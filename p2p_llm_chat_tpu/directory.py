@@ -1,0 +1,189 @@
+"""Directory service: username -> {peer_id, addrs, last} registry, + client.
+
+Reference: go/cmd/directory/main.go (service; memStore at :36-55, /register
+at :62-78, /lookup at :80-92) and the node-side DirectoryClient
+(go/cmd/node/main.go:55-95). Contracts preserved exactly:
+
+- ``POST /register`` body ``{"username": ..., "peer_id": ..., "addrs": [...]}``
+  -> 200 ``{"status":"ok"}``; 400 on missing username/peer_id (directory
+  main.go:72). Last-writer-wins on re-register.
+- ``GET /lookup?username=U`` -> 200 record ``{"username","peer_id","addrs",
+  "last"}`` or 404 ``{"error":"not found"}`` (directory main.go:80-92).
+- ``Last`` timestamp recorded on register. The reference records it but never
+  evicts (SURVEY.md §2 C5); we additionally support optional TTL-based
+  eviction at lookup time (off by default for contract parity), fixing the
+  stale-entry gap the reference's README punts on.
+
+Deliberate fix vs the reference: register bodies are built with a real JSON
+encoder — the reference interpolates usernames into JSON via fmt.Sprintf
+(go/cmd/node/main.go:56), which breaks on quotes; SURVEY.md §2 flags it as
+an injection-prone quirk to fix.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .proto import now_rfc3339, parse_ts
+from .utils.env import env_or
+from .utils.http import HttpServer, Request, Response, Router, http_json
+from .utils.log import get_logger
+
+log = get_logger("directory")
+
+
+@dataclass
+class DirectoryRecord:
+    username: str
+    peer_id: str
+    addrs: list[str] = field(default_factory=list)
+    last: str = field(default_factory=now_rfc3339)
+
+    def to_dict(self) -> dict:
+        return {
+            "username": self.username,
+            "peer_id": self.peer_id,
+            "addrs": self.addrs,
+            "last": self.last,
+        }
+
+
+class MemStore:
+    """RWMutex-guarded map (directory/main.go:36-55). Python's GIL + a single
+    lock gives the same safety; reads copy records out."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._m: dict[str, DirectoryRecord] = {}
+
+    def set(self, rec: DirectoryRecord) -> None:
+        with self._mu:
+            self._m[rec.username] = rec
+
+    def get(self, username: str) -> Optional[DirectoryRecord]:
+        with self._mu:
+            rec = self._m.get(username)
+            if rec is None:
+                return None
+            return DirectoryRecord(rec.username, rec.peer_id, list(rec.addrs), rec.last)
+
+    def delete(self, username: str) -> None:
+        with self._mu:
+            self._m.pop(username, None)
+
+    def all(self) -> list[DirectoryRecord]:
+        with self._mu:
+            return [DirectoryRecord(r.username, r.peer_id, list(r.addrs), r.last)
+                    for r in self._m.values()]
+
+
+class DirectoryService:
+    """The registry HTTP service. ``ADDR`` env configures the listen address
+    (directory/main.go:58); ``DIRECTORY_TTL_SECONDS`` optionally enables
+    stale-record eviction (0 = never, the reference behavior)."""
+
+    def __init__(self, addr: Optional[str] = None, ttl_seconds: float = 0.0) -> None:
+        self.addr_cfg = addr if addr is not None else env_or("ADDR", ":8080")
+        if self.addr_cfg.startswith(":"):
+            self.addr_cfg = "0.0.0.0" + self.addr_cfg
+        self.ttl = ttl_seconds
+        self.store = MemStore()
+        self.router = Router()
+        self.router.add("POST", "/register", self._register)
+        self.router.add("GET", "/lookup", self._lookup)
+        self.router.add("GET", "/healthz", lambda req: Response(200, {"status": "ok"}))
+        self._server: Optional[HttpServer] = None
+
+    # -- handlers ------------------------------------------------------------
+
+    def _register(self, req: Request) -> Response:
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        username = str(body.get("username") or "")
+        peer_id = str(body.get("peer_id") or "")
+        addrs = body.get("addrs") or []
+        if not username or not peer_id:
+            # directory/main.go:72 — both fields required.
+            return Response(400, {"error": "username and peer_id required"})
+        if not isinstance(addrs, list) or not all(isinstance(a, str) for a in addrs):
+            return Response(400, {"error": "addrs must be a list of strings"})
+        self.store.set(DirectoryRecord(username, peer_id, addrs, now_rfc3339()))
+        log.info("registered %s -> %s (%d addrs)", username, peer_id[:12], len(addrs))
+        return Response(200, {"status": "ok"})
+
+    def _lookup(self, req: Request) -> Response:
+        username = req.query.get("username", "")
+        if not username:
+            return Response(400, {"error": "username required"})
+        rec = self.store.get(username)
+        if rec is not None and self.ttl > 0:
+            age = time.time() - parse_ts(rec.last).timestamp()
+            if age > self.ttl:
+                self.store.delete(username)
+                rec = None
+        if rec is None:
+            return Response(404, {"error": "not found"})
+        return Response(200, rec.to_dict())
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "DirectoryService":
+        self._server = HttpServer(self.router, self.addr_cfg).start()
+        log.info("directory listening on %s", self._server.addr)
+        return self
+
+    @property
+    def url(self) -> str:
+        assert self._server is not None
+        host, _, port = self._server.addr.rpartition(":")
+        if host in ("0.0.0.0", "::"):
+            host = "127.0.0.1"
+        return f"http://{host}:{port}"
+
+    def serve_forever(self) -> None:
+        self.start()
+        threading.Event().wait()
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.stop()
+
+
+class DirectoryClient:
+    """HTTP client for the directory (go/cmd/node/main.go:50-95).
+    5 s timeout matches the reference's client (main.go:175)."""
+
+    def __init__(self, base_url: str, timeout: float = 5.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def register(self, username: str, peer_id: str, addrs: list[str]) -> None:
+        http_json("POST", f"{self.base_url}/register",
+                  {"username": username, "peer_id": peer_id, "addrs": addrs},
+                  timeout=self.timeout)
+
+    def lookup(self, username: str) -> DirectoryRecord:
+        import urllib.parse
+        q = urllib.parse.urlencode({"username": username})
+        status, body = http_json("GET", f"{self.base_url}/lookup?{q}",
+                                 timeout=self.timeout)
+        return DirectoryRecord(
+            username=body.get("username", username),
+            peer_id=body.get("peer_id", ""),
+            addrs=list(body.get("addrs") or []),
+            last=body.get("last", ""),
+        )
+
+
+def main() -> None:
+    svc = DirectoryService()
+    svc.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Per-node inbox: mutex-guarded append-only message buffer.
+
+Reference: ``Inbox`` (go/cmd/node/main.go:97-128). Semantics preserved
+exactly, including the deliberate quirks documented in SURVEY.md §2:
+
+- append-only: ``drain`` never truncates, so history persists for the life
+  of the process and repeated polls with ``after=""`` return everything —
+  this is what makes chat history survive UI reloads in the reference.
+- ``drain(after)`` with a non-empty ``after``: linear scan for the matching
+  message ID, return the suffix strictly after it; unknown ID returns the
+  full list (same as the reference's fall-through at main.go:116-127).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .proto import ChatMessage
+
+
+class Inbox:
+    def __init__(self, max_messages: Optional[int] = None) -> None:
+        """``max_messages`` is an additive safety valve (None = unbounded,
+        matching the reference); when set, the oldest messages are dropped
+        once the cap is exceeded so a hostile peer can't OOM the node."""
+        self._mu = threading.Lock()
+        self._msgs: list[ChatMessage] = []
+        self._max = max_messages
+
+    def push(self, msg: ChatMessage) -> None:
+        with self._mu:
+            self._msgs.append(msg)
+            if self._max is not None and len(self._msgs) > self._max:
+                del self._msgs[: len(self._msgs) - self._max]
+
+    def drain(self, after: str = "") -> list[ChatMessage]:
+        with self._mu:
+            if after == "":
+                return list(self._msgs)
+            for i, m in enumerate(self._msgs):
+                if m.id == after:
+                    return list(self._msgs[i + 1:])
+            return list(self._msgs)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._msgs)

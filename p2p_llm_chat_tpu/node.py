@@ -1,0 +1,208 @@
+"""Per-user P2P chat node daemon.
+
+Reference: go/cmd/node/main.go. One process per user composing:
+
+- a P2P host with a chat stream handler on ``/p2p-llm-chat/1.0.0``
+  (ChatProtocolID, main.go:48; handler main.go:158-172),
+- an in-memory append-only Inbox (main.go:97-128),
+- a DirectoryClient that registers on startup — fatal on failure
+  (main.go:183-184) — and resolves recipients on send (main.go:225),
+- a local HTTP API for the UI: ``POST /send`` (main.go:219-265),
+  ``GET /inbox?after=`` (main.go:267-270), ``GET /me`` (main.go:272-278).
+
+Env config (exact names from main.go:131-134): ``MYNAMEIS``, ``HTTP_ADDR``,
+``DIRECTORY_URL``, ``BOOTSTRAP_ADDRS``; additive: ``P2P_ADDR`` (p2p listen
+address, default 127.0.0.1:0), ``RELAY_ADDRS`` (comma-separated relay
+multiaddrs to hold reservations on — the reference ships a relay daemon but
+never wires it into the node, SURVEY.md §2 C6), ``IDENTITY_FILE`` (persist
+the keypair; reference regenerates per start, README.md:134).
+
+Deliberate fix (documented surface change): ``GET /me`` returns the base58
+peer id string — the reference returns raw peer-ID bytes there
+(``string(h.ID())``, main.go:275), an acknowledged quirk (SURVEY.md §2).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .directory import DirectoryClient
+from .inbox import Inbox
+from .p2p import Identity, Multiaddr, P2PHost
+from .p2p.transport import SecureStream
+from .proto import ChatMessage, now_rfc3339
+from .utils.env import env_or
+from .utils.http import HttpServer, Request, Response, Router
+from .utils.log import get_logger
+
+log = get_logger("node")
+
+CHAT_PROTOCOL_ID = "/p2p-llm-chat/1.0.0"   # go/cmd/node/main.go:48
+
+
+class ChatNode:
+    def __init__(
+        self,
+        username: Optional[str] = None,
+        http_addr: Optional[str] = None,
+        directory_url: Optional[str] = None,
+        bootstrap_addrs: Optional[str] = None,
+        p2p_addr: Optional[str] = None,
+        relay_addrs: Optional[str] = None,
+        identity_file: Optional[str] = None,
+        inbox_cap: Optional[int] = None,
+    ) -> None:
+        # Env-var defaults keep the reference's exact config surface
+        # (go/cmd/node/main.go:131-134).
+        self.username = username if username is not None else env_or("MYNAMEIS", "anon")
+        self.http_addr = http_addr if http_addr is not None else env_or("HTTP_ADDR", ":8081")
+        if self.http_addr.startswith(":"):
+            self.http_addr = "127.0.0.1" + self.http_addr
+        self.directory_url = (directory_url if directory_url is not None
+                              else env_or("DIRECTORY_URL", "http://127.0.0.1:8080"))
+        self.bootstrap_addrs = (bootstrap_addrs if bootstrap_addrs is not None
+                                else env_or("BOOTSTRAP_ADDRS", ""))
+        self.relay_addrs = (relay_addrs if relay_addrs is not None
+                            else env_or("RELAY_ADDRS", ""))
+        p2p_listen = p2p_addr if p2p_addr is not None else env_or("P2P_ADDR", "127.0.0.1:0")
+        ident = Identity.load_or_generate(
+            identity_file if identity_file is not None else env_or("IDENTITY_FILE", "") or None
+        )
+        self.host = P2PHost(identity=ident, listen_addr=p2p_listen)
+        self.inbox = Inbox(max_messages=inbox_cap)
+        self.dir = DirectoryClient(self.directory_url)
+        self._http: Optional[HttpServer] = None
+        self.router = Router()
+        self.router.add("POST", "/send", self._handle_send)
+        self.router.add("GET", "/inbox", self._handle_inbox)
+        self.router.add("GET", "/me", self._handle_me)
+        self.router.add("GET", "/healthz", lambda r: Response(200, {"status": "ok"}))
+
+    # -- p2p side ------------------------------------------------------------
+
+    def _on_chat_stream(self, stream: SecureStream, remote_peer_id: str) -> None:
+        """Inbound chat message: read whole stream until sender closes, parse
+        one JSON ChatMessage, push to inbox (go/cmd/node/main.go:158-172)."""
+        try:
+            raw = stream.read_all()
+            if not raw:
+                return
+            msg = ChatMessage.from_json(raw)
+            self.inbox.push(msg)
+            log.info("inbox <- %s: %r (from peer %s)",
+                     msg.from_user, msg.content[:60], remote_peer_id[:12])
+        except (ValueError, OSError) as e:
+            log.warning("bad chat stream from %s: %s", remote_peer_id[:12], e)
+        finally:
+            stream.close()
+
+    # -- HTTP API ------------------------------------------------------------
+
+    def _handle_send(self, req: Request) -> Response:
+        """POST /send {to_username, content} (go/cmd/node/main.go:219-265)."""
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        to_username = str(body.get("to_username") or "")
+        content = str(body.get("content") or "")
+        if not to_username or not content:
+            return Response(400, {"error": "to_username and content required"})
+
+        try:
+            rec = self.dir.lookup(to_username)          # main.go:225
+        except Exception as e:
+            return Response(404, {"error": f"lookup failed: {e}"})
+
+        msg = ChatMessage(from_user=self.username, to_user=to_username,
+                          content=content, timestamp=now_rfc3339())
+
+        # Try each advertised addr (direct first, then circuits), one stream
+        # per message, write JSON, close (main.go:235-261).
+        errors = []
+        addrs = sorted(rec.addrs, key=lambda a: "/p2p-circuit/" in a)
+        for addr_str in addrs:
+            try:
+                maddr = Multiaddr.parse(addr_str)
+                if maddr.peer_id is None:
+                    maddr = maddr.with_peer(rec.peer_id)
+                stream = self.host.new_stream(maddr, CHAT_PROTOCOL_ID, timeout=5.0)
+                try:
+                    stream.send_frame(msg.to_json())
+                    stream.close_write()
+                finally:
+                    stream.close()
+                return Response(200, {"status": "sent", "id": msg.id})  # main.go:264
+            except Exception as e:  # noqa: BLE001 — collect and try next addr
+                errors.append(f"{addr_str}: {e}")
+        return Response(502, {"error": "could not reach peer", "attempts": errors})
+
+    def _handle_inbox(self, req: Request) -> Response:
+        """GET /inbox?after=<id> (go/cmd/node/main.go:267-270)."""
+        after = req.query.get("after", "")
+        return Response(200, [m.to_dict() for m in self.inbox.drain(after)])
+
+    def _handle_me(self, req: Request) -> Response:
+        """GET /me (go/cmd/node/main.go:272-278). Returns the base58 peer id
+        (deliberate fix of the raw-bytes quirk at main.go:275) plus addrs."""
+        return Response(200, {
+            "username": self.username,
+            "peer_id": self.host.peer_id,
+            "addrs": [str(a) for a in self.host.addrs()],
+        })
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ChatNode":
+        self.host.set_stream_handler(CHAT_PROTOCOL_ID, self._on_chat_stream)
+        self.host.start()
+
+        # Relay reservations (additive; see module docstring).
+        for addr_str in filter(None, (s.strip() for s in self.relay_addrs.split(","))):
+            self.host.reserve_on_relay(Multiaddr.parse(addr_str))
+
+        # Register with the directory — fatal on failure, matching
+        # go/cmd/node/main.go:184.
+        addrs = [str(a) for a in self.host.addrs()]
+        self.dir.register(self.username, self.host.peer_id, addrs)
+        log.info("registered %s (%s) with directory %s",
+                 self.username, self.host.peer_id[:12], self.directory_url)
+
+        # Bootstrap connects: parse multiaddr -> connect; errors logged,
+        # non-fatal (go/cmd/node/main.go:189-211).
+        for addr_str in filter(None, (s.strip() for s in self.bootstrap_addrs.split(","))):
+            try:
+                pid = self.host.connect(Multiaddr.parse(addr_str))
+                log.info("bootstrap connected to %s", pid[:12])
+            except Exception as e:  # noqa: BLE001
+                log.warning("bootstrap connect %s failed: %s", addr_str, e)
+
+        self._http = HttpServer(self.router, self.http_addr).start()
+        log.info("node %s HTTP API on %s", self.username, self._http.addr)
+        return self
+
+    @property
+    def http_url(self) -> str:
+        assert self._http is not None
+        host, _, port = self._http.addr.rpartition(":")
+        if host in ("0.0.0.0", "::"):
+            host = "127.0.0.1"
+        return f"http://{host}:{port}"
+
+    def serve_forever(self) -> None:
+        self.start()
+        threading.Event().wait()
+
+    def stop(self) -> None:
+        if self._http:
+            self._http.stop()
+        self.host.close()
+
+
+def main() -> None:
+    ChatNode().serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Peer identity: Ed25519 keypairs and self-certifying peer IDs.
+
+The reference generates an RSA-2048 key per node start (go/cmd/node/main.go:
+293-299) and derives the libp2p peer ID from it. We use Ed25519 (faster
+keygen/sign, 32-byte keys) and make the peer ID *self-certifying*: it embeds
+the public key, so a dialer holding only a directory record can verify the
+remote peer cryptographically. Identities can optionally be persisted —
+the reference lists that as future work (README.md:134).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives import serialization
+
+from ..utils.base58 import b58decode, b58encode
+
+# 2-byte tag prefixed to the raw public key before base58 encoding, giving
+# peer IDs a stable leading character and versioning the key type.
+_ED25519_TAG = b"\x01\xed"
+
+
+def public_key_to_peer_id(pub: Ed25519PublicKey) -> str:
+    raw = pub.public_bytes(
+        serialization.Encoding.Raw, serialization.PublicFormat.Raw
+    )
+    return b58encode(_ED25519_TAG + raw)
+
+
+def peer_id_to_public_key(peer_id: str) -> Ed25519PublicKey:
+    raw = b58decode(peer_id)
+    if len(raw) != 34 or raw[:2] != _ED25519_TAG:
+        raise ValueError(f"not an ed25519 peer id: {peer_id!r}")
+    return Ed25519PublicKey.from_public_bytes(raw[2:])
+
+
+@dataclass
+class Identity:
+    private_key: Ed25519PrivateKey
+
+    @classmethod
+    def generate(cls) -> "Identity":
+        return cls(Ed25519PrivateKey.generate())
+
+    @classmethod
+    def load_or_generate(cls, path: Optional[str]) -> "Identity":
+        """Load a persisted identity from ``path``; generate (and persist,
+        if a path is given) otherwise."""
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                key = Ed25519PrivateKey.from_private_bytes(f.read())
+            return cls(key)
+        ident = cls.generate()
+        if path:
+            raw = ident.private_key.private_bytes(
+                serialization.Encoding.Raw,
+                serialization.PrivateFormat.Raw,
+                serialization.NoEncryption(),
+            )
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "wb") as f:
+                f.write(raw)
+        return ident
+
+    @property
+    def public_key(self) -> Ed25519PublicKey:
+        return self.private_key.public_key()
+
+    @property
+    def public_bytes(self) -> bytes:
+        return self.public_key.public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+
+    @property
+    def peer_id(self) -> str:
+        return public_key_to_peer_id(self.public_key)
+
+    def sign(self, data: bytes) -> bytes:
+        return self.private_key.sign(data)

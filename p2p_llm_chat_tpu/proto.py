@@ -1,0 +1,77 @@
+"""Chat wire schema.
+
+Reference: ``proto.ChatMessage`` (go/cmd/node/proto/message.go:23-29) — a
+single struct with snake_case JSON tags, one JSON-encoded message per peer
+stream. We keep the exact field names and JSON shape so directory records,
+inbox payloads, and peer streams are wire-compatible with the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Any
+
+
+def now_rfc3339() -> str:
+    """RFC3339/ISO-8601 UTC timestamp, the format Go's time.Time marshals to."""
+    return datetime.now(timezone.utc).isoformat().replace("+00:00", "Z")
+
+
+def parse_ts(ts: str) -> datetime:
+    """Parse an RFC3339 timestamp, tolerating 'Z' suffix and missing tz.
+
+    Mirrors the UI-side tolerant parser (web/streamlit_app.py:120-127): on
+    failure callers should fall back to epoch ordering rather than crash.
+    """
+    try:
+        if ts.endswith("Z"):
+            ts = ts[:-1] + "+00:00"
+        dt = datetime.fromisoformat(ts)
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        return dt
+    except (ValueError, AttributeError):
+        return datetime.fromtimestamp(0, tz=timezone.utc)
+
+
+@dataclass
+class ChatMessage:
+    """One chat message. JSON keys match go/cmd/node/proto/message.go:23-29."""
+
+    id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    from_user: str = ""
+    to_user: str = ""
+    content: str = ""
+    timestamp: str = field(default_factory=now_rfc3339)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "id": self.id,
+            "from_user": self.from_user,
+            "to_user": self.to_user,
+            "content": self.content,
+            "timestamp": self.timestamp,
+        }
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.to_dict()).encode("utf-8")
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ChatMessage":
+        return cls(
+            id=str(d.get("id", "")),
+            from_user=str(d.get("from_user", "")),
+            to_user=str(d.get("to_user", "")),
+            content=str(d.get("content", "")),
+            timestamp=str(d.get("timestamp", "")),
+        )
+
+    @classmethod
+    def from_json(cls, raw: bytes | str) -> "ChatMessage":
+        d = json.loads(raw)
+        if not isinstance(d, dict):
+            raise ValueError("chat message must be a JSON object")
+        return cls.from_dict(d)

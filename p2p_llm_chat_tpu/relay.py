@@ -1,0 +1,302 @@
+"""Circuit relay daemon for NAT traversal.
+
+Reference: go/cmd/relay/main.go — a standalone libp2p circuit-relay-v2 hop
+with default resource limits that prints its multiaddrs and blocks forever.
+Ours is the from-scratch equivalent for the in-tree transport (p2p/transport):
+
+- NAT'd peers hold an authenticated *reservation* (Ed25519-signed, verified
+  against the self-certifying peer id) over a persistent control connection.
+- A dialer sends a HOP request naming the target peer; the relay signals the
+  target over its control channel, the target dials back to ACCEPT, and the
+  relay splices the two TCP connections byte-for-byte.
+- The end-to-end secure handshake runs *through* the splice, so the relay
+  never holds keys or sees plaintext — the property circuit-relay-v2
+  provides in the reference.
+- Resource limits in the spirit of relayv2 ``DefaultResources()``
+  (go/cmd/relay/main.go:37): max reservations, max circuits, per-circuit
+  idle timeout, pending-accept timeout.
+
+Env: ``RELAY_ADDR`` (listen, default 127.0.0.1:4100), ``RELAY_MAX_RESERVATIONS``,
+``RELAY_MAX_CIRCUITS``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .p2p import Identity, Multiaddr, peer_id_to_public_key
+from .p2p.transport import (
+    RELAY_ACCEPT,
+    RELAY_HOP,
+    RELAY_INCOMING,
+    RELAY_PING,
+    RELAY_PONG,
+    RELAY_RESERVE,
+    recv_json_frame,
+    send_json_frame,
+)
+from .utils.env import env_int, env_or
+from .utils.log import get_logger
+
+log = get_logger("relay")
+
+RESERVATION_STALE_S = 120.0     # control channel considered dead after this
+CIRCUIT_IDLE_TIMEOUT_S = 300.0  # spliced circuit killed after idle
+ACCEPT_TIMEOUT_S = 10.0         # target must dial back within this
+
+
+@dataclass
+class _Reservation:
+    peer_id: str
+    sock: socket.socket
+    send_lock: threading.Lock = field(default_factory=threading.Lock)
+    last_seen: float = field(default_factory=time.time)
+
+
+@dataclass
+class _PendingCircuit:
+    dialer_sock: socket.socket
+    event: threading.Event = field(default_factory=threading.Event)
+    target_sock: Optional[socket.socket] = None
+
+
+class RelayService:
+    def __init__(self, addr: Optional[str] = None,
+                 max_reservations: Optional[int] = None,
+                 max_circuits: Optional[int] = None,
+                 advertise_host: Optional[str] = None) -> None:
+        addr = addr if addr is not None else env_or("RELAY_ADDR", "127.0.0.1:4100")
+        host, _, port = addr.rpartition(":")
+        self._host = host or "127.0.0.1"
+        self._port = int(port or 0)
+        self._advertise_host = advertise_host or (
+            self._host if self._host not in ("0.0.0.0", "::") else "127.0.0.1")
+        self.identity = Identity.generate()
+        self.max_reservations = (max_reservations if max_reservations is not None
+                                 else env_int("RELAY_MAX_RESERVATIONS", 128))
+        self.max_circuits = (max_circuits if max_circuits is not None
+                             else env_int("RELAY_MAX_CIRCUITS", 1024))
+        self._reservations: dict[str, _Reservation] = {}
+        self._pending: dict[str, _PendingCircuit] = {}
+        self._active_circuits = 0
+        self._mu = threading.Lock()
+        self._server: Optional[socket.socket] = None
+        self._closed = threading.Event()
+
+    @property
+    def peer_id(self) -> str:
+        return self.identity.peer_id
+
+    def addr(self) -> Multiaddr:
+        return Multiaddr(self._advertise_host, self._port, peer_id=self.peer_id)
+
+    def start(self) -> "RelayService":
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self._host, self._port))
+        s.listen(128)
+        self._port = s.getsockname()[1]
+        self._server = s
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        # Print multiaddrs like the reference does (go/cmd/relay/main.go:40-45).
+        log.info("relay %s listening; multiaddr: %s", self.peer_id[:12], self.addr())
+        return self
+
+    def stop(self) -> None:
+        self._closed.set()
+        if self._server is not None:
+            try:
+                self._server.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        with self._mu:
+            for r in self._reservations.values():
+                try:
+                    r.sock.close()
+                except OSError:
+                    pass
+            self._reservations.clear()
+
+    def serve_forever(self) -> None:
+        self.start()
+        threading.Event().wait()    # block forever (go/cmd/relay/main.go:46)
+
+    # -- connection handling -------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        assert self._server is not None
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle_conn, args=(conn,), daemon=True).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(ACCEPT_TIMEOUT_S)
+            msg = recv_json_frame(conn)
+            if msg is None:
+                conn.close()
+                return
+            mtype = msg.get("type")
+            if mtype == RELAY_RESERVE:
+                self._handle_reserve(conn, msg)
+            elif mtype == RELAY_HOP:
+                self._handle_hop(conn, msg)
+            elif mtype == RELAY_ACCEPT:
+                self._handle_accept(conn, msg)
+            else:
+                send_json_frame(conn, {"ok": False, "error": "unknown type"})
+                conn.close()
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            log.debug("relay conn error: %s", e)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_reserve(self, conn: socket.socket, msg: dict) -> None:
+        peer_id = str(msg.get("peer_id") or "")
+        ts = str(msg.get("ts") or "")
+        sig_hex = str(msg.get("sig") or "")
+        try:
+            pub = peer_id_to_public_key(peer_id)
+            pub.verify(bytes.fromhex(sig_hex),
+                       f"{RELAY_RESERVE}|{peer_id}|{ts}".encode())
+        except Exception:
+            send_json_frame(conn, {"ok": False, "error": "bad signature"})
+            conn.close()
+            return
+        with self._mu:
+            if (peer_id not in self._reservations
+                    and len(self._reservations) >= self.max_reservations):
+                send_json_frame(conn, {"ok": False, "error": "reservation limit"})
+                conn.close()
+                return
+            old = self._reservations.get(peer_id)
+            if old is not None:
+                try:
+                    old.sock.close()
+                except OSError:
+                    pass
+            res = _Reservation(peer_id=peer_id, sock=conn)
+            self._reservations[peer_id] = res
+        send_json_frame(conn, {"ok": True})
+        log.info("reservation: %s", peer_id[:12])
+        conn.settimeout(None)
+        # Keep reading the control channel (pongs / detect close).
+        try:
+            while not self._closed.is_set():
+                m = recv_json_frame(conn)
+                if m is None:
+                    break
+                res.last_seen = time.time()
+        except (OSError, ValueError, json.JSONDecodeError):
+            pass
+        with self._mu:
+            if self._reservations.get(peer_id) is res:
+                del self._reservations[peer_id]
+        try:
+            conn.close()
+        except OSError:
+            pass
+        log.info("reservation closed: %s", peer_id[:12])
+
+    def _handle_hop(self, conn: socket.socket, msg: dict) -> None:
+        target = str(msg.get("target") or "")
+        with self._mu:
+            res = self._reservations.get(target)
+            if res is None:
+                send_json_frame(conn, {"ok": False, "error": "no reservation for target"})
+                conn.close()
+                return
+            if self._active_circuits >= self.max_circuits:
+                send_json_frame(conn, {"ok": False, "error": "circuit limit"})
+                conn.close()
+                return
+            conn_id = uuid.uuid4().hex
+            pending = _PendingCircuit(dialer_sock=conn)
+            self._pending[conn_id] = pending
+        try:
+            with res.send_lock:
+                send_json_frame(res.sock, {"type": RELAY_INCOMING, "conn_id": conn_id})
+        except OSError:
+            with self._mu:
+                self._pending.pop(conn_id, None)
+            send_json_frame(conn, {"ok": False, "error": "target unreachable"})
+            conn.close()
+            return
+        if not pending.event.wait(ACCEPT_TIMEOUT_S):
+            with self._mu:
+                self._pending.pop(conn_id, None)
+            send_json_frame(conn, {"ok": False, "error": "target did not accept"})
+            conn.close()
+            return
+        assert pending.target_sock is not None
+        send_json_frame(conn, {"ok": True})
+        self._splice(conn, pending.target_sock)
+
+    def _handle_accept(self, conn: socket.socket, msg: dict) -> None:
+        conn_id = str(msg.get("conn_id") or "")
+        with self._mu:
+            pending = self._pending.pop(conn_id, None)
+        if pending is None:
+            send_json_frame(conn, {"ok": False, "error": "unknown conn_id"})
+            conn.close()
+            return
+        send_json_frame(conn, {"ok": True})
+        pending.target_sock = conn
+        pending.event.set()
+
+    def _splice(self, a: socket.socket, b: socket.socket) -> None:
+        """Bidirectional byte pump between dialer and target sockets."""
+        with self._mu:
+            self._active_circuits += 1
+
+        def pump(src: socket.socket, dst: socket.socket) -> None:
+            try:
+                src.settimeout(CIRCUIT_IDLE_TIMEOUT_S)
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        t1 = threading.Thread(target=pump, args=(a, b), daemon=True)
+        t2 = threading.Thread(target=pump, args=(b, a), daemon=True)
+        t1.start(); t2.start()
+        t1.join(); t2.join()
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+        with self._mu:
+            self._active_circuits -= 1
+
+
+def main() -> None:
+    RelayService().serve_forever()
+
+
+if __name__ == "__main__":
+    main()

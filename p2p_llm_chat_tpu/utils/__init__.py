@@ -1,0 +1,1 @@
+"""Shared utilities: env config, logging, base58, HTTP micro-framework, metrics."""

@@ -1,0 +1,18 @@
+"""Test config: force JAX onto a virtual 8-device CPU mesh.
+
+Per SURVEY.md §4 — same model code under jax.sharding runs on CPU with a
+faked device count; real-TPU paths are exercised by bench.py / the driver's
+dryrun instead. Must run before jax is imported anywhere.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

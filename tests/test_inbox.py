@@ -1,0 +1,74 @@
+"""Inbox semantics tests — the exact Drain behavior of the reference
+(go/cmd/node/main.go:97-128), including its documented quirks."""
+
+import threading
+
+from p2p_llm_chat_tpu.inbox import Inbox
+from p2p_llm_chat_tpu.proto import ChatMessage
+
+
+def _msgs(n):
+    return [ChatMessage(content=f"m{i}") for i in range(n)]
+
+
+def test_drain_empty_after_returns_everything_and_never_truncates():
+    inbox = Inbox()
+    msgs = _msgs(3)
+    for m in msgs:
+        inbox.push(m)
+    # Repeated polls with after="" keep returning full history (SURVEY.md §2:
+    # this is what makes chat history survive UI reruns).
+    assert [m.id for m in inbox.drain("")] == [m.id for m in msgs]
+    assert [m.id for m in inbox.drain("")] == [m.id for m in msgs]
+    assert len(inbox) == 3
+
+
+def test_drain_after_returns_suffix():
+    inbox = Inbox()
+    msgs = _msgs(5)
+    for m in msgs:
+        inbox.push(m)
+    out = inbox.drain(msgs[1].id)
+    assert [m.id for m in out] == [m.id for m in msgs[2:]]
+    assert inbox.drain(msgs[-1].id) == []
+
+
+def test_drain_unknown_after_returns_full_list():
+    # Reference fall-through (main.go:116-127): no matching ID -> everything.
+    inbox = Inbox()
+    msgs = _msgs(3)
+    for m in msgs:
+        inbox.push(m)
+    assert len(inbox.drain("no-such-id")) == 3
+
+
+def test_drain_returns_copy_not_view():
+    inbox = Inbox()
+    inbox.push(ChatMessage(content="x"))
+    out = inbox.drain("")
+    out.append(ChatMessage(content="y"))
+    assert len(inbox.drain("")) == 1
+
+
+def test_optional_cap_drops_oldest():
+    inbox = Inbox(max_messages=2)
+    msgs = _msgs(4)
+    for m in msgs:
+        inbox.push(m)
+    assert [m.id for m in inbox.drain("")] == [m.id for m in msgs[2:]]
+
+
+def test_concurrent_push_drain():
+    inbox = Inbox()
+    n_threads, per_thread = 8, 50
+
+    def producer():
+        for m in _msgs(per_thread):
+            inbox.push(m)
+
+    threads = [threading.Thread(target=producer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(inbox.drain("")) == n_threads * per_thread

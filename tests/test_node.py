@@ -1,0 +1,106 @@
+"""Node integration tests: directory + two nodes, full message round-trip.
+
+The in-process analogue of the reference's manual two-node validation via
+start_all.sh (SURVEY.md §4 'multi-node without a cluster').
+"""
+
+import time
+
+import pytest
+
+from p2p_llm_chat_tpu.directory import DirectoryService
+from p2p_llm_chat_tpu.node import ChatNode
+from p2p_llm_chat_tpu.utils.http import HttpError, http_json
+
+
+@pytest.fixture()
+def two_nodes():
+    directory = DirectoryService(addr="127.0.0.1:0").start()
+    a = ChatNode(username="najy", http_addr="127.0.0.1:0",
+                 directory_url=directory.url, bootstrap_addrs="",
+                 relay_addrs="", identity_file="").start()
+    b = ChatNode(username="cannan", http_addr="127.0.0.1:0",
+                 directory_url=directory.url, bootstrap_addrs="",
+                 relay_addrs="", identity_file="").start()
+    yield a, b
+    a.stop()
+    b.stop()
+    directory.stop()
+
+
+def _wait_inbox(node_url, want_count, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        _, inbox = http_json("GET", f"{node_url}/inbox?after=")
+        if len(inbox) >= want_count:
+            return inbox
+        time.sleep(0.02)
+    raise AssertionError(f"inbox never reached {want_count} messages")
+
+
+def test_me_endpoint(two_nodes):
+    a, _ = two_nodes
+    status, me = http_json("GET", f"{a.http_url}/me")
+    assert status == 200
+    assert me["username"] == "najy"
+    assert me["peer_id"] == a.host.peer_id
+    assert any("/p2p/" in addr for addr in me["addrs"])
+
+
+def test_send_round_trip(two_nodes):
+    a, b = two_nodes
+    status, resp = http_json("POST", f"{a.http_url}/send",
+                             {"to_username": "cannan", "content": "hello ✨"})
+    assert status == 200
+    assert resp["status"] == "sent"          # node/main.go:264
+    assert resp["id"]
+
+    inbox = _wait_inbox(b.http_url, 1)
+    m = inbox[0]
+    assert m["from_user"] == "najy"
+    assert m["to_user"] == "cannan"
+    assert m["content"] == "hello ✨"
+    assert m["id"] == resp["id"]
+
+
+def test_bidirectional_and_after_cursor(two_nodes):
+    a, b = two_nodes
+    http_json("POST", f"{a.http_url}/send", {"to_username": "cannan", "content": "one"})
+    http_json("POST", f"{a.http_url}/send", {"to_username": "cannan", "content": "two"})
+    inbox = _wait_inbox(b.http_url, 2)
+    first_id = inbox[0]["id"]
+    _, suffix = http_json("GET", f"{b.http_url}/inbox?after={first_id}")
+    assert [m["content"] for m in suffix] == ["two"]
+
+    # Reply path.
+    http_json("POST", f"{b.http_url}/send", {"to_username": "najy", "content": "ack"})
+    back = _wait_inbox(a.http_url, 1)
+    assert back[0]["content"] == "ack"
+
+
+def test_send_validates_body(two_nodes):
+    a, _ = two_nodes
+    for body in [{}, {"to_username": "cannan"}, {"content": "x"}]:
+        with pytest.raises(HttpError) as e:
+            http_json("POST", f"{a.http_url}/send", body)
+        assert e.value.status == 400
+
+
+def test_send_to_unknown_user_is_404(two_nodes):
+    a, _ = two_nodes
+    with pytest.raises(HttpError) as e:
+        http_json("POST", f"{a.http_url}/send",
+                  {"to_username": "ghost", "content": "boo"})
+    assert e.value.status == 404
+
+
+def test_send_to_stale_peer_is_502(two_nodes):
+    # Registered but unreachable peer (node restarted/crashed) -> 502 with
+    # attempt detail, not a hang.
+    a, b = two_nodes
+    b.stop()
+    with pytest.raises(HttpError) as e:
+        http_json("POST", f"{a.http_url}/send",
+                  {"to_username": "cannan", "content": "anyone home?"},
+                  timeout=15.0)
+    assert e.value.status == 502

@@ -1,0 +1,139 @@
+"""P2P substrate tests: base58, identities, multiaddrs, secure transport."""
+
+import threading
+
+import pytest
+
+from p2p_llm_chat_tpu.p2p import Identity, Multiaddr, P2PHost, peer_id_to_public_key
+from p2p_llm_chat_tpu.p2p.transport import HandshakeError
+from p2p_llm_chat_tpu.utils.base58 import b58decode, b58encode
+
+
+# -- base58 -----------------------------------------------------------------
+
+def test_base58_round_trip():
+    for data in [b"", b"\x00", b"\x00\x00abc", b"hello world", bytes(range(256))]:
+        assert b58decode(b58encode(data)) == data
+
+
+def test_base58_known_vector():
+    # "hello" in bitcoin base58 is Cn8eVZg.
+    assert b58encode(b"hello") == "Cn8eVZg"
+    assert b58decode("Cn8eVZg") == b"hello"
+
+
+def test_base58_rejects_invalid_chars():
+    with pytest.raises(ValueError):
+        b58decode("0OIl")  # excluded alphabet characters
+
+
+# -- identity ---------------------------------------------------------------
+
+def test_peer_id_is_self_certifying():
+    ident = Identity.generate()
+    pub = peer_id_to_public_key(ident.peer_id)
+    sig = ident.sign(b"payload")
+    pub.verify(sig, b"payload")  # raises on mismatch
+
+
+def test_identity_persistence(tmp_path):
+    path = str(tmp_path / "identity.key")
+    a = Identity.load_or_generate(path)
+    b = Identity.load_or_generate(path)
+    assert a.peer_id == b.peer_id
+    assert Identity.generate().peer_id != a.peer_id
+
+
+# -- multiaddr --------------------------------------------------------------
+
+def test_multiaddr_parse_format_round_trip():
+    s = "/ip4/127.0.0.1/tcp/4001/p2p/QmPeer"
+    m = Multiaddr.parse(s)
+    assert (m.host, m.port, m.peer_id) == ("127.0.0.1", 4001, "QmPeer")
+    assert str(m) == s
+
+
+def test_multiaddr_circuit():
+    s = "/ip4/10.0.0.1/tcp/4100/p2p/RelayID/p2p-circuit/p2p/TargetID"
+    m = Multiaddr.parse(s)
+    assert m.is_circuit
+    assert m.relay_peer_id == "RelayID"
+    assert m.peer_id == "TargetID"
+    assert str(m) == s
+
+
+def test_multiaddr_quic_parses_as_dialable_host_port():
+    # The reference advertises QUIC addrs too (go/cmd/node/main.go:140).
+    m = Multiaddr.parse("/ip4/1.2.3.4/udp/4001/quic-v1/p2p/X")
+    assert (m.host, m.port, m.peer_id) == ("1.2.3.4", 4001, "X")
+
+
+def test_multiaddr_rejects_unknown_component():
+    with pytest.raises(ValueError):
+        Multiaddr.parse("/ip4/1.2.3.4/sctp/5")
+
+
+# -- secure transport -------------------------------------------------------
+
+def test_stream_round_trip_and_peer_authentication():
+    server = P2PHost(listen_addr="127.0.0.1:0").start()
+    got = {}
+    done = threading.Event()
+
+    def handler(stream, remote_peer_id):
+        got["data"] = stream.read_all()
+        got["peer"] = remote_peer_id
+        stream.close()
+        done.set()
+
+    server.set_stream_handler("/test/1.0.0", handler)
+    client = P2PHost(listen_addr="127.0.0.1:0").start()
+    try:
+        addr = server.addrs()[0]
+        stream = client.new_stream(addr, "/test/1.0.0")
+        stream.send_frame(b"part one|")
+        stream.send_frame(b"part two")
+        stream.close_write()
+        assert done.wait(5)
+        assert got["data"] == b"part one|part two"
+        assert got["peer"] == client.peer_id          # dialer authenticated
+        assert stream.remote_peer_id == server.peer_id  # listener authenticated
+    finally:
+        client.close()
+        server.close()
+
+
+def test_dial_wrong_peer_id_fails_handshake():
+    server = P2PHost(listen_addr="127.0.0.1:0").start()
+    client = P2PHost(listen_addr="127.0.0.1:0").start()
+    imposter_id = Identity.generate().peer_id
+    try:
+        addr = server.addrs()[0]
+        bad = Multiaddr(addr.host, addr.port, peer_id=imposter_id)
+        with pytest.raises(HandshakeError):
+            client.dial(bad)
+    finally:
+        client.close()
+        server.close()
+
+
+def test_unknown_protocol_closes_stream():
+    server = P2PHost(listen_addr="127.0.0.1:0").start()
+    client = P2PHost(listen_addr="127.0.0.1:0").start()
+    try:
+        stream = client.new_stream(server.addrs()[0], "/nope/9.9.9")
+        stream.settimeout(5)
+        assert stream.recv_frame() is None  # server closed on us
+    finally:
+        client.close()
+        server.close()
+
+
+def test_connect_returns_remote_peer_id():
+    server = P2PHost(listen_addr="127.0.0.1:0").start()
+    client = P2PHost(listen_addr="127.0.0.1:0").start()
+    try:
+        assert client.connect(server.addrs()[0]) == server.peer_id
+    finally:
+        client.close()
+        server.close()

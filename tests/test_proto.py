@@ -1,0 +1,46 @@
+"""Wire-schema tests: ChatMessage JSON round-trip matches the reference's
+snake_case shape (go/cmd/node/proto/message.go:23-29)."""
+
+import json
+
+from p2p_llm_chat_tpu.proto import ChatMessage, now_rfc3339, parse_ts
+
+
+def test_json_keys_are_snake_case():
+    m = ChatMessage(from_user="najy", to_user="cannan", content="hi")
+    d = json.loads(m.to_json())
+    assert set(d.keys()) == {"id", "from_user", "to_user", "content", "timestamp"}
+    assert d["from_user"] == "najy"
+    assert d["to_user"] == "cannan"
+    assert d["content"] == "hi"
+
+
+def test_round_trip():
+    m = ChatMessage(from_user="a", to_user="b", content="héllo ✨ \"quoted\"")
+    m2 = ChatMessage.from_json(m.to_json())
+    assert m2 == m
+
+
+def test_ids_are_unique():
+    ids = {ChatMessage().id for _ in range(100)}
+    assert len(ids) == 100
+
+
+def test_timestamp_is_rfc3339_utc():
+    ts = now_rfc3339()
+    assert ts.endswith("Z")
+    dt = parse_ts(ts)
+    assert dt.tzinfo is not None
+
+
+def test_parse_ts_tolerates_garbage():
+    # Mirrors the UI's tolerant parser (web/streamlit_app.py:120-127):
+    # unparseable timestamps sort to epoch rather than crash.
+    assert parse_ts("not-a-timestamp").timestamp() == 0.0
+    assert parse_ts("").timestamp() == 0.0
+
+
+def test_from_json_rejects_non_object():
+    import pytest
+    with pytest.raises(ValueError):
+        ChatMessage.from_json(b'["not", "an", "object"]')

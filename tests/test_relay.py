@@ -1,0 +1,131 @@
+"""Relay circuit tests: a message delivered through the relay splice,
+end-to-end encrypted (the relay never holds keys)."""
+
+import time
+
+import pytest
+
+from p2p_llm_chat_tpu.directory import DirectoryService
+from p2p_llm_chat_tpu.node import ChatNode
+from p2p_llm_chat_tpu.p2p import Multiaddr, P2PHost
+from p2p_llm_chat_tpu.relay import RelayService
+from p2p_llm_chat_tpu.utils.http import http_json
+
+
+def test_circuit_dial_through_relay():
+    relay = RelayService(addr="127.0.0.1:0").start()
+    target = P2PHost(listen_addr="127.0.0.1:0").start()
+    dialer = P2PHost(listen_addr="127.0.0.1:0").start()
+    got = {}
+    import threading
+    done = threading.Event()
+
+    def handler(stream, remote_peer_id):
+        got["data"] = stream.read_all()
+        got["peer"] = remote_peer_id
+        stream.close()
+        done.set()
+
+    target.set_stream_handler("/test/1.0.0", handler)
+    target.reserve_on_relay(relay.addr())
+    time.sleep(0.3)  # allow reservation to establish
+
+    try:
+        circuit = relay.addr().with_peer(target.peer_id).circuit_via(relay.peer_id)
+        assert circuit.is_circuit
+        stream = dialer.new_stream(circuit, "/test/1.0.0")
+        assert stream.remote_peer_id == target.peer_id  # e2e authenticated
+        stream.send_frame(b"via relay")
+        stream.close_write()
+        assert done.wait(5)
+        assert got["data"] == b"via relay"
+        assert got["peer"] == dialer.peer_id
+    finally:
+        dialer.close()
+        target.close()
+        relay.stop()
+
+
+def test_circuit_dial_after_idle_reservation():
+    """Regression: the reservation control channel must survive idle periods
+    longer than the TCP connect timeout (found live: a lingering per-socket
+    timeout made reservations flap every 5 s, so idle NAT'd peers became
+    unreachable)."""
+    relay = RelayService(addr="127.0.0.1:0").start()
+    target = P2PHost(listen_addr="127.0.0.1:0").start()
+    dialer = P2PHost(listen_addr="127.0.0.1:0").start()
+    got = {}
+    import threading
+    done = threading.Event()
+
+    def handler(stream, remote_peer_id):
+        got["data"] = stream.read_all()
+        stream.close()
+        done.set()
+
+    target.set_stream_handler("/test/1.0.0", handler)
+    target.reserve_on_relay(relay.addr())
+    time.sleep(6.0)  # > the 5 s connect timeout; reservation must still hold
+
+    try:
+        circuit = relay.addr().with_peer(target.peer_id).circuit_via(relay.peer_id)
+        stream = dialer.new_stream(circuit, "/test/1.0.0")
+        stream.send_frame(b"after idle")
+        stream.close_write()
+        assert done.wait(5)
+        assert got["data"] == b"after idle"
+    finally:
+        dialer.close()
+        target.close()
+        relay.stop()
+
+
+def test_hop_to_unreserved_target_refused():
+    relay = RelayService(addr="127.0.0.1:0").start()
+    dialer = P2PHost(listen_addr="127.0.0.1:0").start()
+    try:
+        ghost = relay.addr().with_peer("NoSuchPeer").circuit_via(relay.peer_id)
+        with pytest.raises(ConnectionError):
+            dialer.dial(ghost)
+    finally:
+        dialer.close()
+        relay.stop()
+
+
+def test_node_advertises_circuit_addr_and_receives_via_relay():
+    """A NAT'd node (p2p bound to localhost, reachable only via relay in this
+    scenario) registers its circuit addr; peer delivers through the relay."""
+    relay = RelayService(addr="127.0.0.1:0").start()
+    directory = DirectoryService(addr="127.0.0.1:0").start()
+    relay_addr = str(relay.addr())
+    b = ChatNode(username="cannan", http_addr="127.0.0.1:0",
+                 directory_url=directory.url, bootstrap_addrs="",
+                 relay_addrs=relay_addr, identity_file="").start()
+    a = ChatNode(username="najy", http_addr="127.0.0.1:0",
+                 directory_url=directory.url, bootstrap_addrs="",
+                 relay_addrs="", identity_file="").start()
+    time.sleep(0.3)
+    try:
+        # b's registration includes a circuit addr.
+        rec = a.dir.lookup("cannan")
+        assert any("/p2p-circuit/" in addr for addr in rec.addrs)
+
+        # Force relay-only delivery: strip b's direct addr from the directory.
+        circuit_only = [x for x in rec.addrs if "/p2p-circuit/" in x]
+        a.dir.register("cannan", rec.peer_id, circuit_only)
+
+        status, resp = http_json("POST", f"{a.http_url}/send",
+                                 {"to_username": "cannan", "content": "through the relay"})
+        assert status == 200 and resp["status"] == "sent"
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            _, inbox = http_json("GET", f"{b.http_url}/inbox?after=")
+            if inbox:
+                break
+            time.sleep(0.05)
+        assert inbox and inbox[0]["content"] == "through the relay"
+    finally:
+        a.stop()
+        b.stop()
+        directory.stop()
+        relay.stop()
