@@ -88,6 +88,9 @@ class DirectoryService:
     def __init__(self, addr: Optional[str] = None, ttl_seconds: float = 0.0) -> None:
         self.addr_cfg = addr if addr is not None else env_or("ADDR", ":8080")
         if self.addr_cfg.startswith(":"):
+            # The reference directory binds all interfaces for ":8080"
+            # (directory/main.go:58); keep that, unlike the loopback default
+            # the other services get.
             self.addr_cfg = "0.0.0.0" + self.addr_cfg
         self.ttl = ttl_seconds
         self.store = MemStore()
@@ -140,10 +143,7 @@ class DirectoryService:
     @property
     def url(self) -> str:
         assert self._server is not None
-        host, _, port = self._server.addr.rpartition(":")
-        if host in ("0.0.0.0", "::"):
-            host = "127.0.0.1"
-        return f"http://{host}:{port}"
+        return self._server.url
 
     def serve_forever(self) -> None:
         self.start()

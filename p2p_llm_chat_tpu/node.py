@@ -57,8 +57,6 @@ class ChatNode:
         # (go/cmd/node/main.go:131-134).
         self.username = username if username is not None else env_or("MYNAMEIS", "anon")
         self.http_addr = http_addr if http_addr is not None else env_or("HTTP_ADDR", ":8081")
-        if self.http_addr.startswith(":"):
-            self.http_addr = "127.0.0.1" + self.http_addr
         self.directory_url = (directory_url if directory_url is not None
                               else env_or("DIRECTORY_URL", "http://127.0.0.1:8080"))
         self.bootstrap_addrs = (bootstrap_addrs if bootstrap_addrs is not None
@@ -185,10 +183,7 @@ class ChatNode:
     @property
     def http_url(self) -> str:
         assert self._http is not None
-        host, _, port = self._http.addr.rpartition(":")
-        if host in ("0.0.0.0", "::"):
-            host = "127.0.0.1"
-        return f"http://{host}:{port}"
+        return self._http.url
 
     def serve_forever(self) -> None:
         self.start()

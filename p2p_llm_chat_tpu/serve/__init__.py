@@ -1,0 +1,22 @@
+"""TPU LLM serving stack — the in-tree replacement for the reference's
+external Ollama dependency (L4 in SURVEY.md §1).
+
+The reference delegates all inference to an out-of-tree Ollama server via
+``POST {OLLAMA_URL}/api/generate`` (web/streamlit_app.py:91-98). This package
+serves that exact HTTP contract (plus ``/api/chat`` and ``/api/tags``) from
+an in-tree backend so ``OLLAMA_URL`` can point here unchanged:
+
+- :mod:`api`       — the Ollama-compatible HTTP front (+ /metrics)
+- :mod:`backend`   — the backend interface + FakeLLM (canned responses, the
+                     test double mirroring the reference's graceful
+                     degradation path, streamlit_app.py:99-101)
+- :mod:`engine`    — the real JAX/TPU inference engine (prefill + decode,
+                     paged KV cache)
+- :mod:`scheduler` — continuous batching: all peers' suggestion requests
+                     merged into one TPU decode loop
+"""
+
+from .backend import Backend, FakeLLM, GenerateOptions, GenerateRequest
+from .api import OllamaServer
+
+__all__ = ["Backend", "FakeLLM", "GenerateOptions", "GenerateRequest", "OllamaServer"]

@@ -1,0 +1,221 @@
+"""Ollama-compatible HTTP front for the TPU serving stack.
+
+The drop-in replacement for the reference's external Ollama server: the UI's
+``OLLAMA_URL`` points here unchanged. Contract (from web/streamlit_app.py:
+91-98 and BASELINE.json's north star — both endpoints implemented, see
+SURVEY.md §1 L4 note):
+
+- ``POST /api/generate``  body ``{"model", "prompt", "stream", "options"}``;
+  non-streaming response carries ``{"response": ..., "done": true}`` plus
+  Ollama's timing fields; streaming (Ollama's default when ``stream`` is
+  omitted) sends NDJSON chunks ``{"response": <delta>, "done": false}`` and
+  a final ``done: true`` record with stats.
+- ``POST /api/chat``      same shapes with ``messages`` / ``message``.
+- ``GET  /api/tags``      model listing.
+- ``GET  /api/version``, ``GET /`` ("Ollama is running") — client health
+  checks.
+- ``GET  /metrics``       Prometheus-style counters: request counts, TTFT
+  and total-latency summaries, tokens generated, in-flight gauge (the
+  benchmark metrics of BASELINE.md, in-tree per SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Iterator, Optional
+
+from ..proto import now_rfc3339
+from ..utils.env import env_or
+from ..utils.http import HttpServer, Request, Response, Router
+from ..utils.log import get_logger
+from ..utils.metrics import Registry
+from .backend import Backend, GenerateOptions, GenerateRequest, RequestStats
+
+log = get_logger("serve.api")
+
+
+def render_chat_prompt(messages: list[dict], backend: Backend) -> str:
+    """Flatten an /api/chat messages list into a prompt. Backends that have a
+    tokenizer-aware chat template override via ``render_chat``."""
+    fn = getattr(backend, "render_chat", None)
+    if fn is not None:
+        return fn(messages)
+    parts = []
+    for m in messages:
+        role = m.get("role", "user")
+        parts.append(f"{role}: {m.get('content', '')}")
+    parts.append("assistant:")
+    return "\n".join(parts)
+
+
+class OllamaServer:
+    def __init__(self, backend: Backend, addr: Optional[str] = None,
+                 registry: Optional[Registry] = None) -> None:
+        self.backend = backend
+        # 11434 is Ollama's default port; SERVE_ADDR overrides.
+        self.addr_cfg = addr if addr is not None else env_or("SERVE_ADDR", "127.0.0.1:11434")
+        self.metrics = registry or Registry()
+        self._m_requests = self.metrics.counter("serve_requests_total")
+        self._m_errors = self.metrics.counter("serve_errors_total")
+        self._m_tokens = self.metrics.counter("serve_completion_tokens_total")
+        self._m_inflight = self.metrics.gauge("serve_inflight_requests")
+        self._m_ttft = self.metrics.histogram("serve_ttft_seconds")
+        self._m_total = self.metrics.histogram("serve_request_seconds")
+        self.router = Router()
+        self.router.add("POST", "/api/generate", self._generate)
+        self.router.add("POST", "/api/chat", self._chat)
+        self.router.add("GET", "/api/tags", self._tags)
+        self.router.add("GET", "/api/version", lambda r: Response(200, {
+            "version": "0.1.0-p2p-llm-chat-tpu"}))
+        self.router.add("GET", "/", lambda r: Response(
+            200, "Ollama is running", content_type="text/plain"))
+        self.router.add("HEAD", "/", lambda r: Response(200, ""))
+        self.router.add("GET", "/metrics", lambda r: Response(
+            200, self.metrics.render(), content_type="text/plain; version=0.0.4"))
+        self.router.add("GET", "/healthz", lambda r: Response(200, {"status": "ok"}))
+        self._server: Optional[HttpServer] = None
+
+    # -- helpers -------------------------------------------------------------
+
+    def _finalize_record(self, model: str, stats: RequestStats,
+                         started: float) -> dict:
+        total_ns = int((time.monotonic() - started) * 1e9)
+        eval_ns = int((stats.total_s or 0) * 1e9)
+        ttft_ns = int((stats.ttft_s or 0) * 1e9)
+        return {
+            "model": model,
+            "created_at": now_rfc3339(),
+            "done": True,
+            "done_reason": "stop",
+            "total_duration": total_ns,
+            "load_duration": 0,
+            "prompt_eval_count": stats.prompt_tokens,
+            "prompt_eval_duration": ttft_ns,
+            "eval_count": stats.completion_tokens,
+            "eval_duration": max(0, eval_ns - ttft_ns),
+        }
+
+    def _observe(self, stats: RequestStats) -> None:
+        if stats.ttft_s is not None:
+            self._m_ttft.observe(stats.ttft_s)
+        if stats.total_s is not None:
+            self._m_total.observe(stats.total_s)
+        self._m_tokens.inc(stats.completion_tokens)
+
+    def _run(self, req_body: dict, prompt: str, key: str,
+             wrap) -> Response:
+        """Shared generate/chat execution. ``key``: response field holding
+        text ('response' or 'message'); ``wrap``: delta -> field value."""
+        model = str(req_body.get("model") or self.backend.name)
+        opts = GenerateOptions.from_ollama(req_body.get("options"))
+        stream = req_body.get("stream")
+        stream = True if stream is None else bool(stream)  # Ollama defaults to streaming
+        greq = GenerateRequest(prompt=prompt, model=model, options=opts)
+        stats = RequestStats()
+        self._m_requests.inc()
+        self._m_inflight.add(1)
+        started = time.monotonic()
+
+        if not stream:
+            try:
+                text = "".join(self.backend.generate_stream(greq, stats))
+            except Exception as e:  # noqa: BLE001
+                self._m_errors.inc()
+                self._m_inflight.add(-1)
+                log.exception("generate failed")
+                return Response(500, {"error": str(e)})
+            self._m_inflight.add(-1)
+            self._observe(stats)
+            rec = self._finalize_record(model, stats, started)
+            rec[key] = wrap(text)
+            return Response(200, rec)
+
+        def ndjson() -> Iterator[bytes]:
+            try:
+                for delta in self.backend.generate_stream(greq, stats):
+                    chunk = {"model": model, "created_at": now_rfc3339(),
+                             key: wrap(delta), "done": False}
+                    yield (json.dumps(chunk) + "\n").encode()
+                rec = self._finalize_record(model, stats, started)
+                rec[key] = wrap("")
+                yield (json.dumps(rec) + "\n").encode()
+                self._observe(stats)
+            except Exception as e:  # noqa: BLE001
+                self._m_errors.inc()
+                log.exception("stream generate failed")
+                yield (json.dumps({"error": str(e), "done": True}) + "\n").encode()
+            finally:
+                self._m_inflight.add(-1)
+
+        return Response(200, stream=ndjson(), content_type="application/x-ndjson")
+
+    # -- handlers ------------------------------------------------------------
+
+    def _generate(self, req: Request) -> Response:
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        prompt = str(body.get("prompt") or "")
+        return self._run(body, prompt, "response", lambda t: t)
+
+    def _chat(self, req: Request) -> Response:
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        messages = body.get("messages") or []
+        if not isinstance(messages, list):
+            return Response(400, {"error": "messages must be a list"})
+        prompt = render_chat_prompt(messages, self.backend)
+        return self._run(body, prompt, "message",
+                         lambda t: {"role": "assistant", "content": t})
+
+    def _tags(self, req: Request) -> Response:
+        return Response(200, {"models": [
+            {"name": m, "model": m, "modified_at": now_rfc3339(),
+             "size": 0, "digest": "", "details": {"family": "p2p-llm-chat-tpu"}}
+            for m in self.backend.models()
+        ]})
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "OllamaServer":
+        self._server = HttpServer(self.router, self.addr_cfg).start()
+        log.info("serve API (%s backend) on %s", self.backend.name, self._server.addr)
+        return self
+
+    @property
+    def url(self) -> str:
+        assert self._server is not None
+        return self._server.url
+
+    def serve_forever(self) -> None:
+        self.start()
+        threading.Event().wait()
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.stop()
+
+
+def main() -> None:
+    """Entry point: serve FakeLLM (real engine wiring arrives with
+    serve.engine; SERVE_BACKEND=fake|tpu selects)."""
+    from .backend import FakeLLM
+    backend_kind = env_or("SERVE_BACKEND", "fake")
+    if backend_kind == "fake":
+        backend: Backend = FakeLLM()
+    else:
+        try:
+            from .engine import build_engine_from_env
+        except ImportError as e:
+            raise SystemExit(f"SERVE_BACKEND={backend_kind} needs serve.engine: {e}")
+        backend = build_engine_from_env()
+    OllamaServer(backend).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

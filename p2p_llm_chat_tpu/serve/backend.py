@@ -1,0 +1,147 @@
+"""Backend interface for the serving stack, plus the FakeLLM test double.
+
+Streaming-first design: a backend accepts a :class:`GenerateRequest` and
+returns an iterator of text deltas. The HTTP front (api.py) either collects
+them (``stream: false`` — what the reference UI sends,
+web/streamlit_app.py:94) or forwards them as NDJSON chunks (``stream: true``,
+Ollama's default). The continuous-batching TPU engine implements this same
+interface, so the whole chat app runs identically against FakeLLM on any
+machine — the pattern SURVEY.md §4 prescribes for testing without hardware.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Iterator, Optional, Protocol, runtime_checkable
+
+
+@dataclass
+class GenerateOptions:
+    """Sampling options (subset of Ollama's ``options`` object)."""
+
+    max_tokens: int = 256           # Ollama: num_predict
+    temperature: float = 0.0        # 0 => greedy
+    top_p: float = 1.0
+    top_k: int = 0                  # 0 => disabled
+    seed: Optional[int] = None
+    stop: tuple[str, ...] = ()
+
+    @classmethod
+    def from_ollama(cls, options: Optional[dict]) -> "GenerateOptions":
+        o = options or {}
+        stop = o.get("stop") or ()
+        if isinstance(stop, str):
+            stop = (stop,)
+        return cls(
+            max_tokens=int(o.get("num_predict", 256)),
+            temperature=float(o.get("temperature", 0.0)),
+            top_p=float(o.get("top_p", 1.0)),
+            top_k=int(o.get("top_k", 0)),
+            seed=o.get("seed"),
+            stop=tuple(stop),
+        )
+
+
+@dataclass
+class GenerateRequest:
+    prompt: str
+    model: str = ""
+    options: GenerateOptions = field(default_factory=GenerateOptions)
+    request_id: str = field(default_factory=lambda: uuid.uuid4().hex)
+    arrival_time: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class RequestStats:
+    """Per-request timing — the north-star metric is p50 TTFT (BASELINE.md),
+    so timing is in-tree from day one (SURVEY.md §5 tracing)."""
+
+    ttft_s: Optional[float] = None        # arrival -> first token
+    total_s: Optional[float] = None
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+
+@runtime_checkable
+class Backend(Protocol):
+    name: str
+
+    def generate_stream(self, req: GenerateRequest,
+                        stats: Optional[RequestStats] = None) -> Iterator[str]:
+        """Yield text deltas for the completion; return when done."""
+        ...
+
+    def models(self) -> list[str]:
+        """Model tags served (for /api/tags)."""
+        ...
+
+
+def collect(backend: Backend, req: GenerateRequest,
+            stats: Optional[RequestStats] = None) -> str:
+    return "".join(backend.generate_stream(req, stats))
+
+
+class FakeLLM:
+    """Canned-response backend.
+
+    Deterministic: replies echo the tail of the prompt so tests can assert
+    content flowed through. Configurable per-token delay lets chat-path tests
+    exercise streaming/timeout behavior. This mirrors the role Ollama
+    unavailability plays in the reference — the UI must degrade gracefully
+    either way (web/streamlit_app.py:99-101).
+    """
+
+    def __init__(self, name: str = "fake-llm", token_delay_s: float = 0.0,
+                 reply_template: str = "Thanks for your message! You said: {tail}") -> None:
+        self.name = name
+        self.token_delay_s = token_delay_s
+        self.reply_template = reply_template
+        self._lock = threading.Lock()
+        self.requests_served = 0
+
+    def _reply_for(self, req: GenerateRequest) -> str:
+        # The UI wraps the peer's message in a fixed template ending in
+        # "Reply:" (web/streamlit_app.py:93), and chat prompts end with an
+        # "assistant:" marker — skip trailing instruction/role lines (anything
+        # ending in ':') and echo the last content line.
+        lines = [ln.strip() for ln in req.prompt.splitlines() if ln.strip()]
+        body = [ln for ln in lines if not ln.endswith(":")]
+        tail = body[-1] if body else ""
+        return self.reply_template.format(tail=tail)
+
+    def generate_stream(self, req: GenerateRequest,
+                        stats: Optional[RequestStats] = None) -> Iterator[str]:
+        with self._lock:
+            self.requests_served += 1
+        text = self._reply_for(req)
+        words = text.split(" ")
+        words = words[: max(1, req.options.max_tokens)]
+        if stats is not None:
+            stats.prompt_tokens = len(req.prompt.split())
+        first = True
+        emitted = ""
+        for i, w in enumerate(words):
+            if self.token_delay_s:
+                time.sleep(self.token_delay_s)
+            delta = w if i == 0 else " " + w
+            if stats is not None:
+                if first:
+                    stats.ttft_s = time.monotonic() - req.arrival_time
+                    first = False
+                stats.completion_tokens += 1
+            emitted += delta
+            for s in req.options.stop:
+                if s and s in emitted:
+                    yield delta[: len(delta) - (len(emitted) - emitted.index(s))]
+                    if stats is not None:
+                        stats.total_s = time.monotonic() - req.arrival_time
+                    return
+            yield delta
+        if stats is not None:
+            stats.total_s = time.monotonic() - req.arrival_time
+
+    def models(self) -> list[str]:
+        return [self.name]

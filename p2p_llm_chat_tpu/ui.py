@@ -1,0 +1,151 @@
+"""Chat web UI with the AI reply co-pilot.
+
+Reference: web/streamlit_app.py — a Streamlit page per user that (a) sends
+messages through its node's ``POST /send``, (b) polls ``GET /inbox`` every
+2 s, (c) renders messenger-style bubbles sorted by timestamp, and (d) runs
+the co-pilot loop: per incoming message, a "Suggest a reply" button calls
+the LLM with a fixed template and an accept button posts the suggestion
+back through /send (streamlit_app.py:161-190).
+
+Streamlit is not in this image, so the equivalent here is self-contained:
+a single-page HTML/JS app served by this tiny process. Behavior parity:
+
+- config via the same env vars: ``NODE_HTTP``, ``OLLAMA_URL``, ``LLM_MODEL``
+  (streamlit_app.py:26-28) + additive ``UI_ADDR``.
+- 2 s inbox poll with ``after=""`` — full-history refetch, the quirk that
+  makes history survive page reloads (SURVEY.md §2).
+- sent messages live only in browser memory (the reference keeps them only
+  in st.session_state — no persistence, streamlit_app.py:34-37).
+- the LLM prompt template is byte-identical to streamlit_app.py:93, the
+  60 s timeout matches :95, and failures degrade to the same placeholder
+  strings "(LLM error)" / "(LLM unavailable: ...)" (:99-101).
+
+The UI server proxies ``/node/*`` to the node and ``/api/suggest`` to the
+LLM so the browser needs no CORS setup.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from importlib import resources
+from typing import Optional
+
+from .utils.env import env_or
+from .utils.http import HttpServer, Request, Response, Router, http_json
+from .utils.log import get_logger
+
+log = get_logger("ui")
+
+# Byte-identical to web/streamlit_app.py:93 — part of the observable LLM
+# contract the new serving stack must reproduce.
+SUGGEST_TEMPLATE = (
+    "You are a helpful assistant. Draft a concise, friendly reply to the "
+    "following message:\n\n{msg}\n\nReply:"
+)
+LLM_TIMEOUT_S = 60.0   # streamlit_app.py:95
+
+
+class ChatUI:
+    def __init__(self, node_http: Optional[str] = None,
+                 ollama_url: Optional[str] = None,
+                 llm_model: Optional[str] = None,
+                 addr: Optional[str] = None) -> None:
+        self.node_http = (node_http if node_http is not None
+                          else env_or("NODE_HTTP", "http://127.0.0.1:8081")).rstrip("/")
+        self.ollama_url = (ollama_url if ollama_url is not None
+                           else env_or("OLLAMA_URL", "http://127.0.0.1:11434")).rstrip("/")
+        self.llm_model = llm_model if llm_model is not None else env_or("LLM_MODEL", "llama3.1")
+        self.addr_cfg = addr if addr is not None else env_or("UI_ADDR", "127.0.0.1:8501")
+        self.router = Router()
+        self.router.add("GET", "/", self._index)
+        self.router.add("GET", "/config.json", lambda r: Response(200, {
+            "node_http": self.node_http, "llm_model": self.llm_model}))
+        self.router.add("POST", "/api/suggest", self._suggest)
+        self.router.add("GET", "/node/me", self._proxy_node_get("/me"))
+        self.router.add("GET", "/node/inbox", self._proxy_node_get("/inbox"))
+        self.router.add("POST", "/node/send", self._proxy_node_post("/send"))
+        self.router.add("GET", "/healthz", lambda r: Response(200, {"status": "ok"}))
+        self._server: Optional[HttpServer] = None
+
+    # -- handlers ------------------------------------------------------------
+
+    def _index(self, req: Request) -> Response:
+        html = (resources.files("p2p_llm_chat_tpu") / "web_static" / "index.html").read_text()
+        return Response(200, html, content_type="text/html; charset=utf-8")
+
+    def _suggest(self, req: Request) -> Response:
+        """ai_suggest (streamlit_app.py:89-101): call the LLM with the fixed
+        template; degrade to placeholder strings on any failure."""
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        content = str(body.get("content") or "")
+        try:
+            status, resp = http_json("POST", f"{self.ollama_url}/api/generate", {
+                "model": self.llm_model,
+                "prompt": SUGGEST_TEMPLATE.format(msg=content),
+                "stream": False,
+            }, timeout=LLM_TIMEOUT_S, raise_for_status=False)
+            if status == 200 and isinstance(resp, dict) and "response" in resp:
+                suggestion = str(resp["response"]).strip()   # :97-98
+            else:
+                suggestion = "(LLM error)"                   # :99
+        except Exception as e:  # noqa: BLE001
+            suggestion = f"(LLM unavailable: {e})"           # :100-101
+        return Response(200, {"suggestion": suggestion})
+
+    def _proxy_node_get(self, path: str):
+        def handler(req: Request) -> Response:
+            q = f"?{urllib.parse.urlencode(req.query)}" if req.query else ""
+            try:
+                status, body = http_json("GET", f"{self.node_http}{path}{q}",
+                                         timeout=5.0, raise_for_status=False)
+            except ConnectionError as e:
+                return Response(502, {"error": str(e)})
+            return Response(status, body)
+        return handler
+
+    def _proxy_node_post(self, path: str):
+        def handler(req: Request) -> Response:
+            try:
+                payload = req.json()
+            except ValueError:
+                return Response(400, {"error": "invalid json"})
+            try:
+                status, body = http_json("POST", f"{self.node_http}{path}", payload,
+                                         timeout=10.0, raise_for_status=False)
+            except ConnectionError as e:
+                return Response(502, {"error": str(e)})
+            return Response(status, body)
+        return handler
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ChatUI":
+        self._server = HttpServer(self.router, self.addr_cfg).start()
+        log.info("chat UI on http://%s (node=%s, llm=%s)",
+                 self._server.addr, self.node_http, self.ollama_url)
+        return self
+
+    @property
+    def url(self) -> str:
+        assert self._server is not None
+        return self._server.url
+
+    def serve_forever(self) -> None:
+        self.start()
+        threading.Event().wait()
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.stop()
+
+
+def main() -> None:
+    ChatUI().serve_forever()
+
+
+if __name__ == "__main__":
+    main()

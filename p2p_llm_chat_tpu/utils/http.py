@@ -18,7 +18,7 @@ import urllib.parse
 import urllib.request
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Iterator, Optional
 
 from .log import get_logger
 
@@ -45,6 +45,9 @@ class Response:
     body: Any = None           # JSON-serialisable, or bytes/str for raw
     content_type: str = "application/json"
     headers: dict[str, str] = field(default_factory=dict)
+    # When set, the response is sent with Transfer-Encoding: chunked, one
+    # chunk per yielded bytes object (used for Ollama-style NDJSON streams).
+    stream: Optional[Iterator[bytes]] = None
 
     def encode(self) -> bytes:
         if self.body is None:
@@ -96,6 +99,8 @@ class HttpServer:
     """Threaded HTTP server wrapping a Router; one thread per request."""
 
     def __init__(self, router: Router, addr: str = "127.0.0.1:0") -> None:
+        if addr.startswith(":"):
+            addr = "127.0.0.1" + addr
         host, _, port = addr.rpartition(":")
         host = host or "127.0.0.1"
         router_ref = router
@@ -116,6 +121,25 @@ class HttpServer:
                     body=body,
                 )
                 resp = router_ref.dispatch(req)
+                if resp.stream is not None:
+                    self.send_response(resp.status)
+                    self.send_header("Content-Type", resp.content_type)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    for k, v in resp.headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    # Terminate the chunked stream ONLY on clean completion:
+                    # a mid-stream failure must look truncated to the client
+                    # (dropped connection), not like a well-formed response.
+                    for chunk in resp.stream:
+                        if not chunk:
+                            continue
+                        self.wfile.write(f"{len(chunk):x}\r\n".encode())
+                        self.wfile.write(chunk)
+                        self.wfile.write(b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                    return
                 payload = resp.encode()
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)
@@ -123,10 +147,10 @@ class HttpServer:
                 for k, v in resp.headers.items():
                     self.send_header(k, v)
                 self.end_headers()
-                if payload:
+                if payload and self.command != "HEAD":
                     self.wfile.write(payload)
 
-            do_GET = do_POST = do_PUT = do_DELETE = _handle
+            do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _handle
 
             def log_message(self, fmt: str, *args: Any) -> None:
                 log.debug("%s %s", self.address_string(), fmt % args)
@@ -143,6 +167,14 @@ class HttpServer:
     @property
     def port(self) -> int:
         return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        """Dialable base URL (wildcard binds rewritten to loopback)."""
+        host, port = self._httpd.server_address[:2]
+        if host in ("0.0.0.0", "::"):
+            host = "127.0.0.1"
+        return f"http://{host}:{port}"
 
     def start(self) -> "HttpServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)

@@ -1,0 +1,132 @@
+#!/usr/bin/env python3
+"""Dev launcher: boot the whole system on one machine.
+
+Reference: start_all.sh — directory + 2 named nodes (Najy, Cannan) + 2 UIs
+with env-var wiring and sleeps (start_all.sh:5-43). This launcher keeps that
+profile and adds the in-tree LLM server (replacing the out-of-tree Ollama
+the reference assumes is already running) and the optional relay:
+
+    directory  :8080      (ADDR)
+    serve      :11434     (SERVE_ADDR; FakeLLM by default, SERVE_BACKEND=tpu
+                           for the real engine)
+    relay      :4100      (RELAY_ADDR; --relay to enable)
+    node Najy  :8081      (HTTP_ADDR)   + UI :8501
+    node Cannan:8082      (HTTP_ADDR)   + UI :8502
+
+All children are this package's modules in subprocesses; Ctrl-C tears the
+whole tree down. ``--wait-ready`` polls health endpoints instead of fixed
+sleeps (the reference uses ``sleep 5``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def wait_http(url: str, timeout: float = 30.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=1):
+                return
+        except Exception:
+            time.sleep(0.25)
+    raise TimeoutError(f"service at {url} not ready after {timeout}s")
+
+
+def spawn(name: str, module: str, env_extra: dict[str, str],
+          procs: list[tuple[str, subprocess.Popen]]) -> subprocess.Popen:
+    env = {**os.environ, **env_extra}
+    p = subprocess.Popen([sys.executable, "-m", module], cwd=REPO_ROOT, env=env)
+    procs.append((name, p))
+    print(f"  started {name} (pid {p.pid})")
+    return p
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--backend", default=os.environ.get("SERVE_BACKEND", "fake"),
+                    help="LLM backend: fake | tpu (default: fake)")
+    ap.add_argument("--relay", action="store_true", help="also start the relay daemon")
+    ap.add_argument("--users", default="Najy,Cannan",
+                    help="comma-separated usernames (default mirrors start_all.sh)")
+    ap.add_argument("--node-port-base", type=int,
+                    default=int(os.environ.get("NODE_PORT_BASE", "8081")),
+                    help="first node HTTP port (default 8081, reference layout)")
+    ap.add_argument("--ui-port-base", type=int,
+                    default=int(os.environ.get("UI_PORT_BASE", "8501")),
+                    help="first UI port (default 8501, reference layout)")
+    args = ap.parse_args()
+
+    users = [u.strip() for u in args.users.split(",") if u.strip()]
+    procs: list[tuple[str, subprocess.Popen]] = []
+
+    def shutdown(*_, exit_code: int = 0):
+        print("\nshutting down...")
+        for name, p in reversed(procs):
+            if p.poll() is None:
+                p.terminate()
+        for _, p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        sys.exit(exit_code)
+
+    signal.signal(signal.SIGINT, shutdown)
+    signal.signal(signal.SIGTERM, shutdown)
+
+    print("🚀 starting p2p-llm-chat-tpu stack")
+    try:
+        spawn("directory", "p2p_llm_chat_tpu.directory", {"ADDR": "127.0.0.1:8080"}, procs)
+        spawn("serve", "p2p_llm_chat_tpu.serve.api",
+              {"SERVE_ADDR": "127.0.0.1:11434", "SERVE_BACKEND": args.backend}, procs)
+        if args.relay:
+            spawn("relay", "p2p_llm_chat_tpu.relay", {"RELAY_ADDR": "127.0.0.1:4100"}, procs)
+        wait_http("http://127.0.0.1:8080/healthz")
+        wait_http("http://127.0.0.1:11434/healthz", timeout=300 if args.backend != "fake" else 30)
+
+        for i, user in enumerate(users):
+            node_port = args.node_port_base + i
+            ui_port = args.ui_port_base + i
+            spawn(f"node-{user}", "p2p_llm_chat_tpu.node", {
+                "MYNAMEIS": user,
+                "HTTP_ADDR": f"127.0.0.1:{node_port}",
+                "DIRECTORY_URL": "http://127.0.0.1:8080",
+            }, procs)
+            wait_http(f"http://127.0.0.1:{node_port}/healthz")
+            spawn(f"ui-{user}", "p2p_llm_chat_tpu.ui", {
+                "NODE_HTTP": f"http://127.0.0.1:{node_port}",
+                "OLLAMA_URL": "http://127.0.0.1:11434",
+                "UI_ADDR": f"127.0.0.1:{ui_port}",
+            }, procs)
+    except Exception as e:  # noqa: BLE001 — never leave orphaned children
+        print(f"❌ startup failed: {e}; cleaning up")
+        shutdown(exit_code=1)
+
+    print("\n✅ all up:")
+    for i, user in enumerate(users):
+        print(f"   {user}: UI http://127.0.0.1:{args.ui_port_base + i}  "
+              f"node http://127.0.0.1:{args.node_port_base + i}")
+    print("   LLM API http://127.0.0.1:11434  directory http://127.0.0.1:8080\n")
+    print("Ctrl-C to stop.")
+
+    while True:
+        for name, p in procs:
+            code = p.poll()
+            if code is not None:
+                print(f"⚠️ {name} exited with {code}; shutting down")
+                shutdown(exit_code=1)
+        time.sleep(1)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

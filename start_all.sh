@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# Dev launcher (reference: start_all.sh) — delegates to the Python launcher,
+# which replaces fixed sleeps with health polling.
+exec python3 "$(dirname "$0")/start_all.py" "$@"

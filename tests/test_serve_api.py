@@ -1,0 +1,104 @@
+"""Contract tests for the Ollama-compatible serve front (SURVEY.md §4:
+golden HTTP tests for /api/generate + /api/chat shapes)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from p2p_llm_chat_tpu.serve import FakeLLM, OllamaServer
+from p2p_llm_chat_tpu.utils.http import http_json
+
+
+@pytest.fixture()
+def server():
+    srv = OllamaServer(FakeLLM(), addr="127.0.0.1:0").start()
+    yield srv
+    srv.stop()
+
+
+# The exact request the reference UI makes (web/streamlit_app.py:91-95).
+REFERENCE_TEMPLATE = (
+    "You are a helpful assistant. Draft a concise, friendly reply to the "
+    "following message:\n\nsee you at noon?\n\nReply:"
+)
+
+
+def test_generate_non_streaming_reference_contract(server):
+    status, body = http_json("POST", f"{server.url}/api/generate", {
+        "model": "llama3.1", "prompt": REFERENCE_TEMPLATE, "stream": False,
+    }, timeout=60)
+    assert status == 200
+    # The UI reads exactly resp.json()["response"] (streamlit_app.py:97-98).
+    assert isinstance(body["response"], str) and body["response"]
+    assert "see you at noon?" in body["response"]
+    assert body["done"] is True
+    # Ollama timing fields present for compatible clients.
+    for k in ("model", "created_at", "total_duration", "eval_count"):
+        assert k in body
+
+
+def test_generate_streaming_ndjson(server):
+    req = urllib.request.Request(
+        f"{server.url}/api/generate",
+        data=json.dumps({"model": "m", "prompt": "hello there\n\nReply:"}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        lines = [json.loads(l) for l in resp.read().decode().splitlines()]
+    assert len(lines) >= 2
+    assert all(not l["done"] for l in lines[:-1])
+    assert lines[-1]["done"] is True
+    text = "".join(l.get("response", "") for l in lines)
+    assert "hello there" in text
+
+
+def test_chat_endpoint(server):
+    status, body = http_json("POST", f"{server.url}/api/chat", {
+        "model": "m",
+        "messages": [{"role": "user", "content": "lunch tomorrow?"}],
+        "stream": False,
+    }, timeout=30)
+    assert status == 200
+    assert body["message"]["role"] == "assistant"
+    assert "lunch tomorrow?" in body["message"]["content"]
+    assert body["done"] is True
+
+
+def test_options_num_predict_limits_tokens(server):
+    status, body = http_json("POST", f"{server.url}/api/generate", {
+        "prompt": "x\n\nReply:", "stream": False,
+        "options": {"num_predict": 2},
+    }, timeout=30)
+    assert status == 200
+    assert body["eval_count"] <= 2
+
+
+def test_tags_and_version_and_root(server):
+    status, tags = http_json("GET", f"{server.url}/api/tags")
+    assert status == 200
+    assert tags["models"][0]["name"] == "fake-llm"
+    status, ver = http_json("GET", f"{server.url}/api/version")
+    assert status == 200 and "version" in ver
+    with urllib.request.urlopen(f"{server.url}/", timeout=5) as resp:
+        assert resp.read() == b"Ollama is running"
+
+
+def test_metrics_exposed_after_requests(server):
+    http_json("POST", f"{server.url}/api/generate",
+              {"prompt": "hi\n\nReply:", "stream": False}, timeout=30)
+    with urllib.request.urlopen(f"{server.url}/metrics", timeout=5) as resp:
+        text = resp.read().decode()
+    assert "serve_requests_total 1.0" in text
+    assert "serve_ttft_seconds" in text
+    assert "serve_completion_tokens_total" in text
+
+
+def test_invalid_json_is_400(server):
+    import urllib.error
+    req = urllib.request.Request(
+        f"{server.url}/api/generate", data=b"{nope",
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 400
