@@ -1,0 +1,23 @@
+"""JAX model definitions for the TPU serving stack.
+
+The reference delegates all modelling to Ollama (SURVEY.md §1 L4); these are
+the in-tree replacements mandated by BASELINE.json's configs: the llama
+family (3.1-8B / 3.1-70B and smaller test sizes) and Mixtral-8x7B MoE.
+
+Design (TPU-first, not a port of any torch code):
+
+- pure-functional: params are nested dicts of ``jax.Array``; forward passes
+  are plain jitted functions. No framework Module state.
+- layers are *stacked* along a leading ``num_layers`` axis and the decoder
+  runs as one ``lax.scan`` — O(1) XLA graph size in depth, fast compiles
+  for 32-80 layer models.
+- every parameter/activation has a logical-axis annotation
+  (parallel/sharding.py) so the same code runs single-chip, tensor-parallel
+  or expert-parallel by switching the mesh.
+- compute in bfloat16 on the MXU, reductions/norms in float32.
+"""
+
+from .configs import ModelConfig, CONFIGS, get_config
+from . import llama
+
+__all__ = ["ModelConfig", "CONFIGS", "get_config", "llama"]

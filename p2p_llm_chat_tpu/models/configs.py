@@ -1,0 +1,140 @@
+"""Model configurations.
+
+Sizes follow the published architectures for the model families named in
+BASELINE.json (llama3.1 tags served via Ollama in the reference —
+README.md:52, web/streamlit_app.py:28 — and Mixtral-8x7B for config 5).
+``tiny``/``tiny-moe`` are test/CI sizes exercising the exact same code.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class RopeScaling:
+    """llama3.1-style NTK-by-parts rope scaling."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    rope_scaling: Optional[RopeScaling] = None
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    # MoE (0 experts => dense MLP)
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    # token ids (llama3 defaults; byte tokenizer overrides)
+    bos_token_id: int = 128000
+    eos_token_ids: tuple[int, ...] = (128001, 128008, 128009)
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def with_(self, **kw) -> "ModelConfig":
+        return replace(self, **kw)
+
+
+_LLAMA31_SCALING = RopeScaling(factor=8.0, low_freq_factor=1.0,
+                               high_freq_factor=4.0, original_max_position=8192)
+
+CONFIGS: dict[str, ModelConfig] = {}
+
+
+def _register(cfg: ModelConfig) -> ModelConfig:
+    CONFIGS[cfg.name] = cfg
+    return cfg
+
+
+# -- llama family ------------------------------------------------------------
+
+_register(ModelConfig(
+    name="llama3.1-8b", vocab_size=128256, hidden_size=4096,
+    intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+    head_dim=128, rope_theta=500000.0, rope_scaling=_LLAMA31_SCALING,
+))
+
+_register(ModelConfig(
+    name="llama3.1-70b", vocab_size=128256, hidden_size=8192,
+    intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+    head_dim=128, rope_theta=500000.0, rope_scaling=_LLAMA31_SCALING,
+))
+
+_register(ModelConfig(
+    name="llama3.2-1b", vocab_size=128256, hidden_size=2048,
+    intermediate_size=8192, num_layers=16, num_heads=32, num_kv_heads=8,
+    head_dim=64, rope_theta=500000.0, rope_scaling=RopeScaling(factor=32.0),
+    tie_embeddings=True,
+))
+
+_register(ModelConfig(
+    name="llama3.2-3b", vocab_size=128256, hidden_size=3072,
+    intermediate_size=8192, num_layers=28, num_heads=24, num_kv_heads=8,
+    head_dim=128, rope_theta=500000.0, rope_scaling=RopeScaling(factor=32.0),
+    tie_embeddings=True,
+))
+
+# -- Mixtral -----------------------------------------------------------------
+
+_register(ModelConfig(
+    name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
+    intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+    head_dim=128, rope_theta=1e6, num_experts=8, num_experts_per_tok=2,
+    bos_token_id=1, eos_token_ids=(2,), max_seq_len=32768,
+))
+
+# -- test sizes (same code paths, CI-sized) ----------------------------------
+
+_register(ModelConfig(
+    name="tiny", vocab_size=512, hidden_size=128, intermediate_size=256,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32, max_seq_len=256,
+    rope_theta=10000.0, bos_token_id=1, eos_token_ids=(2,),
+))
+
+_register(ModelConfig(
+    name="tiny-moe", vocab_size=512, hidden_size=128, intermediate_size=256,
+    num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32, max_seq_len=256,
+    rope_theta=10000.0, num_experts=4, num_experts_per_tok=2,
+    bos_token_id=1, eos_token_ids=(2,),
+))
+
+# ~1B-class dense config used by bench.py on a single v5e chip (fits HBM in
+# bf16 with room for KV cache; same architecture family as the 8B).
+_register(ModelConfig(
+    name="bench-1b", vocab_size=32768, hidden_size=2048,
+    intermediate_size=5632, num_layers=22, num_heads=16, num_kv_heads=8,
+    head_dim=128, max_seq_len=2048, rope_theta=500000.0,
+    bos_token_id=1, eos_token_ids=(2,),
+))
+
+
+def get_config(name: str) -> ModelConfig:
+    try:
+        return CONFIGS[name]
+    except KeyError:
+        raise KeyError(f"unknown model config {name!r}; have {sorted(CONFIGS)}") from None

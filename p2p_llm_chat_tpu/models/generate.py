@@ -1,0 +1,110 @@
+"""Reference generation loops over the dense-cache model.
+
+Two shapes of loop:
+
+- :func:`generate` — host-driven: one jitted prefill + one jitted decode
+  step called from Python. This is the loop shape the continuous-batching
+  engine uses (it must inspect/stream tokens and admit new requests between
+  steps), so it doubles as that engine's correctness oracle.
+- :func:`generate_scan` — fully-compiled ``lax.while_loop`` decode for
+  maximum single-stream throughput (no host round-trip per token); used by
+  benchmarks.
+
+Both stop on EOS or max_new_tokens.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .configs import ModelConfig
+from . import llama
+from .llama import KVCache
+from .sampling import sample
+
+
+def _model_fns(config: ModelConfig, mesh=None):
+    prefill_fn = functools.partial(llama.prefill, config=config, mesh=mesh)
+    decode_fn = functools.partial(llama.decode_step, config=config, mesh=mesh)
+    return prefill_fn, decode_fn
+
+
+def generate(params: dict, config: ModelConfig, prompt: jax.Array,
+             max_new_tokens: int = 64, temperature: float = 0.0,
+             top_k: int = 0, top_p: float = 1.0,
+             seed: int = 0, max_seq: Optional[int] = None,
+             mesh=None,
+             on_token: Optional[Callable[[int], None]] = None) -> list[int]:
+    """Single-sequence host-driven generation. prompt: [S] token ids.
+    Returns generated ids (without the prompt)."""
+    prefill_fn, decode_fn = _model_fns(config, mesh)
+    prefill_j = jax.jit(prefill_fn)
+    decode_j = jax.jit(decode_fn)
+
+    S = prompt.shape[0]
+    max_seq = max_seq or min(config.max_seq_len, S + max_new_tokens + 1)
+    cache = KVCache.create(config, batch=1, max_seq=max_seq,
+                           dtype=params["embed"].dtype)
+    tokens = prompt[None, :]
+    logits, cache = prefill_j(params, tokens=tokens,
+                              prompt_lens=jnp.array([S]), cache=cache)
+    key = jax.random.PRNGKey(seed)
+    last = logits[:, S - 1, :]
+    out: list[int] = []
+    for _ in range(max_new_tokens):
+        key, sub = jax.random.split(key)
+        next_tok = sample(last, sub, temperature, top_k, top_p)
+        tok = int(next_tok[0])
+        if tok in config.eos_token_ids:
+            break
+        out.append(tok)
+        if on_token is not None:
+            on_token(tok)
+        logits, cache = decode_j(params, tokens=next_tok[:, None], cache=cache)
+        last = logits[:, 0, :]
+    return out
+
+
+def generate_scan(params: dict, config: ModelConfig, prompt: jax.Array,
+                  max_new_tokens: int, temperature: float = 0.0,
+                  seed: int = 0, max_seq: Optional[int] = None,
+                  mesh=None) -> jax.Array:
+    """Fully-compiled batch-1 generation: prefill + while_loop of decode
+    steps inside a single jit. Returns [max_new_tokens] ids (padded with the
+    first EOS id after stopping). Greedy when temperature<=0."""
+    S = int(prompt.shape[0])
+    max_seq_ = max_seq or min(config.max_seq_len, S + max_new_tokens + 1)
+    eos = jnp.array(config.eos_token_ids, jnp.int32)
+
+    @jax.jit
+    def run(params, prompt, key):
+        cache = KVCache.create(config, batch=1, max_seq=max_seq_,
+                               dtype=params["embed"].dtype)
+        logits, cache = llama.prefill(params, config, prompt[None, :],
+                                      jnp.array([S]), cache, mesh)
+        last = logits[:, S - 1, :]
+
+        def cond(state):
+            i, _, _, _, done, _ = state
+            return (i < max_new_tokens) & (~done)
+
+        def body(state):
+            i, last, cache, key, done, out = state
+            key, sub = jax.random.split(key)
+            tok = sample(last, sub, temperature)
+            done = jnp.any(tok[0] == eos)
+            out = out.at[i].set(jnp.where(done, eos[0], tok[0]))
+            logits, cache = llama.decode_step(params, config, tok[:, None],
+                                              cache, mesh)
+            return (i + 1, logits[:, 0, :], cache, key, done, out)
+
+        out = jnp.full((max_new_tokens,), eos[0], jnp.int32)
+        state = (jnp.int32(0), last, cache, key, jnp.bool_(False), out)
+        *_, out = jax.lax.while_loop(cond, body, state)
+        return out
+
+    return run(params, prompt, jax.random.PRNGKey(seed))

@@ -1,0 +1,120 @@
+"""Shared transformer layer primitives (functional, TPU-first).
+
+Conventions:
+- activations flow in ``compute_dtype`` (bfloat16 by default — MXU-native);
+  normalisation statistics and attention softmax run in float32.
+- weights are stored as ``[in, out]`` so matmuls are ``x @ w`` (lands on the
+  MXU with the contraction on the last axis, XLA's preferred layout).
+- KV cache layout is ``[batch, max_seq, kv_heads, head_dim]`` — sequential
+  writes at the position axis are contiguous and the decode attention
+  contraction reads it without transposition.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .configs import ModelConfig, RopeScaling
+
+DEFAULT_COMPUTE_DTYPE = jnp.bfloat16
+
+# A large-negative constant for masking that is safe in bf16/f32 softmax.
+NEG_INF = -1e9
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm in float32, result cast back to x.dtype."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_frequencies(config: ModelConfig) -> jax.Array:
+    """Inverse frequencies [head_dim/2], with llama3.1 NTK-by-parts scaling
+    applied when configured."""
+    d = config.head_dim
+    inv_freq = 1.0 / (config.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    s = config.rope_scaling
+    if s is None:
+        return inv_freq
+    # llama3.1 scaling: low-frequency components are slowed by `factor`,
+    # high-frequency kept, a smooth ramp in between.
+    low_wavelen = s.original_max_position / s.low_freq_factor
+    high_wavelen = s.original_max_position / s.high_freq_factor
+    wavelen = 2.0 * jnp.pi / inv_freq
+    scaled = inv_freq / s.factor
+    smooth = (s.original_max_position / wavelen - s.low_freq_factor) / (
+        s.high_freq_factor - s.low_freq_factor)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    blended = (1.0 - smooth) * scaled + smooth * inv_freq
+    return jnp.where(wavelen > low_wavelen, scaled,
+                     jnp.where(wavelen < high_wavelen, inv_freq, blended))
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               inv_freq: jax.Array) -> jax.Array:
+    """Rotate pairs (x[..., :d/2], x[..., d/2:]) by position*freq.
+
+    x: [..., seq, heads, head_dim]; positions: [..., seq] (broadcastable).
+    Uses the half-split convention (HF llama's rotate_half), so HF
+    checkpoints work without permutation.
+    """
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., S, d/2]
+    cos = jnp.cos(angles)[..., :, None, :]   # [..., S, 1, d/2]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """GQA: expand kv heads to query heads. [B,S,Hkv,D] -> [B,S,Hkv*n,D]."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(
+        b, s, h * n_rep, d)
+
+
+def attend(q: jax.Array, k: jax.Array, v: jax.Array,
+           mask: Optional[jax.Array]) -> jax.Array:
+    """Scaled dot-product attention, softmax in f32.
+
+    q: [B,Sq,H,D]; k,v: [B,Skv,H,D]; mask: broadcastable to [B,H,Sq,Skv]
+    (True = attend). Returns [B,Sq,H,D].
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / jnp.sqrt(d).astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
+           w_down: jax.Array) -> jax.Array:
+    """SwiGLU MLP: down(silu(x@gate) * (x@up))."""
+    g = jax.nn.silu(x @ w_gate)
+    u = x @ w_up
+    return (g * u) @ w_down
+
+
+def causal_mask(q_len: int, kv_len: int, q_offset: jax.Array | int) -> jax.Array:
+    """[1,1,Sq,Skv] boolean mask: query i (at absolute pos q_offset+i) may
+    attend kv position j iff j <= q_offset+i."""
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return (kv_pos <= q_pos)[None, None, :, :]
+
+
+def length_mask(kv_len: int, lengths: jax.Array) -> jax.Array:
+    """[B,1,1,Skv] mask limiting attention to the first ``lengths[b]``
+    cache slots (decode path with ragged per-request lengths)."""
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return (kv_pos < lengths[:, None])[:, None, None, :]

@@ -1,0 +1,233 @@
+"""llama-family decoder (3.x dense models) — functional JAX, TPU-first.
+
+Replaces the reference's out-of-tree Ollama llama3.1 backend
+(web/streamlit_app.py:28, README.md:52) with an in-tree implementation.
+Architecture: pre-norm transformer, RMSNorm, RoPE (llama3.1 NTK scaling),
+grouped-query attention, SwiGLU MLP, optionally tied embeddings.
+
+TPU-first choices:
+- layers stacked on a leading axis, decoder body is one ``lax.scan`` —
+  constant-size XLA graph regardless of depth (fast compiles for 80-layer
+  70B), and scan keeps weights resident in HBM with no per-layer dispatch.
+- dense KV cache ``[L, B, max_seq, Hkv, D]`` with ragged per-row lengths;
+  decode writes one slot via a batched scatter and masks by length. (The
+  serving engine swaps this for the paged Pallas cache; this dense path is
+  the reference implementation and the test oracle.)
+- bf16 activations/weights, f32 softmax/norms; one all-reduce per block
+  under tensor parallelism (Megatron layout — see parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..parallel.sharding import LogicalRules, DEFAULT_RULES, constrain
+from .configs import ModelConfig
+from .layers import (
+    DEFAULT_COMPUTE_DTYPE,
+    apply_rope,
+    attend,
+    causal_mask,
+    length_mask,
+    repeat_kv,
+    rms_norm,
+    rope_frequencies,
+    swiglu,
+)
+
+
+class KVCache(NamedTuple):
+    """k/v: [L, B, max_seq, Hkv, D]; lengths: [B] valid slots per row."""
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+
+    @classmethod
+    def create(cls, config: ModelConfig, batch: int, max_seq: int,
+               dtype=DEFAULT_COMPUTE_DTYPE) -> "KVCache":
+        shape = (config.num_layers, batch, max_seq, config.num_kv_heads,
+                 config.head_dim)
+        return cls(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   lengths=jnp.zeros((batch,), jnp.int32))
+
+
+# -- parameters ---------------------------------------------------------------
+
+def init_params(config: ModelConfig, key: jax.Array,
+                dtype=DEFAULT_COMPUTE_DTYPE) -> dict:
+    """Random init (scaled normal). Real weights come from
+    models/weights.py; random init serves tests and synthetic benches."""
+    ks = jax.random.split(key, 10)
+    L, H, E = config.num_layers, config.hidden_size, config.intermediate_size
+    std = H ** -0.5
+
+    def normal(k, shape, scale=std):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    params = {
+        "embed": normal(ks[0], (config.vocab_size, H), scale=1.0),
+        "layers": {
+            "attn_norm": jnp.ones((L, H), dtype),
+            "wq": normal(ks[1], (L, H, config.q_dim)),
+            "wk": normal(ks[2], (L, H, config.kv_dim)),
+            "wv": normal(ks[3], (L, H, config.kv_dim)),
+            "wo": normal(ks[4], (L, config.q_dim, H)),
+            "mlp_norm": jnp.ones((L, H), dtype),
+            "w_gate": normal(ks[5], (L, H, E)),
+            "w_up": normal(ks[6], (L, H, E)),
+            "w_down": normal(ks[7], (L, E, H)),
+        },
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = normal(ks[8], (H, config.vocab_size))
+    return params
+
+
+def param_axes(config: ModelConfig) -> dict:
+    """Logical-axis tree matching init_params (leading layer axis on stacked
+    leaves is unsharded). Feed to parallel.sharding.shard_params."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": (None, "embed"),
+            "wq": (None, "embed", "heads"),
+            "wk": (None, "embed", "kv_heads"),
+            "wv": (None, "embed", "kv_heads"),
+            "wo": (None, "heads", "embed"),
+            "mlp_norm": (None, "embed"),
+            "w_gate": (None, "embed", "mlp"),
+            "w_up": (None, "embed", "mlp"),
+            "w_down": (None, "mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+    if not config.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# -- forward ------------------------------------------------------------------
+
+def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
+           positions: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
+           write_pos: jax.Array, mask: jax.Array,
+           mesh: Optional[Mesh], rules: LogicalRules):
+    """One decoder block against a single layer's cache.
+
+    h: [B,S,H]; cache_k/v: [B,max_seq,Hkv,D]; write_pos: [B,S] absolute slots
+    to write this step's k/v into; mask: [B or 1, 1, S, max_seq].
+    Returns (h, new_cache_k, new_cache_v).
+    """
+    B, S, _ = h.shape
+    n_rep = config.num_heads // config.num_kv_heads
+
+    x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
+    q = (x @ lp["wq"]).reshape(B, S, config.num_heads, config.head_dim)
+    k = (x @ lp["wk"]).reshape(B, S, config.num_kv_heads, config.head_dim)
+    v = (x @ lp["wv"]).reshape(B, S, config.num_kv_heads, config.head_dim)
+    q = constrain(q, mesh, ("batch", None, "act_heads", None), rules)
+    k = constrain(k, mesh, ("batch", None, "act_heads", None), rules)
+
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+
+    # Write this step's k/v into the cache at write_pos (batched scatter;
+    # rows write S consecutive slots).
+    b_idx = jnp.arange(B)[:, None]
+    cache_k = cache_k.at[b_idx, write_pos].set(k)
+    cache_v = cache_v.at[b_idx, write_pos].set(v)
+
+    k_full = repeat_kv(cache_k, n_rep)
+    v_full = repeat_kv(cache_v, n_rep)
+    attn = attend(q, k_full, v_full, mask)          # [B,S,H,D]
+    attn = attn.reshape(B, S, config.q_dim)
+    h = h + constrain(attn @ lp["wo"], mesh, ("batch", None, "act_embed"), rules)
+
+    x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
+    mlp = swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+    h = h + constrain(mlp, mesh, ("batch", None, "act_embed"), rules)
+    return h, cache_k, cache_v
+
+
+def forward(params: dict, config: ModelConfig, tokens: jax.Array,
+            positions: jax.Array, cache: KVCache, mask: jax.Array,
+            mesh: Optional[Mesh] = None,
+            rules: LogicalRules = DEFAULT_RULES) -> tuple[jax.Array, KVCache]:
+    """Shared forward: embed -> scan(blocks) -> norm -> logits.
+
+    tokens/positions: [B,S]; mask: [B or 1,1,S,max_seq] (True = attend);
+    k/v for this step are written at ``positions`` in every layer's cache.
+    Returns (logits [B,S,vocab] f32, updated cache).
+    """
+    # Compute dtype follows the params' dtype (bf16 in production; the HF
+    # parity tests load f32 weights and get f32 compute for tight tolerances).
+    h = params["embed"][tokens]
+    h = constrain(h, mesh, ("batch", None, "act_embed"), rules)
+    inv_freq = rope_frequencies(config)
+
+    def body(h, xs):
+        lp, ck, cv = xs
+        h, ck, cv = _block(h, lp, config, inv_freq, positions, ck, cv,
+                           positions, mask, mesh, rules)
+        return h, (ck, cv)
+
+    h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache.k, cache.v))
+    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    lm_head = (params["embed"].T if config.tie_embeddings
+               else params["lm_head"])
+    logits = (h @ lm_head).astype(jnp.float32)
+    logits = constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
+    return logits, KVCache(new_k, new_v, cache.lengths)
+
+
+def prefill(params: dict, config: ModelConfig, tokens: jax.Array,
+            prompt_lens: jax.Array, cache: KVCache,
+            mesh: Optional[Mesh] = None,
+            rules: LogicalRules = DEFAULT_RULES) -> tuple[jax.Array, KVCache]:
+    """Process right-padded prompts from position 0.
+
+    tokens: [B,S] right-padded; prompt_lens: [B]. Causal masking makes pad
+    slots invisible to real queries (pads sit after the prompt); cache
+    lengths are set to prompt_lens so decode never attends to pad slots.
+    Returns (logits [B,S,vocab], cache).
+    """
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    mask = causal_mask(S, cache.k.shape[2], 0)        # [1,1,S,max_seq]
+    logits, cache = forward(params, config, tokens, positions, cache, mask,
+                            mesh, rules)
+    return logits, cache._replace(lengths=prompt_lens.astype(jnp.int32))
+
+
+def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
+                cache: KVCache, mesh: Optional[Mesh] = None,
+                rules: LogicalRules = DEFAULT_RULES,
+                active: Optional[jax.Array] = None) -> tuple[jax.Array, KVCache]:
+    """One autoregressive step for every row of the batch.
+
+    tokens: [B,1] (this step's input token per row). Each row writes cache
+    slot ``lengths[b]`` and attends to slots [0, lengths[b]]. ``active``
+    ([B] bool) freezes finished/empty rows: their cache and length don't
+    advance (the continuous-batching scheduler keeps dead slots parked).
+    Returns (logits [B,1,vocab], cache with lengths+1 where active).
+    """
+    B = tokens.shape[0]
+    positions = cache.lengths[:, None]                 # [B,1]
+    max_seq = cache.k.shape[2]
+    mask = length_mask(max_seq, cache.lengths + 1)     # include slot being written
+    if active is not None:
+        # Parked rows: write into their current slot is avoided by masking
+        # the scatter via an out-of-range index trick is fragile; instead we
+        # let the write happen and roll lengths back, so the slot is simply
+        # overwritten again later. Correct because attention masks by length.
+        pass
+    logits, cache = forward(params, config, tokens, positions, cache, mask,
+                            mesh, rules)
+    inc = jnp.ones_like(cache.lengths) if active is None else active.astype(jnp.int32)
+    return logits, cache._replace(lengths=cache.lengths + inc)

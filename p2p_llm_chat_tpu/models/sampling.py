@@ -1,0 +1,51 @@
+"""Token sampling: greedy, temperature, top-k, top-p — jit-friendly.
+
+All functions take f32 logits [B, vocab] and return token ids [B]. The
+option set mirrors what the Ollama contract exposes via ``options``
+(serve/backend.py GenerateOptions), so server-side sampling is a drop-in
+for what the reference delegated to Ollama.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .layers import NEG_INF
+
+
+def greedy(logits: jax.Array) -> jax.Array:
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _apply_top_k(logits: jax.Array, top_k: int) -> jax.Array:
+    if top_k <= 0:
+        return logits
+    k = min(top_k, logits.shape[-1])
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def _apply_top_p(logits: jax.Array, top_p: float) -> jax.Array:
+    if top_p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep the smallest prefix with cumulative prob >= top_p (always >= 1 tok).
+    keep = cum - probs < top_p
+    threshold = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                        keepdims=True)
+    return jnp.where(logits < threshold, NEG_INF, logits)
+
+
+def sample(logits: jax.Array, key: jax.Array, temperature: float = 0.0,
+           top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+    """Sample next tokens. temperature<=0 means greedy (matching Ollama's
+    deterministic mode)."""
+    if temperature <= 0.0:
+        return greedy(logits)
+    logits = logits / jnp.asarray(temperature, logits.dtype)
+    logits = _apply_top_k(logits, top_k)
+    logits = _apply_top_p(logits, top_p)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,191 @@
+"""Checkpoint loading: HF-format safetensors -> our stacked param trees.
+
+The reference pulls model weights out-of-tree via ``ollama pull``
+(README.md:62-70); the in-tree equivalent reads HuggingFace-layout
+checkpoints (config.json + *.safetensors) from local disk and materialises
+them directly into (optionally sharded) ``jax.Array``s.
+
+Key transforms vs the HF torch layout:
+- torch ``nn.Linear`` stores ``[out, in]`` and computes ``x @ W.T``; we
+  store ``[in, out]`` — so every projection is transposed on load.
+- per-layer tensors are stacked along a leading ``num_layers`` axis to
+  match the lax.scan decoder (models/llama.py).
+- with a mesh, each stacked tensor is device_put with its logical-axis
+  sharding, so a 70B checkpoint never needs to fit on one chip
+  (BASELINE.json config 4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from ..utils.log import get_logger
+from ..parallel.sharding import LogicalRules, DEFAULT_RULES, spec_for
+from .configs import CONFIGS, ModelConfig, RopeScaling
+
+log = get_logger("weights")
+
+
+# -- HF name mapping ----------------------------------------------------------
+
+def _dense_layer_map(i: int) -> dict[str, tuple[str, bool]]:
+    """our layer key -> (HF tensor name, transpose?)."""
+    p = f"model.layers.{i}"
+    return {
+        "attn_norm": (f"{p}.input_layernorm.weight", False),
+        "wq": (f"{p}.self_attn.q_proj.weight", True),
+        "wk": (f"{p}.self_attn.k_proj.weight", True),
+        "wv": (f"{p}.self_attn.v_proj.weight", True),
+        "wo": (f"{p}.self_attn.o_proj.weight", True),
+        "mlp_norm": (f"{p}.post_attention_layernorm.weight", False),
+        "w_gate": (f"{p}.mlp.gate_proj.weight", True),
+        "w_up": (f"{p}.mlp.up_proj.weight", True),
+        "w_down": (f"{p}.mlp.down_proj.weight", True),
+    }
+
+
+def _moe_layer_map(i: int, num_experts: int) -> dict[str, Any]:
+    """Mixtral layout: experts w1 (gate), w3 (up), w2 (down) + router gate."""
+    p = f"model.layers.{i}"
+    m: dict[str, Any] = {
+        "attn_norm": (f"{p}.input_layernorm.weight", False),
+        "wq": (f"{p}.self_attn.q_proj.weight", True),
+        "wk": (f"{p}.self_attn.k_proj.weight", True),
+        "wv": (f"{p}.self_attn.v_proj.weight", True),
+        "wo": (f"{p}.self_attn.o_proj.weight", True),
+        "mlp_norm": (f"{p}.post_attention_layernorm.weight", False),
+        "router": (f"{p}.block_sparse_moe.gate.weight", True),
+        "w_gate": [(f"{p}.block_sparse_moe.experts.{e}.w1.weight", True)
+                   for e in range(num_experts)],
+        "w_up": [(f"{p}.block_sparse_moe.experts.{e}.w3.weight", True)
+                 for e in range(num_experts)],
+        "w_down": [(f"{p}.block_sparse_moe.experts.{e}.w2.weight", True)
+                   for e in range(num_experts)],
+    }
+    return m
+
+
+def convert_hf_state_dict(state: dict[str, np.ndarray], config: ModelConfig,
+                          dtype=jnp.bfloat16) -> dict:
+    """Convert a flat HF state dict (numpy arrays) into our stacked tree.
+    Test-oracle path (used by the parity tests); load_checkpoint below is
+    the production path over safetensors files."""
+    def get(name: str, transpose: bool) -> np.ndarray:
+        t = state[name]
+        return np.ascontiguousarray(t.T) if transpose else t
+
+    L = config.num_layers
+    layers: dict[str, Any] = {}
+    maps = [( _moe_layer_map(i, config.num_experts) if config.is_moe
+              else _dense_layer_map(i)) for i in range(L)]
+    for key in maps[0]:
+        per_layer = []
+        for i in range(L):
+            spec = maps[i][key]
+            if isinstance(spec, list):   # per-expert stack
+                per_layer.append(np.stack([get(n, t) for n, t in spec]))
+            else:
+                per_layer.append(get(*spec))
+        layers[key] = jnp.asarray(np.stack(per_layer), dtype)
+
+    params: dict[str, Any] = {
+        "embed": jnp.asarray(state["model.embed_tokens.weight"], dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(state["model.norm.weight"], dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = jnp.asarray(
+            np.ascontiguousarray(state["lm_head.weight"].T), dtype)
+    return params
+
+
+# -- safetensors checkpoint directory loading --------------------------------
+
+def config_from_hf_json(path: str) -> ModelConfig:
+    """Derive a ModelConfig from an HF config.json (llama/mixtral families)."""
+    with open(path) as f:
+        hf = json.load(f)
+    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    rope_scaling = None
+    rs = hf.get("rope_scaling")
+    if rs and rs.get("rope_type", rs.get("type")) == "llama3":
+        rope_scaling = RopeScaling(
+            factor=float(rs.get("factor", 8.0)),
+            low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+            high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+            original_max_position=int(rs.get("original_max_position_embeddings", 8192)),
+        )
+    num_heads = int(hf["num_attention_heads"])
+    eos = hf.get("eos_token_id", 2)
+    eos_ids = tuple(eos) if isinstance(eos, list) else (int(eos),)
+    return ModelConfig(
+        name=hf.get("_name_or_path", "hf-model"),
+        vocab_size=int(hf["vocab_size"]),
+        hidden_size=int(hf["hidden_size"]),
+        intermediate_size=int(hf["intermediate_size"]),
+        num_layers=int(hf["num_hidden_layers"]),
+        num_heads=num_heads,
+        num_kv_heads=int(hf.get("num_key_value_heads", num_heads)),
+        head_dim=int(hf.get("head_dim", hf["hidden_size"] // num_heads)),
+        max_seq_len=int(hf.get("max_position_embeddings", 8192)),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        num_experts=int(hf.get("num_local_experts", 0)),
+        num_experts_per_tok=int(hf.get("num_experts_per_tok", 0)),
+        bos_token_id=int(hf.get("bos_token_id", 1)),
+        eos_token_ids=eos_ids,
+    )
+
+
+def load_checkpoint(ckpt_dir: str, config: Optional[ModelConfig] = None,
+                    mesh: Optional[Mesh] = None,
+                    rules: LogicalRules = DEFAULT_RULES,
+                    dtype=jnp.bfloat16,
+                    param_axes_fn: Optional[Callable[[ModelConfig], dict]] = None,
+                    ) -> tuple[dict, ModelConfig]:
+    """Load an HF-layout checkpoint directory into a (sharded) param tree.
+
+    Reads every ``*.safetensors`` shard, converts/stacks, and — when a mesh
+    is given — places each tensor with its logical sharding so per-host
+    memory stays bounded by the shard size, not the model size.
+    """
+    from safetensors import safe_open
+
+    if config is None:
+        config = config_from_hf_json(os.path.join(ckpt_dir, "config.json"))
+
+    state: dict[str, np.ndarray] = {}
+    shards = sorted(f for f in os.listdir(ckpt_dir) if f.endswith(".safetensors"))
+    if not shards:
+        raise FileNotFoundError(f"no .safetensors files in {ckpt_dir}")
+    for shard in shards:
+        with safe_open(os.path.join(ckpt_dir, shard), framework="numpy") as f:
+            for name in f.keys():
+                state[name] = f.get_tensor(name)
+        log.info("read shard %s (%d tensors total)", shard, len(state))
+
+    params = convert_hf_state_dict(state, config, dtype)
+    if mesh is not None:
+        if param_axes_fn is None:
+            from . import llama as _llama
+            from . import mixtral as _mixtral
+            param_axes_fn = (_mixtral.param_axes if config.is_moe
+                             else _llama.param_axes)
+        axes = param_axes_fn(config)
+        params = jax.tree.map(
+            lambda x, a: jax.device_put(x, NamedSharding(mesh, spec_for(a, rules))),
+            params, axes,
+            is_leaf=lambda x: isinstance(x, jax.Array),
+        )
+    log.info("loaded %s: %.2fB params", config.name,
+             sum(x.size for x in jax.tree.leaves(params)) / 1e9)
+    return params, config

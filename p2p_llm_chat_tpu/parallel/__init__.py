@@ -1,0 +1,24 @@
+"""Device mesh, sharding rules, and collectives — the TPU-native 'comms
+backend' (SURVEY.md §2 parallelism checklist, §5 distributed-communication).
+
+The reference has no distributed ML machinery; its comms are libp2p streams
+and HTTP. Here, intra-slice parallelism is expressed the XLA way: a
+:class:`jax.sharding.Mesh` over the chips, logical-axis sharding rules
+binding parameter/activation axes to mesh axes, and XLA-inserted collectives
+(psum / all-gather / reduce-scatter / ppermute) over ICI — no NCCL/MPI
+equivalent is written by hand. DCN-scale (multi-host) uses the same
+mechanism: JAX global meshes span hosts transparently.
+
+- :mod:`mesh`      — mesh construction (dp/tp/ep/sp axes) and config
+- :mod:`sharding`  — logical-axis rules -> PartitionSpecs for params and
+                     activations (tensor parallel for dense models, expert
+                     parallel for MoE, sequence/context parallel hooks)
+- :mod:`ring`      — ring attention over sequence-parallel shards (ppermute
+                     over ICI) for long-context
+"""
+
+from .mesh import MeshConfig, make_mesh, local_mesh
+from .sharding import LogicalRules, DEFAULT_RULES, spec_for, shard_params
+
+__all__ = ["MeshConfig", "make_mesh", "local_mesh", "LogicalRules",
+           "DEFAULT_RULES", "spec_for", "shard_params"]

@@ -1,0 +1,106 @@
+"""Logical-axis sharding rules -> PartitionSpecs.
+
+Model code names parameter/activation dimensions with *logical* axes
+("embed", "heads", "mlp", "vocab", "experts", ...); this module binds them
+to mesh axes ("dp", "ep", "sp", "tp"). Changing the parallelism layout =
+changing the rule table, not the model. This is the standard scalable-JAX
+recipe (mesh -> annotate -> let XLA insert collectives) — the TPU-native
+replacement for hand-written NCCL calls (SURVEY.md §5).
+
+Tensor-parallel layout for llama-family (Megatron-style, one psum per
+block, scoped by BASELINE.json config 4):
+
+- attention: q/k/v projections column-sharded over heads ("heads"/"kv_heads"
+  -> tp), output projection row-sharded ("heads" input dim -> tp) => one
+  all-reduce after o_proj.
+- MLP: gate/up column-sharded ("mlp" -> tp), down row-sharded => one
+  all-reduce after down.
+- embeddings/lm_head sharded over "vocab" -> tp.
+- MoE (config 5): experts sharded over "experts" -> ("ep","tp") so an
+  8-expert model on 8 chips keeps exactly one expert's weights per chip.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# logical axis -> mesh axis (or None = replicated). A logical axis may map to
+# a tuple of mesh axes (sharded over their product).
+LogicalRules = dict[str, Any]
+
+DEFAULT_RULES: LogicalRules = {
+    # weights
+    "embed": None,            # hidden dim of residual stream — replicated
+    "heads": "tp",            # query heads
+    "kv_heads": "tp",         # kv heads (GQA)
+    "head_dim": None,
+    "mlp": "tp",              # ffn intermediate
+    "vocab": "tp",            # embedding/lm_head vocab dim
+    "experts": ("ep", "tp"),  # MoE expert dim
+    "expert_mlp": None,       # per-expert ffn intermediate (already sharded
+                              # over experts; keep dense within an expert)
+    # activations
+    "batch": "dp",
+    "seq": "sp",              # sequence/context parallel shards
+    "act_heads": "tp",
+    "act_embed": None,
+    "act_mlp": "tp",
+    "act_vocab": "tp",
+    "kv_seq": None,           # kv-cache length axis — replicated under TP
+}
+
+
+def spec_for(logical_axes: tuple[Optional[str], ...],
+             rules: LogicalRules = DEFAULT_RULES) -> P:
+    """PartitionSpec for a tensor whose dims carry these logical names."""
+    out = []
+    for ax in logical_axes:
+        if ax is None:
+            out.append(None)
+        else:
+            if ax not in rules:
+                raise KeyError(f"unknown logical axis {ax!r}")
+            out.append(rules[ax])
+    # Trim trailing Nones (canonical PartitionSpec form).
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def sharding_for(mesh: Mesh, logical_axes: tuple[Optional[str], ...],
+                 rules: LogicalRules = DEFAULT_RULES) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(logical_axes, rules))
+
+
+def tree_specs(axes_tree: Any, rules: LogicalRules = DEFAULT_RULES) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: spec_for(axes, rules), axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x),
+    )
+
+
+def shard_params(params: Any, axes_tree: Any, mesh: Mesh,
+                 rules: LogicalRules = DEFAULT_RULES) -> Any:
+    """Device-put a param pytree with shardings derived from its axes tree."""
+    specs = tree_specs(axes_tree, rules)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params, specs,
+    )
+
+
+def constrain(x: jax.Array, mesh: Optional[Mesh],
+              logical_axes: tuple[Optional[str], ...],
+              rules: LogicalRules = DEFAULT_RULES) -> jax.Array:
+    """In-jit activation sharding hint; no-op when mesh is None (single
+    device / testing)."""
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, spec_for(logical_axes, rules)))

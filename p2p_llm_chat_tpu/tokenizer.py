@@ -1,0 +1,197 @@
+"""Tokenizers for the serving stack.
+
+The reference has no tokenizer at all — tokenization happens inside the
+out-of-tree Ollama server (SURVEY.md §5 long-context note). In-tree we
+provide:
+
+- :class:`BPETokenizer` — a from-scratch byte-level BPE implementation that
+  reads HuggingFace ``tokenizer.json`` files (the format llama3/Mixtral
+  checkpoints ship with): vocab + ranked merges, GPT-2 byte<->unicode
+  mapping, regex pre-tokenization, added special tokens.
+- :class:`ByteTokenizer` — a dependency-free fallback (UTF-8 bytes +
+  specials) used by tests, FakeLLM-adjacent flows, and synthetic benches so
+  the entire stack runs with no tokenizer artifacts on disk.
+
+``load_tokenizer`` picks BPE when a checkpoint directory has tokenizer
+files, else bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from functools import lru_cache
+from typing import Optional, Protocol, runtime_checkable
+
+
+@runtime_checkable
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: int
+    eos_id: int
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+# ---------------------------------------------------------------------------
+# Byte fallback
+# ---------------------------------------------------------------------------
+
+class ByteTokenizer:
+    """UTF-8 bytes as ids 0..255; bos=256, eos=257, pad=258."""
+
+    def __init__(self, vocab_size: int = 512) -> None:
+        if vocab_size < 259:
+            raise ValueError("byte tokenizer needs vocab_size >= 259")
+        self.vocab_size = vocab_size
+        self.bos_id = 256
+        self.eos_id = 257
+        self.pad_id = 258
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", "replace")
+
+
+# ---------------------------------------------------------------------------
+# Byte-level BPE (HF tokenizer.json)
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=1)
+def _byte_to_unicode() -> dict[int, str]:
+    """GPT-2's reversible byte<->printable-unicode mapping."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+# llama3's pre-tokenization regex (tiktoken cl100k-style), expressed for
+# Python's `re` (no possessive quantifiers; (?i:...) works).
+_PRETOKEN_RE = re.compile(
+    r"(?i:'s|'t|'re|'ve|'m|'ll|'d)"
+    r"|[^\r\n\w]?\w+"
+    r"|\d{1,3}"
+    r"| ?[^\s\w\d]+[\r\n]*"
+    r"|\s*[\r\n]+"
+    r"|\s+(?!\S)"
+    r"|\s+"
+)
+
+
+class BPETokenizer:
+    def __init__(self, vocab: dict[str, int], merges: list[tuple[str, str]],
+                 special_tokens: Optional[dict[str, int]] = None,
+                 bos_token: str = "<|begin_of_text|>",
+                 eos_tokens: tuple[str, ...] = ("<|end_of_text|>", "<|eot_id|>")):
+        self._vocab = vocab
+        self._inv_vocab = {v: k for k, v in vocab.items()}
+        self._ranks = {pair: i for i, pair in enumerate(merges)}
+        self._special = dict(special_tokens or {})
+        self._inv_special = {v: k for k, v in self._special.items()}
+        self._b2u = _byte_to_unicode()
+        self._u2b = {v: k for k, v in self._b2u.items()}
+        self.vocab_size = max(
+            [max(vocab.values(), default=-1)] + list(self._special.values())) + 1
+        self.bos_id = self._special.get(bos_token, 0)
+        self.eos_id = next((self._special[t] for t in eos_tokens
+                            if t in self._special), 0)
+        if self._special:
+            self._special_re = re.compile(
+                "(" + "|".join(re.escape(t) for t in
+                               sorted(self._special, key=len, reverse=True)) + ")")
+        else:
+            self._special_re = None
+
+    # -- loading -------------------------------------------------------------
+
+    @classmethod
+    def from_file(cls, path: str) -> "BPETokenizer":
+        with open(path, encoding="utf-8") as f:
+            tj = json.load(f)
+        model = tj["model"]
+        vocab = model["vocab"]
+        merges_raw = model["merges"]
+        merges = [tuple(m.split(" ", 1)) if isinstance(m, str) else tuple(m)
+                  for m in merges_raw]
+        specials = {t["content"]: t["id"] for t in tj.get("added_tokens", [])}
+        return cls(vocab, merges, specials)
+
+    # -- bpe core ------------------------------------------------------------
+
+    def _bpe(self, token: str) -> list[int]:
+        parts = list(token)
+        if len(parts) == 1:
+            return [self._vocab[token]] if token in self._vocab else []
+        while len(parts) > 1:
+            best_rank, best_i = None, -1
+            for i in range(len(parts) - 1):
+                r = self._ranks.get((parts[i], parts[i + 1]))
+                if r is not None and (best_rank is None or r < best_rank):
+                    best_rank, best_i = r, i
+            if best_rank is None:
+                break
+            parts[best_i:best_i + 2] = [parts[best_i] + parts[best_i + 1]]
+        out = []
+        for p in parts:
+            if p in self._vocab:
+                out.append(self._vocab[p])
+            else:
+                # Unknown fragment: fall back to per-character lookup.
+                out.extend(self._vocab[c] for c in p if c in self._vocab)
+        return out
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids: list[int] = [self.bos_id] if add_bos else []
+        chunks = (self._special_re.split(text) if self._special_re else [text])
+        for chunk in chunks:
+            if not chunk:
+                continue
+            if chunk in self._special:
+                ids.append(self._special[chunk])
+                continue
+            for piece in _PRETOKEN_RE.findall(chunk):
+                mapped = "".join(self._b2u[b] for b in piece.encode("utf-8"))
+                ids.extend(self._bpe(mapped))
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        out_bytes = bytearray()
+        for i in ids:
+            if i in self._inv_special:
+                out_bytes += self._inv_special[i].encode("utf-8")
+                continue
+            tok = self._inv_vocab.get(i)
+            if tok is None:
+                continue
+            for ch in tok:
+                b = self._u2b.get(ch)
+                if b is not None:
+                    out_bytes.append(b)
+                else:
+                    out_bytes += ch.encode("utf-8")
+        return out_bytes.decode("utf-8", "replace")
+
+
+# ---------------------------------------------------------------------------
+
+def load_tokenizer(ckpt_dir: Optional[str], vocab_size: int = 512) -> Tokenizer:
+    """BPE from <ckpt_dir>/tokenizer.json when present; byte fallback
+    otherwise (the no-artifacts path tests and synthetic benches use)."""
+    if ckpt_dir:
+        tj = os.path.join(ckpt_dir, "tokenizer.json")
+        if os.path.exists(tj):
+            return BPETokenizer.from_file(tj)
+    return ByteTokenizer(vocab_size=vocab_size)

@@ -9,6 +9,9 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The CPU backend's default matmul precision is bf16-class (observed 6e-2
+# error on f32 matmuls); parity/equivalence tests need true f32 accumulation.
+os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

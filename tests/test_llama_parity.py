@@ -1,0 +1,166 @@
+"""Numerical parity vs HuggingFace transformers LlamaForCausalLM (torch CPU).
+
+The strongest available correctness oracle without downloadable weights:
+build a tiny HF llama with random weights, convert its state dict through
+models/weights.py, and require our prefill/decode logits to match HF's to
+float32 tolerance. Covers RMSNorm, RoPE (plain + llama3.1 scaling), GQA,
+SwiGLU, tied/untied embeddings, and the KV-cache decode path.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from p2p_llm_chat_tpu.models import llama
+from p2p_llm_chat_tpu.models.configs import ModelConfig, RopeScaling
+from p2p_llm_chat_tpu.models.llama import KVCache
+from p2p_llm_chat_tpu.models.weights import convert_hf_state_dict
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def make_hf_model(tie=False, llama3_rope=False, vocab=128, hidden=64,
+                  layers=2, heads=4, kv_heads=2):
+    kw = {}
+    if llama3_rope:
+        kw["rope_scaling"] = {
+            "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 64,
+        }
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=hidden * 2,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        num_key_value_heads=kv_heads, max_position_embeddings=256,
+        rope_theta=10000.0, rms_norm_eps=1e-5, tie_word_embeddings=tie,
+        attention_bias=False, mlp_bias=False, **kw,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    our_cfg = ModelConfig(
+        name="tiny-parity", vocab_size=vocab, hidden_size=hidden,
+        intermediate_size=hidden * 2, num_layers=layers, num_heads=heads,
+        num_kv_heads=kv_heads, head_dim=hidden // heads, max_seq_len=256,
+        rope_theta=10000.0,
+        rope_scaling=RopeScaling(8.0, 1.0, 4.0, 64) if llama3_rope else None,
+        tie_embeddings=tie, bos_token_id=1, eos_token_ids=(2,),
+    )
+    return model, our_cfg
+
+
+def hf_logits(model, tokens: np.ndarray) -> np.ndarray:
+    with torch.no_grad():
+        out = model(torch.from_numpy(tokens))
+    return out.logits.float().numpy()
+
+
+def our_params(model, cfg):
+    state = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    if cfg.tie_embeddings:
+        state.pop("lm_head.weight", None)
+    return convert_hf_state_dict(state, cfg, dtype=jnp.float32)
+
+
+@pytest.mark.parametrize("tie,llama3_rope", [(False, False), (True, False),
+                                             (False, True)])
+def test_prefill_logits_match_hf(tie, llama3_rope):
+    model, cfg = make_hf_model(tie=tie, llama3_rope=llama3_rope)
+    params = our_params(model, cfg)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 12)).astype(np.int32)
+
+    ref = hf_logits(model, tokens)
+    cache = KVCache.create(cfg, batch=2, max_seq=32, dtype=jnp.float32)
+    ours, _ = llama.prefill(params, cfg, jnp.asarray(tokens),
+                            jnp.array([12, 12]), cache)
+    ours = np.asarray(ours)
+    # f32 tolerance is bounded by precision-policy differences (HF computes
+    # rope/norms in f32 regardless of dtype; verified 1.7e-5 max diff at
+    # f64). The strict semantic check is argmax agreement at every position.
+    np.testing.assert_allclose(ours, ref, atol=5e-3, rtol=2e-2)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
+def test_decode_matches_prefill():
+    """Token-by-token decode through the KV cache must reproduce the full
+    prefill logits (the cache path is what serving uses)."""
+    model, cfg = make_hf_model()
+    params = our_params(model, cfg)
+    rng = np.random.default_rng(1)
+    S = 10
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, S)).astype(np.int32)
+
+    cache = KVCache.create(cfg, batch=1, max_seq=32, dtype=jnp.float32)
+    full_logits, _ = llama.prefill(params, cfg, jnp.asarray(tokens),
+                                   jnp.array([S]), cache)
+
+    cache = KVCache.create(cfg, batch=1, max_seq=32, dtype=jnp.float32)
+    logits0, cache = llama.prefill(params, cfg, jnp.asarray(tokens[:, :1]),
+                                   jnp.array([1]), cache)
+    step_logits = [np.asarray(logits0[:, 0])]
+    for t in range(1, S):
+        lg, cache = llama.decode_step(params, cfg,
+                                      jnp.asarray(tokens[:, t:t + 1]), cache)
+        step_logits.append(np.asarray(lg[:, 0]))
+    stepwise = np.stack(step_logits, axis=1)
+    np.testing.assert_allclose(stepwise, np.asarray(full_logits),
+                               atol=2e-4, rtol=2e-3)
+    assert int(cache.lengths[0]) == S
+
+
+def test_padded_prefill_rows_are_independent():
+    """Right-padded rows must produce identical logits to unpadded runs."""
+    model, cfg = make_hf_model()
+    params = our_params(model, cfg)
+    rng = np.random.default_rng(2)
+    a = rng.integers(0, cfg.vocab_size, size=(1, 5)).astype(np.int32)
+    b = rng.integers(0, cfg.vocab_size, size=(1, 9)).astype(np.int32)
+
+    # Batch with padding.
+    batch = np.zeros((2, 9), np.int32)
+    batch[0, :5] = a[0]
+    batch[1] = b[0]
+    cache = KVCache.create(cfg, batch=2, max_seq=32, dtype=jnp.float32)
+    logits, cache2 = llama.prefill(params, cfg, jnp.asarray(batch),
+                                   jnp.array([5, 9]), cache)
+
+    cache_a = KVCache.create(cfg, batch=1, max_seq=32, dtype=jnp.float32)
+    solo_a, _ = llama.prefill(params, cfg, jnp.asarray(a), jnp.array([5]), cache_a)
+    np.testing.assert_allclose(np.asarray(logits[0, :5]),
+                               np.asarray(solo_a[0]), atol=2e-4, rtol=2e-3)
+    assert list(np.asarray(cache2.lengths)) == [5, 9]
+
+
+def test_generate_greedy_matches_hf():
+    model, cfg = make_hf_model()
+    params = our_params(model, cfg)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(3, cfg.vocab_size, size=(6,)).astype(np.int32)
+
+    with torch.no_grad():
+        hf_out = model.generate(
+            torch.from_numpy(prompt[None]), max_new_tokens=8, do_sample=False,
+            eos_token_id=2, pad_token_id=0)
+    hf_new = hf_out[0, 6:].numpy().tolist()
+    # HF may stop early at EOS and pad; trim after first EOS.
+    if 2 in hf_new:
+        hf_new = hf_new[: hf_new.index(2)]
+
+    from p2p_llm_chat_tpu.models.generate import generate
+    ours = generate(params, cfg, jnp.asarray(prompt), max_new_tokens=8)
+    assert ours == hf_new
+
+
+def test_generate_scan_matches_host_loop():
+    model, cfg = make_hf_model()
+    params = our_params(model, cfg)
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(3, cfg.vocab_size, size=(6,)).astype(np.int32)
+
+    from p2p_llm_chat_tpu.models.generate import generate, generate_scan
+    host = generate(params, cfg, jnp.asarray(prompt), max_new_tokens=8)
+    compiled = np.asarray(generate_scan(params, cfg, jnp.asarray(prompt),
+                                        max_new_tokens=8)).tolist()
+    trimmed = compiled[: compiled.index(2)] if 2 in compiled else compiled
+    assert trimmed == host
