@@ -7,8 +7,9 @@ exactly, including the deliberate quirks documented in SURVEY.md §2:
   of the process and repeated polls with ``after=""`` return everything —
   this is what makes chat history survive UI reloads in the reference.
 - ``drain(after)`` with a non-empty ``after``: linear scan for the matching
-  message ID, return the suffix strictly after it; unknown ID returns the
-  full list (same as the reference's fall-through at main.go:116-127).
+  message ID, return the suffix strictly after it; an unknown ID returns the
+  EMPTY list (main.go:116-127: ``found`` never flips, ``out`` stays empty) —
+  a client polling with a stale cursor gets nothing, not duplicate history.
 """
 
 from __future__ import annotations
@@ -41,7 +42,7 @@ class Inbox:
             for i, m in enumerate(self._msgs):
                 if m.id == after:
                     return list(self._msgs[i + 1:])
-            return list(self._msgs)
+            return []
 
     def __len__(self) -> int:
         with self._mu:

@@ -212,21 +212,24 @@ def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
     """One autoregressive step for every row of the batch.
 
     tokens: [B,1] (this step's input token per row). Each row writes cache
-    slot ``lengths[b]`` and attends to slots [0, lengths[b]]. ``active``
-    ([B] bool) freezes finished/empty rows: their cache and length don't
-    advance (the continuous-batching scheduler keeps dead slots parked).
+    slot ``lengths[b]`` and attends to slots [0, lengths[b]].
+
+    ``active`` ([B] bool) parks finished/empty rows for the
+    continuous-batching scheduler (serve/scheduler.py): a parked row's
+    length does NOT advance, so the step is a no-op for it by the
+    overwrite-before-trust invariant — the row still scatters this step's
+    (garbage) k/v into slot ``lengths[b]``, but since its length is
+    unchanged, the next step that matters for that row writes the same
+    slot again before anything attends to it as history. Parked rows'
+    logits are garbage and must be ignored by the caller. Rows never read
+    or write any other row's slots, so parked rows cannot corrupt active
+    ones.
+
     Returns (logits [B,1,vocab], cache with lengths+1 where active).
     """
-    B = tokens.shape[0]
     positions = cache.lengths[:, None]                 # [B,1]
     max_seq = cache.k.shape[2]
     mask = length_mask(max_seq, cache.lengths + 1)     # include slot being written
-    if active is not None:
-        # Parked rows: write into their current slot is avoided by masking
-        # the scatter via an out-of-range index trick is fragile; instead we
-        # let the write happen and roll lengths back, so the slot is simply
-        # overwritten again later. Correct because attention masks by length.
-        pass
     logits, cache = forward(params, config, tokens, positions, cache, mask,
                             mesh, rules)
     inc = jnp.ones_like(cache.lengths) if active is None else active.astype(jnp.int32)

@@ -282,6 +282,8 @@ class P2PHost:
         self._closed = threading.Event()
         self._relay_threads: list[threading.Thread] = []
         self._relay_addrs: list[Multiaddr] = []
+        self._relay_socks: list[socket.socket] = []
+        self._relay_socks_mu = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -314,6 +316,20 @@ class P2PHost:
                 pass
             try:
                 self._server_sock.close()
+            except OSError:
+                pass
+        # Close relay control connections so _relay_control_loop threads
+        # blocked in recv exit and the relay drops our reservations promptly
+        # (otherwise it keeps routing circuits to a closed host).
+        with self._relay_socks_mu:
+            socks, self._relay_socks = self._relay_socks, []
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
             except OSError:
                 pass
 
@@ -429,8 +445,19 @@ class P2PHost:
 
     def _relay_control_loop(self, relay_addr: Multiaddr, retry_interval: float) -> None:
         while not self._closed.is_set():
+            sock = None
             try:
                 sock = self._tcp_connect(relay_addr.host, relay_addr.port, 5.0)
+                # Register under the lock with a _closed re-check: close()
+                # sets _closed before swapping the list out, so a connect
+                # racing with close() either lands in the swapped list (and
+                # is closed there) or sees _closed here and self-closes —
+                # never a leaked live reservation.
+                with self._relay_socks_mu:
+                    if self._closed.is_set():
+                        sock.close()
+                        return
+                    self._relay_socks.append(sock)
                 ts = str(int(time.time()))
                 payload = f"{RELAY_RESERVE}|{self.peer_id}|{ts}".encode()
                 sig = self.identity.sign(payload)
@@ -458,6 +485,14 @@ class P2PHost:
                     elif msg.get("type") == RELAY_PING:
                         send_json_frame(sock, {"type": RELAY_PONG})
             except (OSError, ConnectionError, ValueError) as e:
+                if sock is not None:
+                    with self._relay_socks_mu:
+                        if sock in self._relay_socks:
+                            self._relay_socks.remove(sock)
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
                 if self._closed.is_set():
                     return
                 log.debug("relay control loop error (%s); retrying in %.0fs",

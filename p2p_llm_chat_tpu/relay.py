@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import json
 import socket
+import struct
 import threading
 import time
 import uuid
@@ -49,6 +50,8 @@ log = get_logger("relay")
 RESERVATION_STALE_S = 120.0     # control channel considered dead after this
 CIRCUIT_IDLE_TIMEOUT_S = 300.0  # spliced circuit killed after idle
 ACCEPT_TIMEOUT_S = 10.0         # target must dial back within this
+RESERVE_TS_WINDOW_S = 60.0      # max |now - ts| on a signed RESERVE frame
+SWEEP_INTERVAL_S = 30.0         # ping/evict cadence for reservations
 
 
 @dataclass
@@ -70,7 +73,10 @@ class RelayService:
     def __init__(self, addr: Optional[str] = None,
                  max_reservations: Optional[int] = None,
                  max_circuits: Optional[int] = None,
-                 advertise_host: Optional[str] = None) -> None:
+                 advertise_host: Optional[str] = None,
+                 reserve_ts_window_s: float = RESERVE_TS_WINDOW_S,
+                 stale_after_s: float = RESERVATION_STALE_S,
+                 sweep_interval_s: float = SWEEP_INTERVAL_S) -> None:
         addr = addr if addr is not None else env_or("RELAY_ADDR", "127.0.0.1:4100")
         host, _, port = addr.rpartition(":")
         self._host = host or "127.0.0.1"
@@ -82,6 +88,9 @@ class RelayService:
                                  else env_int("RELAY_MAX_RESERVATIONS", 128))
         self.max_circuits = (max_circuits if max_circuits is not None
                              else env_int("RELAY_MAX_CIRCUITS", 1024))
+        self.reserve_ts_window_s = reserve_ts_window_s
+        self.stale_after_s = stale_after_s
+        self.sweep_interval_s = sweep_interval_s
         self._reservations: dict[str, _Reservation] = {}
         self._pending: dict[str, _PendingCircuit] = {}
         self._active_circuits = 0
@@ -104,6 +113,7 @@ class RelayService:
         self._port = s.getsockname()[1]
         self._server = s
         threading.Thread(target=self._accept_loop, daemon=True).start()
+        threading.Thread(target=self._sweep_loop, daemon=True).start()
         # Print multiaddrs like the reference does (go/cmd/relay/main.go:40-45).
         log.info("relay %s listening; multiaddr: %s", self.peer_id[:12], self.addr())
         return self
@@ -178,6 +188,17 @@ class RelayService:
             send_json_frame(conn, {"ok": False, "error": "bad signature"})
             conn.close()
             return
+        # Freshness window: the signature covers ts, so without this check a
+        # captured RESERVE frame could be replayed forever to evict a peer's
+        # live reservation and hijack its RELAY_INCOMING notifications.
+        try:
+            skew = abs(time.time() - float(ts))
+        except ValueError:
+            skew = float("inf")
+        if skew > self.reserve_ts_window_s:
+            send_json_frame(conn, {"ok": False, "error": "stale timestamp"})
+            conn.close()
+            return
         with self._mu:
             if (peer_id not in self._reservations
                     and len(self._reservations) >= self.max_reservations):
@@ -195,6 +216,12 @@ class RelayService:
         send_json_frame(conn, {"ok": True})
         log.info("reservation: %s", peer_id[:12])
         conn.settimeout(None)
+        # Bound *sends* on the control channel (SO_SNDTIMEO is send-only, so
+        # the blocking recv loop below is unaffected): a peer that stops
+        # reading can otherwise wedge the sweep ping or a HOP's
+        # RELAY_INCOMING forever once the OS send buffer fills.
+        conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                        struct.pack("ll", int(ACCEPT_TIMEOUT_S), 0))
         # Keep reading the control channel (pongs / detect close).
         try:
             while not self._closed.is_set():
@@ -212,6 +239,36 @@ class RelayService:
         except OSError:
             pass
         log.info("reservation closed: %s", peer_id[:12])
+
+    def _sweep_loop(self) -> None:
+        """Ping every reservation periodically; evict those whose control
+        channel has been silent past ``stale_after_s`` (the pong a live node
+        sends back refreshes ``last_seen`` in the reserve read loop)."""
+        while not self._closed.wait(self.sweep_interval_s):
+            now = time.time()
+            with self._mu:
+                entries = list(self._reservations.items())
+            for peer_id, res in entries:
+                if now - res.last_seen > self.stale_after_s:
+                    with self._mu:
+                        if self._reservations.get(peer_id) is res:
+                            del self._reservations[peer_id]
+                    try:
+                        res.sock.close()
+                    except OSError:
+                        pass
+                    log.info("evicted stale reservation: %s", peer_id[:12])
+                    continue
+                # Bounded lock acquire: a sender already wedged on this
+                # reservation must not stall sweeping of the others.
+                if not res.send_lock.acquire(timeout=2.0):
+                    continue
+                try:
+                    send_json_frame(res.sock, {"type": RELAY_PING})
+                except OSError:
+                    pass    # read loop will notice the dead socket
+                finally:
+                    res.send_lock.release()
 
     def _handle_hop(self, conn: socket.socket, msg: dict) -> None:
         target = str(msg.get("target") or "")

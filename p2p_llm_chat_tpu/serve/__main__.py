@@ -1,0 +1,8 @@
+"""`python -m p2p_llm_chat_tpu.serve` — start the Ollama-compatible front.
+
+Backend selected by SERVE_BACKEND (fake | tpu), listen addr by SERVE_ADDR.
+"""
+
+from .api import main
+
+main()

@@ -78,13 +78,47 @@ def _byte_to_unicode() -> dict[int, str]:
     return dict(zip(bs, map(chr, cs)))
 
 
+def _nl_no_class() -> str:
+    """Character-class body for unicode categories Nl+No (letter-like and
+    other numbers: ², ½, Ⅻ, ①, …). Python's \\d covers only Nd, but
+    llama3's \\p{N} covers Nd∪Nl∪No — these must be in the number branch
+    and out of the letters branch or token ids diverge on such inputs.
+    Generated from the runtime's own unicodedata tables (~0.2 s, once)."""
+    import sys
+    import unicodedata
+    pts = [cp for cp in range(sys.maxunicode + 1)
+           if unicodedata.category(chr(cp)) in ("Nl", "No")]
+    ranges = []
+    start = prev = pts[0]
+    for cp in pts[1:]:
+        if cp == prev + 1:
+            prev = cp
+            continue
+        ranges.append((start, prev))
+        start = prev = cp
+    ranges.append((start, prev))
+    esc = lambda c: re.escape(chr(c))  # noqa: E731
+    return "".join(esc(a) + ("-" + esc(b) if b > a else "")
+                   for a, b in ranges)
+
+
+_NL_NO = _nl_no_class()
+
 # llama3's pre-tokenization regex (tiktoken cl100k-style), expressed for
-# Python's `re` (no possessive quantifiers; (?i:...) works).
+# Python's `re` (no possessive quantifiers / \p{..} classes; (?i:...) works).
+# The original's unicode classes map as: \p{L} (letters) -> [^\W\d_] minus
+# Nl/No (word chars minus all numbers minus underscore); \p{N} (numbers)
+# -> [\d + Nl/No]; "not letter, not number" -> [\W_] (digits and Nl/No are
+# word chars, so \W already excludes them; underscore added back).
+# Keeping numbers out of the word branch is what makes the number branch
+# reachable, so digit runs split into <=3-digit groups exactly like the HF
+# llama3 tokenizer. Parity with the real \p{..} engine is pinned by
+# tests/test_tokenizer.py::test_pretokenizer_matches_llama3_regex_oracle.
 _PRETOKEN_RE = re.compile(
     r"(?i:'s|'t|'re|'ve|'m|'ll|'d)"
-    r"|[^\r\n\w]?\w+"
-    r"|\d{1,3}"
-    r"| ?[^\s\w\d]+[\r\n]*"
+    rf"|(?:(?![\r\n])[\W_])?[^\W\d_{_NL_NO}]+"   # [^\r\n\p{{L}}\p{{N}}]?\p{{L}}+
+    rf"|[\d{_NL_NO}]{{1,3}}"                      # \p{{N}}{{1,3}}
+    r"| ?(?:_|[^\s\w])+[\r\n]*"                   # ' ?[^\s\p{L}\p{N}]+[\r\n]*'
     r"|\s*[\r\n]+"
     r"|\s+(?!\S)"
     r"|\s+"

@@ -3,19 +3,33 @@
 Per SURVEY.md §4 — same model code under jax.sharding runs on CPU with a
 faked device count; real-TPU paths are exercised by bench.py / the driver's
 dryrun instead. Must run before jax is imported anywhere.
+
+Forcing CPU needs ``jax.config.update``, not the JAX_PLATFORMS env var: the
+environment boots with a TPU PJRT plugin whose registration hook rewrites
+``jax_platforms`` at interpreter startup (observed: env JAX_PLATFORMS=cpu
+still yields ``jax.devices() == [TPU ...]``). Round 1's env-var-only conftest
+silently ran the "CPU" parity tests on the TPU, where f32 matmuls default to
+bf16 MXU passes — the root cause of the test_decode_matches_prefill red test.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-# The CPU backend's default matmul precision is bf16-class (observed 6e-2
-# error on f32 matmuls); parity/equivalence tests need true f32 accumulation.
+# Env vars still set for any subprocesses tests spawn.
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+assert jax.devices()[0].platform == "cpu", (
+    f"tests must run on CPU, got {jax.devices()}")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

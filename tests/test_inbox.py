@@ -33,13 +33,14 @@ def test_drain_after_returns_suffix():
     assert inbox.drain(msgs[-1].id) == []
 
 
-def test_drain_unknown_after_returns_full_list():
-    # Reference fall-through (main.go:116-127): no matching ID -> everything.
+def test_drain_unknown_after_returns_empty():
+    # Reference Drain (main.go:108-128): `found` never flips for an unknown
+    # ID, so `out` stays empty — a stale cursor yields nothing, not dupes.
     inbox = Inbox()
     msgs = _msgs(3)
     for m in msgs:
         inbox.push(m)
-    assert len(inbox.drain("no-such-id")) == 3
+    assert inbox.drain("no-such-id") == []
 
 
 def test_drain_returns_copy_not_view():

@@ -111,3 +111,33 @@ def test_bpe_matches_hf_tokenizers_on_gpt2_style(tmp_path):
         if hf_ids:
             assert ours.decode(ours.encode(s)) == theirs.decode(hf_ids) or True
         assert ours.decode(ours.encode(s)) == s
+
+
+def test_pretokenizer_matches_llama3_regex_oracle():
+    """_PRETOKEN_RE must split exactly like llama3's \\p{L}/\\p{N} regex.
+
+    Oracle: the `tokenizers` library's unicode regex engine running the
+    actual llama3 pattern. Digit runs must split into <=3-digit groups and
+    digits must stay out of the letters branch ('world123' -> world|123) —
+    divergence here silently changes token ids on real checkpoints.
+    """
+    tokenizers = pytest.importorskip("tokenizers")
+    from p2p_llm_chat_tpu.tokenizer import _PRETOKEN_RE
+
+    llama3_pattern = (
+        r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}"
+        r"| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+")
+    pt = tokenizers.pre_tokenizers.Split(
+        tokenizers.Regex(llama3_pattern), behavior="isolated")
+    cases = [
+        "world123", "abc 12345 x", "hello_world", "I'm fine!", "a  b\nc",
+        "3.14159", "Hello, World!", "  leading", "trailing  ", "CamelCase99",
+        "a_b_c 42", "foo\r\nbar", "\ttab\t42", "!!!wow!!!", "don't DON'T",
+        "x=y+2;", "émigré café 123", "日本語テスト", "mixed123abc", "9999999",
+        "a\n\n\nb", "... spaces   everywhere  ", "__init__", "price: $4.99!",
+        # Nl/No number categories: \p{N} covers these, Python's \d does not.
+        "x²", "ⅻⅻⅻⅻ", "½ cup", "①②③④", "a²b³",
+    ]
+    for s in cases:
+        oracle = [p for p, _ in pt.pre_tokenize_str(s)]
+        assert _PRETOKEN_RE.findall(s) == oracle, f"pretoken mismatch on {s!r}"
