@@ -1,7 +1,16 @@
-"""Token sampling: greedy, temperature, top-k, top-p — jit-friendly.
+"""Token sampling: greedy, temperature, top-k, top-p.
 
-All functions take f32 logits [B, vocab] and return token ids [B]. The
-option set mirrors what the Ollama contract exposes via ``options``
+Two implementations of the same semantics:
+
+- :func:`sample` — jit-friendly JAX, f32 logits [B, vocab] -> ids [B], one
+  shared option set for the whole batch. Used by the reference generation
+  loops (models/generate.py).
+- :func:`sample_np` — host-side numpy over a single row, per-request
+  options and per-request RNG. Used by the continuous-batching scheduler
+  (serve/scheduler.py), where every batch row belongs to a different
+  request with its own temperature/top-k/top-p/seed.
+
+The option set mirrors what the Ollama contract exposes via ``options``
 (serve/backend.py GenerateOptions), so server-side sampling is a drop-in
 for what the reference delegated to Ollama.
 """
@@ -10,6 +19,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .layers import NEG_INF
 
@@ -32,8 +42,10 @@ def _apply_top_p(logits: jax.Array, top_p: float) -> jax.Array:
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    # Keep the smallest prefix with cumulative prob >= top_p (always >= 1 tok).
+    # Keep the smallest prefix with cumulative prob >= top_p (always >= 1
+    # tok — the explicit set makes that hold even for top_p <= 0).
     keep = cum - probs < top_p
+    keep = keep.at[..., 0].set(True)
     threshold = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
                         keepdims=True)
     return jnp.where(logits < threshold, NEG_INF, logits)
@@ -49,3 +61,45 @@ def sample(logits: jax.Array, key: jax.Array, temperature: float = 0.0,
     logits = _apply_top_k(logits, top_k)
     logits = _apply_top_p(logits, top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_np(logits: np.ndarray, rng: np.random.Generator,
+              temperature: float = 0.0, top_k: int = 0,
+              top_p: float = 1.0) -> int:
+    """Numpy twin of :func:`sample` for one row of logits [vocab].
+
+    Same filtering semantics: temperature<=0 is greedy; top-k keeps the k
+    highest logits (ties at the k-th value survive, like lax.top_k's
+    threshold compare); top-p keeps the smallest probability-sorted prefix
+    whose cumulative mass reaches top_p (always at least one token).
+    """
+    # float64 throughout: Generator.choice checks sum(p)==1 to float64
+    # tolerance, which float32 softmax fails at real vocab sizes (~128k).
+    logits = np.asarray(logits, np.float64)
+    if temperature <= 0.0:
+        return int(np.argmax(logits))
+    logits = logits / max(temperature, 1e-6)
+    if top_k > 0:
+        k = min(top_k, logits.shape[-1])
+        kth = np.sort(logits)[-k]
+        logits = np.where(logits < kth, NEG_INF, logits)
+    if top_p < 1.0:
+        order = np.argsort(logits)[::-1]
+        sorted_logits = logits[order]
+        probs = _softmax_np(sorted_logits)
+        cum = np.cumsum(probs)
+        keep = (cum - probs) < top_p
+        # top_p <= 0 keeps nothing under the strict compare; degrade to
+        # top-1 like the JAX twin (threshold=inf keeps only the max).
+        threshold = (sorted_logits[keep].min() if keep.any()
+                     else sorted_logits[0])
+        logits = np.where(logits < threshold, NEG_INF, logits)
+    probs = _softmax_np(logits)
+    return int(rng.choice(logits.shape[-1], p=probs))
+
+
+def _softmax_np(x: np.ndarray) -> np.ndarray:
+    e = np.exp(x - x.max())
+    p = e / e.sum()
+    # Renormalise exactly — np.random choice requires sum(p) == 1.
+    return p / p.sum()

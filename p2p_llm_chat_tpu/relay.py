@@ -23,6 +23,7 @@ Env: ``RELAY_ADDR`` (listen, default 127.0.0.1:4100), ``RELAY_MAX_RESERVATIONS``
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 import threading
@@ -352,7 +353,18 @@ class RelayService:
 
 
 def main() -> None:
-    RelayService().serve_forever()
+    svc = RelayService().start()
+    # Machine-readable multiaddr hand-off for launchers: the identity (and
+    # so the /p2p/<id> in the multiaddr) is fresh per start, so orchestrators
+    # can't know it in advance — RELAY_ADDR_FILE names a file to publish it
+    # in (start_all.py uses this to set RELAY_ADDRS on the nodes).
+    addr_file = env_or("RELAY_ADDR_FILE", "")
+    if addr_file:
+        tmp = addr_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(svc.addr()))
+        os.replace(tmp, addr_file)
+    threading.Event().wait()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,96 @@
+"""TPU inference engine: the real model behind the Ollama-compatible API.
+
+This is the in-tree replacement for the reference's external Ollama server
+(the one capability that defines the project — web/streamlit_app.py:91-98
+delegates every suggestion to ``POST {OLLAMA_URL}/api/generate``; here the
+same HTTP surface is backed by the JAX model stack on TPU).
+
+Composition: :class:`TPUEngine` implements the serve ``Backend`` protocol
+(serve/backend.py) over a :class:`~.scheduler.BatchScheduler`, which merges
+all concurrent requests into one fixed-shape batched decode loop.
+
+Two provisioning paths (build_engine_from_env):
+
+- ``CKPT_DIR`` set: HF-layout safetensors checkpoint + its tokenizer.json
+  (models/weights.py, tokenizer.py) — the production path for real llama3 /
+  Mixtral weights.
+- no checkpoint: randomly-initialised weights for ``MODEL_CONFIG`` (default
+  ``tiny``) + the byte tokenizer, so the full serving stack runs anywhere —
+  the same graceful no-artifacts posture as FakeLLM, but exercising every
+  real device code path.
+
+Env surface (reference-style env-first config, utils/env.py):
+``SERVE_BACKEND=tpu``, ``CKPT_DIR``, ``MODEL_CONFIG``, ``SERVE_SLOTS``,
+``SERVE_MAX_SEQ``, ``SERVE_TP``, ``LLM_MODEL`` (served model tag).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+
+from ..models.configs import get_config
+from ..models import llama
+from ..models.weights import load_checkpoint
+from ..tokenizer import ByteTokenizer, load_tokenizer
+from ..utils.env import env_int, env_or
+from ..utils.log import get_logger
+from .backend import Backend, GenerateRequest, RequestStats
+from .scheduler import BatchScheduler
+
+log = get_logger("serve.engine")
+
+
+class TPUEngine:
+    """Backend over the continuous-batching scheduler."""
+
+    def __init__(self, params: dict, config, tokenizer, *,
+                 num_slots: int = 8, max_seq: int = 1024, mesh=None,
+                 name: Optional[str] = None) -> None:
+        self.name = name or config.name
+        self.config = config
+        self.scheduler = BatchScheduler(params, config, tokenizer,
+                                        num_slots=num_slots, max_seq=max_seq,
+                                        mesh=mesh)
+
+    def generate_stream(self, req: GenerateRequest,
+                        stats: Optional[RequestStats] = None) -> Iterator[str]:
+        return self.scheduler.submit(req, stats)
+
+    def models(self) -> list[str]:
+        return [self.name]
+
+    def stop(self) -> None:
+        self.scheduler.stop()
+
+
+def build_engine_from_env() -> Backend:
+    """Engine from env vars; falls back to a random tiny model + byte
+    tokenizer when no checkpoint is configured (runs anywhere)."""
+    ckpt_dir = env_or("CKPT_DIR", "")
+    num_slots = env_int("SERVE_SLOTS", 8)
+    max_seq = env_int("SERVE_MAX_SEQ", 1024)
+    tp = env_int("SERVE_TP", 1)
+
+    mesh = None
+    if tp > 1:
+        from ..parallel.mesh import local_mesh
+        mesh = local_mesh(tp=tp)
+
+    if ckpt_dir:
+        params, config = load_checkpoint(ckpt_dir, mesh=mesh)
+        tokenizer = load_tokenizer(ckpt_dir, vocab_size=config.vocab_size)
+        name = env_or("LLM_MODEL", config.name)
+    else:
+        config = get_config(env_or("MODEL_CONFIG", "tiny"))
+        log.info("no CKPT_DIR set: serving random-init %s with byte tokenizer",
+                 config.name)
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+        if mesh is not None:
+            from ..parallel.sharding import shard_params
+            params = shard_params(params, llama.param_axes(config), mesh)
+        tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
+        name = env_or("LLM_MODEL", config.name)
+    return TPUEngine(params, config, tokenizer, num_slots=num_slots,
+                     max_seq=max_seq, mesh=mesh, name=name)

@@ -1,0 +1,362 @@
+"""Continuous-batching scheduler: many requests, one decode loop.
+
+This is the component that turns the model into a *server*. The reference
+issues one blocking Ollama call per suggestion (web/streamlit_app.py:91-95);
+here all peers' requests are merged into a single fixed-shape batched decode
+loop on the TPU (BASELINE.json config 3: 32 concurrent peers, p50 TTFT
+target < 150 ms).
+
+Design, shaped by XLA's compilation model (SURVEY.md §7 "hard parts"):
+
+- **Fixed shapes.** The KV cache is ``[L, num_slots, max_seq, Hkv, D]`` and
+  the decode step is one jitted program over all ``num_slots`` rows, traced
+  once. Requests churn without recompilation because admission/eviction
+  only changes *data* (an ``active`` mask + per-row lengths), never shapes.
+- **Admit = prefill + insert.** A new request is prefilled alone at a
+  power-of-two padded length (bounded compile cache), then its kv block is
+  spliced into the big cache at a free row with ``dynamic_update_slice``.
+  Its first token is sampled from the prefill logits immediately — TTFT
+  does not wait for the next decode tick.
+- **Single scheduler thread.** All device work and slot bookkeeping happen
+  on one thread (the race-safety strategy SURVEY.md §5 prescribes); HTTP
+  threads communicate via queues only. Per-request sampling runs on host
+  (numpy) because every row has its own temperature/top-k/top-p/seed.
+- **Park, don't shrink.** Finished/empty rows stay in the batch with
+  ``active=False``; decode_step leaves their lengths unchanged and their
+  garbage logits are ignored (models/llama.py decode_step docstring —
+  the overwrite-before-trust invariant).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama
+from ..models.configs import ModelConfig
+from ..models.llama import KVCache
+from ..models.sampling import sample_np
+from ..tokenizer import Tokenizer
+from ..utils.log import get_logger
+from .backend import GenerateRequest, RequestStats
+
+log = get_logger("serve.scheduler")
+
+_MIN_BUCKET = 16
+
+
+def _bucket(n: int, max_seq: int) -> int:
+    """Smallest power-of-two >= n (>= _MIN_BUCKET), capped at max_seq."""
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return min(b, max_seq)
+
+
+@dataclass
+class _Slot:
+    """Host-side state for one batch row. Touched only by the scheduler
+    thread after admission."""
+
+    req: GenerateRequest
+    stats: Optional[RequestStats]
+    out_q: "queue.Queue[Optional[str]]"
+    rng: np.random.Generator
+    ids: list[int] = field(default_factory=list)      # generated ids
+    text: str = ""                                     # decoded from ids[:decoded_upto]
+    decoded_upto: int = 0                              # ids already folded into text
+    streamed: int = 0                                  # len of text already yielded
+    max_new: int = 0
+    ctx_len: int = 0                                   # host mirror of lengths[row]
+    cancelled: threading.Event = field(default_factory=threading.Event)
+
+    def push(self, delta: str) -> None:
+        if delta:
+            self.out_q.put(delta)
+
+    def finish(self) -> None:
+        if self.stats is not None and self.stats.total_s is None:
+            self.stats.total_s = time.monotonic() - self.req.arrival_time
+        self.out_q.put(None)
+
+
+class BatchScheduler:
+    """Owns the device state (params, KV cache) and the decode loop."""
+
+    def __init__(self, params: dict, config: ModelConfig,
+                 tokenizer: Tokenizer, num_slots: int = 8,
+                 max_seq: int = 1024, mesh=None) -> None:
+        self.config = config
+        self.tokenizer = tokenizer
+        self.num_slots = num_slots
+        self.max_seq = min(max_seq, config.max_seq_len)
+        self.mesh = mesh
+        self._params = params
+        dtype = params["embed"].dtype
+
+        self._cache = KVCache.create(config, num_slots, self.max_seq, dtype)
+        self._next_tokens = np.zeros((num_slots, 1), np.int32)
+        self._slots: list[Optional[_Slot]] = [None] * num_slots
+        self._stop_ids = set(config.eos_token_ids)
+        eos = getattr(tokenizer, "eos_id", None)
+        if eos is not None and 0 <= eos < config.vocab_size:
+            self._stop_ids.add(eos)
+
+        self._admit_q: "queue.Queue[Optional[_Slot]]" = queue.Queue()
+        self._closed = threading.Event()
+
+        # Jitted programs. Shapes: decode is compiled once; prefill/insert
+        # once per power-of-two prompt bucket.
+        def _prefill(params, tokens, lens, cache):
+            return llama.prefill(params, config, tokens, lens, cache, mesh)
+
+        def _decode(params, tokens, cache, active):
+            return llama.decode_step(params, config, tokens, cache, mesh,
+                                     active=active)
+
+        def _insert(cache: KVCache, small: KVCache, row, length) -> KVCache:
+            k = jax.lax.dynamic_update_slice(
+                cache.k, small.k, (0, row, 0, 0, 0))
+            v = jax.lax.dynamic_update_slice(
+                cache.v, small.v, (0, row, 0, 0, 0))
+            lengths = jax.lax.dynamic_update_slice(
+                cache.lengths, length[None].astype(cache.lengths.dtype), (row,))
+            return KVCache(k, v, lengths)
+
+        self._prefill_j = jax.jit(_prefill)
+        self._decode_j = jax.jit(_decode, donate_argnums=(2,))
+        self._insert_j = jax.jit(_insert, donate_argnums=(0,))
+
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="batch-scheduler")
+        self._thread.start()
+
+    # -- client side (HTTP threads) ------------------------------------------
+
+    def submit(self, req: GenerateRequest,
+               stats: Optional[RequestStats] = None) -> Iterator[str]:
+        """Enqueue a request; yield text deltas until completion. Closing
+        the iterator early (client gone) cancels the request."""
+        if self._closed.is_set():
+            raise RuntimeError("scheduler is stopped")
+        opts = req.options
+        seed = opts.seed if opts.seed is not None else time.monotonic_ns()
+        slot = _Slot(req=req, stats=stats,
+                     out_q=queue.Queue(),
+                     rng=np.random.default_rng(seed))
+        self._admit_q.put(slot)
+        try:
+            while True:
+                delta = slot.out_q.get()
+                if delta is None:
+                    return
+                yield delta
+        finally:
+            slot.cancelled.set()
+
+    def stop(self) -> None:
+        self._closed.set()
+        self._admit_q.put(None)    # wake the loop if parked
+        self._thread.join(timeout=10.0)
+        # Unblock every consumer: in-flight slots and never-admitted
+        # requests would otherwise hang forever on out_q.get().
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                s.finish()
+                self._slots[i] = None
+        while True:
+            try:
+                s = self._admit_q.get_nowait()
+            except queue.Empty:
+                break
+            if s is not None:
+                s.finish()
+
+    # -- scheduler thread ----------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._closed.is_set():
+            self._admit_pending(block=not self._any_active())
+            if self._closed.is_set():
+                return
+            if not self._any_active():
+                continue
+            try:
+                self._decode_tick()
+            except Exception:   # noqa: BLE001 — fail requests, keep serving
+                log.exception("decode tick failed; failing in-flight requests")
+                for i, s in enumerate(self._slots):
+                    if s is not None:
+                        s.finish()
+                        self._slots[i] = None
+
+    def _any_active(self) -> bool:
+        return any(s is not None for s in self._slots)
+
+    def _free_rows(self) -> list[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _admit_pending(self, block: bool) -> None:
+        """Move requests from the admission queue into free rows. Blocks
+        when the batch is empty (nothing to decode until work arrives)."""
+        free = self._free_rows()
+        while free:
+            try:
+                slot = self._admit_q.get(block=block, timeout=0.2 if block else None)
+            except queue.Empty:
+                return
+            block = False
+            if slot is None:
+                return
+            if slot.cancelled.is_set():
+                continue
+            row = free.pop(0)
+            try:
+                self._admit(slot, row)
+            except Exception:   # noqa: BLE001
+                log.exception("admission failed for request %s",
+                              slot.req.request_id)
+                slot.finish()
+                self._slots[row] = None
+                free.insert(0, row)
+
+    def _admit(self, slot: _Slot, row: int) -> None:
+        """Prefill the prompt alone, splice its kv into row ``row``, and
+        emit the first token."""
+        opts = slot.req.options
+        ids = self.tokenizer.encode(slot.req.prompt, add_bos=True)
+        # Context budget: keep the prompt tail (recent context wins, the
+        # same truncation direction Ollama applies), leave room to generate.
+        max_prompt = self.max_seq - 2
+        if len(ids) > max_prompt:
+            ids = ids[-max_prompt:]
+        budget = self.max_seq - 1 - len(ids)
+        # Ollama semantics: num_predict <= 0 means "until EOS / context
+        # full", not "almost nothing".
+        want = opts.max_tokens if opts.max_tokens > 0 else budget
+        slot.max_new = max(1, min(want, budget))
+        if slot.stats is not None:
+            slot.stats.prompt_tokens = len(ids)
+
+        S = _bucket(len(ids), self.max_seq)
+        tokens = np.zeros((1, S), np.int32)
+        tokens[0, : len(ids)] = ids
+        small = KVCache.create(self.config, 1, S, self._params["embed"].dtype)
+        logits, small = self._prefill_j(self._params, jnp.asarray(tokens),
+                                        jnp.asarray([len(ids)]), small)
+        self._cache = self._insert_j(self._cache, small,
+                                     jnp.int32(row), jnp.int32(len(ids)))
+
+        first = sample_np(np.asarray(logits[0, len(ids) - 1]), slot.rng,
+                          opts.temperature, opts.top_k, opts.top_p)
+        if slot.stats is not None:
+            slot.stats.ttft_s = time.monotonic() - slot.req.arrival_time
+        slot.ctx_len = len(ids)
+        self._slots[row] = slot
+        self._next_tokens[row, 0] = first
+        if not self._append_token(slot, row, first):
+            # finished on the very first token (eos / limits)
+            self._release(row)
+
+    def _decode_tick(self) -> None:
+        """One batched decode step: all active rows advance one token."""
+        active = np.array([s is not None for s in self._slots], bool)
+        logits, self._cache = self._decode_j(
+            self._params, jnp.asarray(self._next_tokens), self._cache,
+            jnp.asarray(active))
+        logits_h = np.asarray(logits[:, 0])    # [B, vocab] one transfer
+        for row, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            if slot.cancelled.is_set():
+                self._release(row)
+                continue
+            opts = slot.req.options
+            tok = sample_np(logits_h[row], slot.rng, opts.temperature,
+                            opts.top_k, opts.top_p)
+            self._next_tokens[row, 0] = tok
+            slot.ctx_len += 1          # decode wrote this row's next kv slot
+            if not self._append_token(slot, row, tok):
+                self._release(row)
+
+    def _append_token(self, slot: _Slot, row: int, tok: int) -> bool:
+        """Record one sampled token; stream its text. Returns False when the
+        request is finished (eos, stop string, length/context limits)."""
+        if tok in self._stop_ids:
+            self._flush_text(slot, final=True)
+            slot.finish()
+            return False
+        slot.ids.append(tok)
+        if slot.stats is not None:
+            slot.stats.completion_tokens = len(slot.ids)
+        stop_hit = self._flush_text(slot)
+        if stop_hit:
+            slot.finish()
+            return False
+        if len(slot.ids) >= slot.max_new:
+            self._flush_text(slot, final=True)
+            slot.finish()
+            return False
+        # Context full: the next decode step would write slot ctx_len,
+        # which must stay < max_seq (host mirror avoids a device sync).
+        if slot.ctx_len + 1 >= self.max_seq:
+            self._flush_text(slot, final=True)
+            slot.finish()
+            return False
+        return True
+
+    def _flush_text(self, slot: _Slot, final: bool = False) -> bool:
+        """Incremental detokenisation + streaming.
+
+        Decodes only the ids not yet folded into ``slot.text`` (amortised
+        O(1) per token — never the whole history), holding back a trailing
+        partial UTF-8 sequence (surfaces as U+FFFD) until completed. Also
+        holds back any text suffix that is a prefix of a stop string, so a
+        stop straddling a token boundary never leaks its prefix to the
+        client. Returns True when a stop string matched (text past it is
+        dropped, matching Ollama)."""
+        pending = self.tokenizer.decode(slot.ids[slot.decoded_upto:])
+        if pending:
+            if not final and pending.endswith("�"):
+                return False    # wait for the rest of the multibyte char
+            slot.text += pending
+            slot.decoded_upto = len(slot.ids)
+
+        stops = [s for s in slot.req.options.stop if s]
+        max_stop = max((len(s) for s in stops), default=0)
+        for s in stops:
+            # Overlap window: a match can start up to len(s)-1 chars before
+            # the newly decoded region; never earlier (holdback below
+            # guarantees streamed text cannot already contain a prefix).
+            idx = slot.text.find(s, max(0, slot.streamed - len(s) + 1))
+            if idx >= 0:
+                slot.push(slot.text[slot.streamed: idx])
+                slot.text = slot.text[:idx]
+                slot.streamed = idx
+                return True
+        emit_to = len(slot.text)
+        if not final and stops:
+            # Longest suffix of text that is a proper prefix of any stop
+            # string stays buffered until disambiguated.
+            for k in range(min(max_stop - 1, len(slot.text)), 0, -1):
+                suffix = slot.text[-k:]
+                if any(s.startswith(suffix) for s in stops):
+                    emit_to = len(slot.text) - k
+                    break
+        if emit_to > slot.streamed:
+            slot.push(slot.text[slot.streamed: emit_to])
+            slot.streamed = emit_to
+        return False
+
+    def _release(self, row: int) -> None:
+        """Free a row (finish() has already been queued where a consumer is
+        still listening; cancelled consumers are gone)."""
+        self._slots[row] = None
+        self._next_tokens[row, 0] = 0

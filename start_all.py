@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import argparse
 import os
+import shutil
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 import urllib.request
 
@@ -89,19 +91,39 @@ def main() -> int:
         spawn("directory", "p2p_llm_chat_tpu.directory", {"ADDR": "127.0.0.1:8080"}, procs)
         spawn("serve", "p2p_llm_chat_tpu.serve.api",
               {"SERVE_ADDR": "127.0.0.1:11434", "SERVE_BACKEND": args.backend}, procs)
+        relay_addrs = ""
         if args.relay:
-            spawn("relay", "p2p_llm_chat_tpu.relay", {"RELAY_ADDR": "127.0.0.1:4100"}, procs)
+            # The relay publishes its fresh multiaddr (identity is per-start)
+            # to a file; nodes get it as RELAY_ADDRS so they actually hold
+            # reservations — a relay no node can use is dead config.
+            addr_file = os.path.join(tempfile.mkdtemp(prefix="p2pchat-relay-"),
+                                     "relay.maddr")
+            spawn("relay", "p2p_llm_chat_tpu.relay",
+                  {"RELAY_ADDR": "127.0.0.1:4100",
+                   "RELAY_ADDR_FILE": addr_file}, procs)
+            deadline = time.time() + 15
+            while time.time() < deadline and not os.path.exists(addr_file):
+                time.sleep(0.1)
+            if not os.path.exists(addr_file):
+                raise TimeoutError("relay did not publish its multiaddr")
+            with open(addr_file) as f:
+                relay_addrs = f.read().strip()
+            shutil.rmtree(os.path.dirname(addr_file), ignore_errors=True)
+            print(f"  relay multiaddr: {relay_addrs}")
         wait_http("http://127.0.0.1:8080/healthz")
         wait_http("http://127.0.0.1:11434/healthz", timeout=300 if args.backend != "fake" else 30)
 
         for i, user in enumerate(users):
             node_port = args.node_port_base + i
             ui_port = args.ui_port_base + i
-            spawn(f"node-{user}", "p2p_llm_chat_tpu.node", {
+            node_env = {
                 "MYNAMEIS": user,
                 "HTTP_ADDR": f"127.0.0.1:{node_port}",
                 "DIRECTORY_URL": "http://127.0.0.1:8080",
-            }, procs)
+            }
+            if relay_addrs:
+                node_env["RELAY_ADDRS"] = relay_addrs
+            spawn(f"node-{user}", "p2p_llm_chat_tpu.node", node_env, procs)
             wait_http(f"http://127.0.0.1:{node_port}/healthz")
             spawn(f"ui-{user}", "p2p_llm_chat_tpu.ui", {
                 "NODE_HTTP": f"http://127.0.0.1:{node_port}",

@@ -33,3 +33,18 @@ assert jax.devices()[0].platform == "cpu", (
     f"tests must run on CPU, got {jax.devices()}")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# Model-heavy modules get the `model` marker automatically, so the
+# chat-plane suite stays sub-minute: `pytest -m "not model"`.
+_MODEL_TEST_MODULES = {"test_llama_parity", "test_engine", "test_sampling",
+                       "test_mixtral_parity", "test_sharding", "test_ops",
+                       "test_weights"}
+
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _MODEL_TEST_MODULES:
+            item.add_marker(pytest.mark.model)

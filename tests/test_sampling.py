@@ -1,0 +1,104 @@
+"""Sampling tests: JAX and numpy twins, boundary cases.
+
+Boundary semantics under test (the ones that silently shape every served
+reply): temperature<=0 greedy, top-k/top-p filtering including top_p<=0 and
+top_p=1, large-vocab float tolerance (Generator.choice requires probability
+sums exact to float64), and JAX/numpy agreement on the filtered support.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_llm_chat_tpu.models.sampling import greedy, sample, sample_np
+
+
+def logits_np(vocab=64, seed=0):
+    return np.random.default_rng(seed).normal(size=(vocab,)).astype(np.float32)
+
+
+def test_greedy_matches_argmax():
+    lg = logits_np()
+    assert sample_np(lg, np.random.default_rng(0)) == int(lg.argmax())
+    out = sample(jnp.asarray(lg[None]), jax.random.PRNGKey(0))
+    assert int(out[0]) == int(lg.argmax())
+    assert int(greedy(jnp.asarray(lg[None]))[0]) == int(lg.argmax())
+
+
+def test_large_vocab_temperature_does_not_crash():
+    # float32 softmax sums fail Generator.choice's float64 tolerance at
+    # ~128k vocab — regression for the float64 renormalisation.
+    lg = logits_np(vocab=128256, seed=1)
+    rng = np.random.default_rng(0)
+    for _ in range(8):
+        tok = sample_np(lg, rng, temperature=0.8)
+        assert 0 <= tok < 128256
+
+
+def test_top_k_restricts_support():
+    lg = logits_np(vocab=32, seed=2)
+    top5 = set(np.argsort(lg)[-5:].tolist())
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        assert sample_np(lg, rng, temperature=1.0, top_k=5) in top5
+    key = jax.random.PRNGKey(0)
+    for i in range(20):
+        key, sub = jax.random.split(key)
+        assert int(sample(jnp.asarray(lg[None]), sub, temperature=1.0,
+                          top_k=5)[0]) in top5
+
+
+def test_top_k_one_is_greedy():
+    lg = logits_np(seed=3)
+    rng = np.random.default_rng(0)
+    assert sample_np(lg, rng, temperature=1.0, top_k=1) == int(lg.argmax())
+
+
+def test_top_p_zero_keeps_top_token():
+    """top_p<=0 must degrade to top-1 (not crash, not uniform-random)."""
+    lg = logits_np(seed=4)
+    rng = np.random.default_rng(0)
+    assert sample_np(lg, rng, temperature=1.0, top_p=0.0) == int(lg.argmax())
+    out = sample(jnp.asarray(lg[None]), jax.random.PRNGKey(0),
+                 temperature=1.0, top_p=0.0)
+    assert int(out[0]) == int(lg.argmax())
+
+
+def test_top_p_one_is_unfiltered():
+    lg = np.array([0.0, 0.0, 10.0], np.float32)
+    rng = np.random.default_rng(0)
+    seen = {sample_np(lg, rng, temperature=5.0, top_p=1.0) for _ in range(200)}
+    assert seen == {0, 1, 2}     # high temperature, no filtering
+
+
+def test_top_p_small_keeps_only_peak():
+    # One dominant token (p ~ 0.99): tiny top_p must exclude the tail.
+    lg = np.array([10.0, 0.0, 0.0, 0.0], np.float32)
+    rng = np.random.default_rng(0)
+    for _ in range(50):
+        assert sample_np(lg, rng, temperature=1.0, top_p=0.5) == 0
+    key = jax.random.PRNGKey(1)
+    for _ in range(20):
+        key, sub = jax.random.split(key)
+        assert int(sample(jnp.asarray(lg[None]), sub, temperature=1.0,
+                          top_p=0.5)[0]) == 0
+
+
+def test_top_p_keeps_prefix_reaching_mass():
+    # Two tokens at ~0.45 each, rest tiny: top_p=0.6 needs both of the top
+    # two (cum-probs < 0.6 admits the second at cum=0.45).
+    lg = np.log(np.array([0.45, 0.45, 0.05, 0.05], np.float64)).astype(np.float32)
+    rng = np.random.default_rng(0)
+    seen = {sample_np(lg, rng, temperature=1.0, top_p=0.6) for _ in range(200)}
+    assert seen == {0, 1}
+
+
+def test_seeded_reproducibility():
+    lg = logits_np(seed=5)
+    r1, r2 = np.random.default_rng(7), np.random.default_rng(7)
+    a = [sample_np(lg, r1, temperature=0.9, top_k=10) for _ in range(5)]
+    b = [sample_np(lg, r2, temperature=0.9, top_k=10) for _ in range(5)]
+    assert a == b
+    assert len(set(a)) > 1     # the stream actually advances
