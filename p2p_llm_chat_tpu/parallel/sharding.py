@@ -27,6 +27,10 @@ from typing import Any, Optional
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.log import get_logger
+
+log = get_logger("parallel.sharding")
+
 
 # logical axis -> mesh axis (or None = replicated). A logical axis may map to
 # a tuple of mesh axes (sharded over their product).
@@ -99,8 +103,29 @@ def constrain(x: jax.Array, mesh: Optional[Mesh],
               logical_axes: tuple[Optional[str], ...],
               rules: LogicalRules = DEFAULT_RULES) -> jax.Array:
     """In-jit activation sharding hint; no-op when mesh is None (single
-    device / testing)."""
+    device / testing).
+
+    Dims whose size the bound mesh axes don't divide evenly are left
+    unconstrained instead of forcing XLA into involuntary full
+    rematerialisation (hit by tiny test configs, e.g. 2 kv heads on tp=4;
+    production head/mlp/vocab dims always divide)."""
     if mesh is None:
         return x
+    spec = list(spec_for(logical_axes, rules))
+    spec += [None] * (x.ndim - len(spec))
+    for i, entry in enumerate(spec):
+        if entry is None or i >= x.ndim:
+            # Rank mismatch falls through to with_sharding_constraint,
+            # whose error names the spec and the value's rank.
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        if x.shape[i] % n:
+            log.warning("dropping sharding %r on dim %d (size %d %% %d != 0) "
+                        "of %s tensor — replicated instead", entry, i,
+                        x.shape[i], n, x.shape)
+            spec[i] = None
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, spec_for(logical_axes, rules)))
+        x, NamedSharding(mesh, P(*spec)))

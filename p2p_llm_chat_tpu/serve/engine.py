@@ -81,7 +81,6 @@ def build_engine_from_env() -> Backend:
     if ckpt_dir:
         params, config = load_checkpoint(ckpt_dir, mesh=mesh)
         tokenizer = load_tokenizer(ckpt_dir, vocab_size=config.vocab_size)
-        name = env_or("LLM_MODEL", config.name)
     else:
         config = get_config(env_or("MODEL_CONFIG", "tiny"))
         log.info("no CKPT_DIR set: serving random-init %s with byte tokenizer",
@@ -91,6 +90,6 @@ def build_engine_from_env() -> Backend:
             from ..parallel.sharding import shard_params
             params = shard_params(params, llama.param_axes(config), mesh)
         tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
-        name = env_or("LLM_MODEL", config.name)
     return TPUEngine(params, config, tokenizer, num_slots=num_slots,
-                     max_seq=max_seq, mesh=mesh, name=name)
+                     max_seq=max_seq, mesh=mesh,
+                     name=env_or("LLM_MODEL", config.name))

@@ -152,6 +152,11 @@ class BatchScheduler:
                      out_q=queue.Queue(),
                      rng=np.random.default_rng(seed))
         self._admit_q.put(slot)
+        if self._closed.is_set():
+            # stop() may have drained the queue between our closed-check and
+            # the put; finish defensively so the consumer can never hang (a
+            # duplicate None from stop()'s own drain is harmless).
+            slot.finish()
         try:
             while True:
                 delta = slot.out_q.get()
@@ -196,6 +201,7 @@ class BatchScheduler:
                     if s is not None:
                         s.finish()
                         self._slots[i] = None
+                self._recover_cache()
 
     def _any_active(self) -> bool:
         return any(s is not None for s in self._slots)
@@ -226,6 +232,7 @@ class BatchScheduler:
                 slot.finish()
                 self._slots[row] = None
                 free.insert(0, row)
+                self._recover_cache()
 
     def _admit(self, slot: _Slot, row: int) -> None:
         """Prefill the prompt alone, splice its kv into row ``row``, and
@@ -354,6 +361,25 @@ class BatchScheduler:
             slot.push(slot.text[slot.streamed: emit_to])
             slot.streamed = emit_to
         return False
+
+    def _recover_cache(self) -> None:
+        """A failed _decode_j/_insert_j call may have consumed the donated
+        KV cache buffer; without this, every later admission dies on
+        'Array has been deleted' while the engine appears up. If the cache
+        is gone, fail any in-flight requests (their context lives in the
+        dead buffer) and start fresh."""
+        if not self._cache.k.is_deleted():
+            return
+        log.warning("KV cache buffer was donated to a failed call; "
+                    "recreating and failing %d in-flight requests",
+                    sum(s is not None for s in self._slots))
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                s.finish()
+                self._slots[i] = None
+        self._cache = KVCache.create(self.config, self.num_slots,
+                                     self.max_seq, self._params["embed"].dtype)
+        self._next_tokens[:] = 0
 
     def _release(self, row: int) -> None:
         """Free a row (finish() has already been queued where a consumer is

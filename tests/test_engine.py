@@ -183,6 +183,22 @@ def test_stop_unblocks_inflight_consumers():
     t.join(timeout=5)
 
 
+def test_recovers_after_cache_buffer_loss():
+    """A failed donated call consumes the KV cache buffer; the scheduler
+    must detect the dead buffer, fail in-flight work, and keep serving."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128)
+    try:
+        text, _ = run(eng, "before failure", max_tokens=6)
+        assert text == oracle("before failure", 6)
+        # Simulate a call that raised after consuming its donated input.
+        eng.scheduler._cache.k.delete()
+        eng.scheduler._recover_cache()
+        text, _ = run(eng, "after failure", max_tokens=6)
+        assert text == oracle("after failure", 6)
+    finally:
+        eng.stop()
+
+
 def test_sampling_with_seed_is_reproducible(engine):
     a, _ = run(engine, "seeded", max_tokens=8, temperature=0.8, seed=42)
     b, _ = run(engine, "seeded", max_tokens=8, temperature=0.8, seed=42)
