@@ -88,13 +88,46 @@ def attend(q: jax.Array, k: jax.Array, v: jax.Array,
     (True = attend). Returns [B,Sq,H,D].
     """
     d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) / jnp.sqrt(d).astype(jnp.float32)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(d).astype(jnp.float32)
     if mask is not None:
         scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out
+
+
+def attend_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
+               mask: Optional[jax.Array]) -> jax.Array:
+    """Grouped-query attention without materialising repeated kv heads.
+
+    q: [B,Sq,Hq,D]; k,v: [B,Skv,Hkv,D] with Hq = Hkv * rep; mask:
+    broadcastable to [B,H,Sq,Skv] (True = attend). Returns [B,Sq,Hq,D].
+
+    The repeat_kv + attend formulation reads (and on TPU, writes) the kv
+    cache ``rep``× per step — at serving shapes that is gigabytes of pure
+    HBM waste. Here q is reshaped to [B,Sq,G,rep,D] and contracted against
+    the unexpanded cache; scores accumulate in f32 on the MXU
+    (``preferred_element_type``) without an f32 copy of the cache. Query
+    head h maps to kv head h // rep, matching repeat_kv's expansion order.
+    """
+    B, Sq, Hq, D = q.shape
+    G = k.shape[2]
+    rep = Hq // G
+    if rep == 1:
+        return attend(q, k, v, mask)
+    qg = q.reshape(B, Sq, G, rep, D)
+    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(D).astype(jnp.float32)
+    if mask is not None:
+        if mask.ndim == 4:                     # [B|1, 1, Sq, Skv]
+            mask = mask[:, :, None]            # -> [B|1, 1, 1, Sq, Skv]
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrst,btgd->bsgrd", probs.astype(v.dtype), v)
+    return out.reshape(B, Sq, Hq, D)
 
 
 def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,

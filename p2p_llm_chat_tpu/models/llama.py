@@ -30,10 +30,9 @@ from .configs import ModelConfig
 from .layers import (
     DEFAULT_COMPUTE_DTYPE,
     apply_rope,
-    attend,
+    attend_gqa,
     causal_mask,
     length_mask,
-    repeat_kv,
     rms_norm,
     rope_frequencies,
     swiglu,
@@ -116,16 +115,23 @@ def param_axes(config: ModelConfig) -> dict:
 
 def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
            positions: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
-           write_pos: jax.Array, mask: jax.Array,
-           mesh: Optional[Mesh], rules: LogicalRules):
-    """One decoder block against a single layer's cache.
+           layer: jax.Array, write_pos: jax.Array, mask: jax.Array,
+           mesh: Optional[Mesh], rules: LogicalRules,
+           kv_window: Optional[int] = None):
+    """One decoder block against the full stacked cache.
 
-    h: [B,S,H]; cache_k/v: [B,max_seq,Hkv,D]; write_pos: [B,S] absolute slots
-    to write this step's k/v into; mask: [B or 1, 1, S, max_seq].
+    h: [B,S,H]; cache_k/v: [L,B,max_seq,Hkv,D] (the whole stacked cache —
+    this layer's slice is selected by ``layer``); write_pos: [B,S] absolute
+    slots to write this step's k/v into; mask: [B or 1, 1, S, max_seq].
     Returns (h, new_cache_k, new_cache_v).
+
+    The cache flows through the layer scan as *carry* and is updated with a
+    scatter at exactly the written slots: per step, HBM sees a tiny write
+    plus one read of this layer's history — not a rewrite of the stacked
+    cache (which scan ys would force), and not a ``rep``× expanded read
+    (attend_gqa contracts the unexpanded cache).
     """
     B, S, _ = h.shape
-    n_rep = config.num_heads // config.num_kv_heads
 
     x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
     q = (x @ lp["wq"]).reshape(B, S, config.num_heads, config.head_dim)
@@ -137,15 +143,21 @@ def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
 
-    # Write this step's k/v into the cache at write_pos (batched scatter;
-    # rows write S consecutive slots).
+    # Scatter this step's k/v into the carried cache at (layer, row,
+    # write_pos); rows write S consecutive slots, in place.
     b_idx = jnp.arange(B)[:, None]
-    cache_k = cache_k.at[b_idx, write_pos].set(k)
-    cache_v = cache_v.at[b_idx, write_pos].set(v)
+    cache_k = cache_k.at[layer, b_idx, write_pos].set(k)
+    cache_v = cache_v.at[layer, b_idx, write_pos].set(v)
+    k_layer = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
+    v_layer = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
+    if kv_window is not None and kv_window < k_layer.shape[1]:
+        # Static attention-read window: every row's live context fits in
+        # the first kv_window slots (caller guarantees lengths < window),
+        # so HBM reads scale with actual context, not allocated max_seq.
+        k_layer = k_layer[:, :kv_window]
+        v_layer = v_layer[:, :kv_window]
 
-    k_full = repeat_kv(cache_k, n_rep)
-    v_full = repeat_kv(cache_v, n_rep)
-    attn = attend(q, k_full, v_full, mask)          # [B,S,H,D]
+    attn = attend_gqa(q, k_layer, v_layer, mask)    # [B,S,H,D]
     attn = attn.reshape(B, S, config.q_dim)
     h = h + constrain(attn @ lp["wo"], mesh, ("batch", None, "act_embed"), rules)
 
@@ -158,12 +170,14 @@ def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
 def forward(params: dict, config: ModelConfig, tokens: jax.Array,
             positions: jax.Array, cache: KVCache, mask: jax.Array,
             mesh: Optional[Mesh] = None,
-            rules: LogicalRules = DEFAULT_RULES) -> tuple[jax.Array, KVCache]:
+            rules: LogicalRules = DEFAULT_RULES,
+            kv_window: Optional[int] = None) -> tuple[jax.Array, KVCache]:
     """Shared forward: embed -> scan(blocks) -> norm -> logits.
 
-    tokens/positions: [B,S]; mask: [B or 1,1,S,max_seq] (True = attend);
-    k/v for this step are written at ``positions`` in every layer's cache.
-    Returns (logits [B,S,vocab] f32, updated cache).
+    tokens/positions: [B,S]; mask: [B or 1,1,S,W] (True = attend) where W
+    is ``kv_window`` (or max_seq when unset — the static attention-read
+    window; see _block); k/v for this step are written at ``positions`` in
+    every layer's cache. Returns (logits [B,S,vocab] f32, updated cache).
     """
     # Compute dtype follows the params' dtype (bf16 in production; the HF
     # parity tests load f32 weights and get f32 compute for tight tolerances).
@@ -171,13 +185,16 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
     h = constrain(h, mesh, ("batch", None, "act_embed"), rules)
     inv_freq = rope_frequencies(config)
 
-    def body(h, xs):
-        lp, ck, cv = xs
+    def body(carry, xs):
+        h, ck, cv = carry
+        lp, layer = xs
         h, ck, cv = _block(h, lp, config, inv_freq, positions, ck, cv,
-                           positions, mask, mesh, rules)
-        return h, (ck, cv)
+                           layer, positions, mask, mesh, rules, kv_window)
+        return (h, ck, cv), None
 
-    h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache.k, cache.v))
+    (h, new_k, new_v), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v),
+        (params["layers"], jnp.arange(config.num_layers)))
     h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
     lm_head = (params["embed"].T if config.tie_embeddings
                else params["lm_head"])
@@ -208,7 +225,8 @@ def prefill(params: dict, config: ModelConfig, tokens: jax.Array,
 def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
                 cache: KVCache, mesh: Optional[Mesh] = None,
                 rules: LogicalRules = DEFAULT_RULES,
-                active: Optional[jax.Array] = None) -> tuple[jax.Array, KVCache]:
+                active: Optional[jax.Array] = None,
+                kv_window: Optional[int] = None) -> tuple[jax.Array, KVCache]:
     """One autoregressive step for every row of the batch.
 
     tokens: [B,1] (this step's input token per row). Each row writes cache
@@ -228,9 +246,9 @@ def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
     Returns (logits [B,1,vocab], cache with lengths+1 where active).
     """
     positions = cache.lengths[:, None]                 # [B,1]
-    max_seq = cache.k.shape[2]
-    mask = length_mask(max_seq, cache.lengths + 1)     # include slot being written
+    window = kv_window if kv_window is not None else cache.k.shape[2]
+    mask = length_mask(window, cache.lengths + 1)      # include slot being written
     logits, cache = forward(params, config, tokens, positions, cache, mask,
-                            mesh, rules)
+                            mesh, rules, kv_window=kv_window)
     inc = jnp.ones_like(cache.lengths) if active is None else active.astype(jnp.int32)
     return logits, cache._replace(lengths=cache.lengths + inc)

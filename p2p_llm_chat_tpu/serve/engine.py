@@ -26,6 +26,7 @@ Env surface (reference-style env-first config, utils/env.py):
 
 from __future__ import annotations
 
+import threading
 from typing import Iterator, Optional
 
 import jax
@@ -57,6 +58,23 @@ class TPUEngine:
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
         return self.scheduler.submit(req, stats)
+
+    def warmup(self, buckets: tuple[int, ...] = (128, 256),
+               background: bool = False) -> None:
+        """Compile the serving programs (admit per chunk-size x prompt
+        bucket, decode per attention window) before real traffic arrives —
+        first-compile on TPU is tens of seconds, which would otherwise land
+        on the first users' TTFT."""
+        def _run() -> None:
+            try:
+                self.scheduler.warmup(prompt_buckets=buckets)
+            except Exception:   # noqa: BLE001 — warmup is best-effort
+                log.exception("warmup failed")
+
+        if background:
+            threading.Thread(target=_run, daemon=True, name="warmup").start()
+        else:
+            _run()
 
     def models(self) -> list[str]:
         return [self.name]
@@ -90,6 +108,11 @@ def build_engine_from_env() -> Backend:
             from ..parallel.sharding import shard_params
             params = shard_params(params, llama.param_axes(config), mesh)
         tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
-    return TPUEngine(params, config, tokenizer, num_slots=num_slots,
-                     max_seq=max_seq, mesh=mesh,
-                     name=env_or("LLM_MODEL", config.name))
+    engine = TPUEngine(params, config, tokenizer, num_slots=num_slots,
+                       max_seq=max_seq, mesh=mesh,
+                       name=env_or("LLM_MODEL", config.name))
+    warmup = env_or("SERVE_WARMUP", "128,256")
+    if warmup and warmup != "0":
+        buckets = tuple(int(b) for b in warmup.split(",") if b.strip())
+        engine.warmup(buckets, background=True)
+    return engine

@@ -12,19 +12,31 @@ Design, shaped by XLA's compilation model (SURVEY.md §7 "hard parts"):
   the decode step is one jitted program over all ``num_slots`` rows, traced
   once. Requests churn without recompilation because admission/eviction
   only changes *data* (an ``active`` mask + per-row lengths), never shapes.
-- **Admit = prefill + insert.** A new request is prefilled alone at a
-  power-of-two padded length (bounded compile cache), then its kv block is
-  spliced into the big cache at a free row with ``dynamic_update_slice``.
-  Its first token is sampled from the prefill logits immediately — TTFT
-  does not wait for the next decode tick.
+- **Fused device steps, minimal host traffic.** Sampling runs *inside* the
+  jitted programs with per-row options and per-row PRNG keys
+  (models/sampling.sample_batched), so a decode tick transfers B int32
+  tokens instead of [B, vocab] f32 logits (4 MB -> 128 bytes at B=32,
+  vocab=32k — the difference between ~10 ms and ~100 ms per tick when the
+  chip sits behind a network tunnel). Next-step input tokens and PRNG keys
+  stay resident on device; the host reads tokens only to detokenise,
+  stream, and detect stops.
+- **Admit = batched prefill + fused insert + first token.** Pending
+  requests (drained through a ~3 ms arrival-gap window so a concurrent
+  burst lands together) are grouped by power-of-two prompt bucket and
+  prefilled *together* in chunks from a two-size ladder (8 or num_slots
+  rows; short chunks carry padding entries whose writes a real entry
+  overwrites), then one fused program splices every chunk row's kv into
+  the big cache with ``dynamic_update_slice`` and samples each row's first
+  token from its prefill logits — one device dispatch + one tiny readback
+  per chunk, so TTFT does not wait for the next decode tick and a
+  32-request burst costs one dispatch, not 32.
 - **Single scheduler thread.** All device work and slot bookkeeping happen
   on one thread (the race-safety strategy SURVEY.md §5 prescribes); HTTP
-  threads communicate via queues only. Per-request sampling runs on host
-  (numpy) because every row has its own temperature/top-k/top-p/seed.
+  threads communicate via queues only.
 - **Park, don't shrink.** Finished/empty rows stay in the batch with
   ``active=False``; decode_step leaves their lengths unchanged and their
-  garbage logits are ignored (models/llama.py decode_step docstring —
-  the overwrite-before-trust invariant).
+  garbage logits/tokens are ignored (models/llama.py decode_step docstring
+  — the overwrite-before-trust invariant).
 """
 
 from __future__ import annotations
@@ -42,7 +54,7 @@ import numpy as np
 from ..models import llama
 from ..models.configs import ModelConfig
 from ..models.llama import KVCache
-from ..models.sampling import sample_np
+from ..models.sampling import sample_batched
 from ..tokenizer import Tokenizer
 from ..utils.log import get_logger
 from .backend import GenerateRequest, RequestStats
@@ -50,6 +62,7 @@ from .backend import GenerateRequest, RequestStats
 log = get_logger("serve.scheduler")
 
 _MIN_BUCKET = 16
+_MAX_ADMIT_CHUNK = 8
 
 
 def _bucket(n: int, max_seq: int) -> int:
@@ -68,8 +81,9 @@ class _Slot:
     req: GenerateRequest
     stats: Optional[RequestStats]
     out_q: "queue.Queue[Optional[str]]"
-    rng: np.random.Generator
+    seed: int
     ids: list[int] = field(default_factory=list)      # generated ids
+    prompt_ids: list[int] = field(default_factory=list)
     text: str = ""                                     # decoded from ids[:decoded_upto]
     decoded_upto: int = 0                              # ids already folded into text
     streamed: int = 0                                  # len of text already yielded
@@ -88,7 +102,8 @@ class _Slot:
 
 
 class BatchScheduler:
-    """Owns the device state (params, KV cache) and the decode loop."""
+    """Owns the device state (params, KV cache, per-row sampling state)
+    and the decode loop."""
 
     def __init__(self, params: dict, config: ModelConfig,
                  tokenizer: Tokenizer, num_slots: int = 8,
@@ -99,44 +114,163 @@ class BatchScheduler:
         self.max_seq = min(max_seq, config.max_seq_len)
         self.mesh = mesh
         self._params = params
-        dtype = params["embed"].dtype
+        self._dtype = params["embed"].dtype
 
-        self._cache = KVCache.create(config, num_slots, self.max_seq, dtype)
-        self._next_tokens = np.zeros((num_slots, 1), np.int32)
         self._slots: list[Optional[_Slot]] = [None] * num_slots
         self._stop_ids = set(config.eos_token_ids)
         eos = getattr(tokenizer, "eos_id", None)
         if eos is not None and 0 <= eos < config.vocab_size:
             self._stop_ids.add(eos)
 
+        self._reset_device_state()
+
         self._admit_q: "queue.Queue[Optional[_Slot]]" = queue.Queue()
         self._closed = threading.Event()
 
-        # Jitted programs. Shapes: decode is compiled once; prefill/insert
-        # once per power-of-two prompt bucket.
-        def _prefill(params, tokens, lens, cache):
-            return llama.prefill(params, config, tokens, lens, cache, mesh)
+        # Jitted programs. decode is compiled once; admit once per
+        # (chunk-rows, prompt-bucket) shape pair — both power-of-two
+        # bucketed, so the compile cache stays small.
+        def _make_decode(kv_window: int):
+            def _decode(params, tokens, cache, active, temps, top_ks, top_ps,
+                        keys):
+                logits, cache = llama.decode_step(params, config, tokens,
+                                                  cache, mesh, active=active,
+                                                  kv_window=kv_window)
+                toks, keys = sample_batched(logits[:, 0, :], keys, temps,
+                                            top_ks, top_ps)
+                # Parked rows keep their previous input token so their
+                # (ignored) next step stays stable regardless of their
+                # garbage sample.
+                next_tokens = jnp.where(active[:, None], toks[:, None], tokens)
+                return toks, next_tokens, cache, keys
+            return jax.jit(_decode, donate_argnums=(1, 2, 7))
 
-        def _decode(params, tokens, cache, active):
-            return llama.decode_step(params, config, tokens, cache, mesh,
-                                     active=active)
+        self._make_decode = _make_decode
+        self._decode_programs: dict[int, object] = {}
 
-        def _insert(cache: KVCache, small: KVCache, row, length) -> KVCache:
-            k = jax.lax.dynamic_update_slice(
-                cache.k, small.k, (0, row, 0, 0, 0))
-            v = jax.lax.dynamic_update_slice(
-                cache.v, small.v, (0, row, 0, 0, 0))
-            lengths = jax.lax.dynamic_update_slice(
-                cache.lengths, length[None].astype(cache.lengths.dtype), (row,))
-            return KVCache(k, v, lengths)
+        def _admit_batch(params, tokens, ints, floats, cache, keys,
+                         next_tokens, temps, top_ks, top_ps):
+            """Prefill R prompts together, splice each row's kv into the big
+            cache, and sample each row's first token. R comes from a
+            two-size ladder (short chunks carry padding entries aimed at a
+            real entry's row but written *before* it, so the real write
+            wins); S is the prompt bucket — two compiled programs per
+            bucket. All per-row updates are sequentially unrolled: a vector
+            scatter with duplicate row indices has undefined write order.
 
-        self._prefill_j = jax.jit(_prefill)
-        self._decode_j = jax.jit(_decode, donate_argnums=(2,))
-        self._insert_j = jax.jit(_insert, donate_argnums=(0,))
+            Host scalars arrive packed (``ints`` [4,R] = lens/rows/seeds/
+            top_k, ``floats`` [2,R] = temperature/top_p): every separate
+            H2D upload costs a tunnel round-trip, so the dispatch carries
+            three arrays, not eight."""
+            R, S = tokens.shape
+            lens, rows, seeds, chunk_tks = ints[0], ints[1], ints[2], ints[3]
+            chunk_temps, chunk_tps = floats[0], floats[1]
+            small = KVCache.create(config, R, S, dtype=self._dtype)
+            logits, small = llama.prefill(params, config, tokens, lens,
+                                          small, mesh)
+            last = jnp.take_along_axis(
+                logits, (lens - 1)[:, None, None], axis=1)[:, 0, :]   # [R,V]
+            row_keys = jax.vmap(jax.random.PRNGKey)(seeds)
+            toks, row_keys = sample_batched(last, row_keys, chunk_temps,
+                                            chunk_tks, chunk_tps)
+
+            k, v, lengths = cache.k, cache.v, cache.lengths
+            for r in range(R):      # static unroll, R == _MAX_ADMIT_CHUNK
+                k = jax.lax.dynamic_update_slice(
+                    k, small.k[:, r: r + 1], (0, rows[r], 0, 0, 0))
+                v = jax.lax.dynamic_update_slice(
+                    v, small.v[:, r: r + 1], (0, rows[r], 0, 0, 0))
+                lengths = lengths.at[rows[r]].set(lens[r].astype(lengths.dtype))
+                keys = keys.at[rows[r]].set(row_keys[r])
+                next_tokens = next_tokens.at[rows[r], 0].set(toks[r])
+                temps = temps.at[rows[r]].set(chunk_temps[r])
+                top_ks = top_ks.at[rows[r]].set(chunk_tks[r])
+                top_ps = top_ps.at[rows[r]].set(chunk_tps[r])
+            cache = KVCache(k, v, lengths)
+            return toks, cache, keys, next_tokens, temps, top_ks, top_ps
+
+        self._admit_j = jax.jit(_admit_batch,
+                                donate_argnums=(4, 5, 6, 7, 8, 9))
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="batch-scheduler")
         self._thread.start()
+
+    def _decode_for(self, window: int):
+        """Jitted decode program for a static attention-read window
+        (compiled once per power-of-two window)."""
+        p = self._decode_programs.get(window)
+        if p is None:
+            p = self._make_decode(window)
+            self._decode_programs[window] = p
+        return p
+
+    def _window(self) -> int:
+        """Smallest power-of-two (>= 128, <= max_seq) attention window
+        covering every active row's context + the slot being written."""
+        need = 1 + max(s.ctx_len for s in self._slots if s is not None)
+        w = min(128, self.max_seq)
+        while w < need:
+            w *= 2
+        return min(w, self.max_seq)
+
+    def warmup(self, prompt_buckets: tuple[int, ...] = (128, 256),
+               chunk_sizes: Optional[tuple[int, ...]] = None,
+               windows: Optional[tuple[int, ...]] = None) -> None:
+        """Pre-compile the serving programs on synthetic throwaway buffers
+        (first compile is tens of seconds on TPU — it must not land on real
+        requests' TTFT). Compiles one admit program per (chunk size, prompt
+        bucket) and one decode program per attention window; the live
+        device state is untouched (synthetic buffers are donated and
+        discarded)."""
+        if chunk_sizes is None:
+            chunk_sizes = tuple(sorted({_MAX_ADMIT_CHUNK,
+                                        max(self.num_slots, _MAX_ADMIT_CHUNK)}))
+        buckets = sorted({_bucket(b, self.max_seq) for b in prompt_buckets})
+        if windows is None:
+            # The whole ladder up to max_seq: any window left uncompiled
+            # would lazily compile mid-serving the first time a context
+            # grows into it, stalling every active stream for the compile.
+            w, ws = min(128, self.max_seq), set()
+            while True:
+                ws.add(w)
+                if w >= self.max_seq:
+                    break
+                w *= 2
+            windows = tuple(sorted(ws))
+        B = self.num_slots
+        for R in chunk_sizes:
+            for S in buckets:
+                cache = KVCache.create(self.config, B, self.max_seq, self._dtype)
+                ints = np.ones((4, R), np.int32)
+                self._admit_j(
+                    self._params, jnp.zeros((R, S), jnp.int32),
+                    jnp.asarray(ints), jnp.ones((2, R), jnp.float32),
+                    cache, jnp.zeros((B, 2), jnp.uint32),
+                    jnp.zeros((B, 1), jnp.int32), jnp.zeros((B,), jnp.float32),
+                    jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32))
+        for w in windows:
+            cache = KVCache.create(self.config, B, self.max_seq, self._dtype)
+            self._decode_for(w)(
+                self._params, jnp.zeros((B, 1), jnp.int32), cache,
+                jnp.zeros((B,), bool), jnp.zeros((B,), jnp.float32),
+                jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
+                jnp.zeros((B, 2), jnp.uint32))
+        log.info("warmup compiled: admit %s x buckets %s, decode windows %s",
+                 chunk_sizes, buckets, windows)
+
+    def _reset_device_state(self) -> None:
+        B = self.num_slots
+        self._cache = KVCache.create(self.config, B, self.max_seq, self._dtype)
+        self._next_dev = jnp.zeros((B, 1), jnp.int32)
+        self._keys = jnp.zeros((B, 2), jnp.uint32)
+        # Per-row sampling options live on device; admission scatters them
+        # so decode ticks upload nothing but the active mask.
+        self._temps_dev = jnp.zeros((B,), jnp.float32)
+        self._top_ks_dev = jnp.zeros((B,), jnp.int32)
+        self._top_ps_dev = jnp.ones((B,), jnp.float32)
+        self._active_host: tuple = ()
+        self._active_dev = jnp.zeros((B,), bool)
 
     # -- client side (HTTP threads) ------------------------------------------
 
@@ -148,9 +282,8 @@ class BatchScheduler:
             raise RuntimeError("scheduler is stopped")
         opts = req.options
         seed = opts.seed if opts.seed is not None else time.monotonic_ns()
-        slot = _Slot(req=req, stats=stats,
-                     out_q=queue.Queue(),
-                     rng=np.random.default_rng(seed))
+        slot = _Slot(req=req, stats=stats, out_q=queue.Queue(),
+                     seed=int(seed) % (2 ** 31))
         self._admit_q.put(slot)
         if self._closed.is_set():
             # stop() may have drained the queue between our closed-check and
@@ -209,88 +342,147 @@ class BatchScheduler:
     def _free_rows(self) -> list[int]:
         return [i for i, s in enumerate(self._slots) if s is None]
 
-    def _admit_pending(self, block: bool) -> None:
-        """Move requests from the admission queue into free rows. Blocks
-        when the batch is empty (nothing to decode until work arrives)."""
-        free = self._free_rows()
-        while free:
+    def _collect_pending(self, limit: int, block: bool) -> list[_Slot]:
+        """Pull up to ``limit`` admittable requests off the queue; tokenize
+        and budget them host-side. Blocks only when the batch is empty."""
+        out: list[_Slot] = []
+        while len(out) < limit:
             try:
-                slot = self._admit_q.get(block=block, timeout=0.2 if block else None)
+                # Once the first request is in hand, keep draining through a
+                # short arrival gap (3 ms): a concurrent burst lands in ONE
+                # big-chunk admission instead of fragmenting into serial
+                # small chunks; a lone request pays at most the gap.
+                timeout = 0.2 if (block and not out) else (0.003 if out else None)
+                slot = self._admit_q.get(block=timeout is not None,
+                                         timeout=timeout)
             except queue.Empty:
-                return
-            block = False
-            if slot is None:
-                return
+                break
+            if slot is None or self._closed.is_set():
+                if slot is not None:
+                    # Already dequeued: stop()'s drain can no longer see it,
+                    # so finish it here or its consumer hangs forever.
+                    slot.finish()
+                break
             if slot.cancelled.is_set():
                 continue
-            row = free.pop(0)
-            try:
-                self._admit(slot, row)
-            except Exception:   # noqa: BLE001
-                log.exception("admission failed for request %s",
-                              slot.req.request_id)
-                slot.finish()
-                self._slots[row] = None
-                free.insert(0, row)
-                self._recover_cache()
+            opts = slot.req.options
+            ids = self.tokenizer.encode(slot.req.prompt, add_bos=True)
+            # Context budget: keep the prompt tail (recent context wins, the
+            # same truncation direction Ollama applies), leave room to
+            # generate.
+            max_prompt = self.max_seq - 2
+            if len(ids) > max_prompt:
+                ids = ids[-max_prompt:]
+            budget = self.max_seq - 1 - len(ids)
+            # Ollama semantics: num_predict <= 0 means "until EOS / context
+            # full", not "almost nothing".
+            want = opts.max_tokens if opts.max_tokens > 0 else budget
+            slot.max_new = max(1, min(want, budget))
+            slot.prompt_ids = ids
+            if slot.stats is not None:
+                slot.stats.prompt_tokens = len(ids)
+            out.append(slot)
+        return out
 
-    def _admit(self, slot: _Slot, row: int) -> None:
-        """Prefill the prompt alone, splice its kv into row ``row``, and
-        emit the first token."""
-        opts = slot.req.options
-        ids = self.tokenizer.encode(slot.req.prompt, add_bos=True)
-        # Context budget: keep the prompt tail (recent context wins, the
-        # same truncation direction Ollama applies), leave room to generate.
-        max_prompt = self.max_seq - 2
-        if len(ids) > max_prompt:
-            ids = ids[-max_prompt:]
-        budget = self.max_seq - 1 - len(ids)
-        # Ollama semantics: num_predict <= 0 means "until EOS / context
-        # full", not "almost nothing".
-        want = opts.max_tokens if opts.max_tokens > 0 else budget
-        slot.max_new = max(1, min(want, budget))
-        if slot.stats is not None:
-            slot.stats.prompt_tokens = len(ids)
+    def _admit_pending(self, block: bool) -> None:
+        """Admit pending requests into free rows: group by prompt bucket,
+        prefill each group in power-of-two chunks (one fused dispatch per
+        chunk)."""
+        free = self._free_rows()
+        if not free:
+            return
+        pending = self._collect_pending(len(free), block)
+        if not pending:
+            return
+        by_bucket: dict[int, list[_Slot]] = {}
+        for s in pending:
+            by_bucket.setdefault(_bucket(len(s.prompt_ids), self.max_seq),
+                                 []).append(s)
+        for S, group in sorted(by_bucket.items()):
+            while group:
+                # A backlog burst is admitted through the full-width program
+                # (one prefill for up to num_slots requests) instead of
+                # queueing behind _MAX_ADMIT_CHUNK-sized dispatches.
+                R = (max(self.num_slots, _MAX_ADMIT_CHUNK)
+                     if len(group) > _MAX_ADMIT_CHUNK else _MAX_ADMIT_CHUNK)
+                chunk = group[:R]
+                group = group[R:]
+                rows = [free.pop(0) for _ in range(len(chunk))]
+                try:
+                    self._admit_chunk(chunk, rows, S, R)
+                except Exception:   # noqa: BLE001
+                    log.exception("admission failed for %d request(s)",
+                                  len(chunk))
+                    for s in chunk:
+                        s.finish()
+                    for r in rows:
+                        self._slots[r] = None
+                        free.append(r)
+                    self._recover_cache()
 
-        S = _bucket(len(ids), self.max_seq)
-        tokens = np.zeros((1, S), np.int32)
-        tokens[0, : len(ids)] = ids
-        small = KVCache.create(self.config, 1, S, self._params["embed"].dtype)
-        logits, small = self._prefill_j(self._params, jnp.asarray(tokens),
-                                        jnp.asarray([len(ids)]), small)
-        self._cache = self._insert_j(self._cache, small,
-                                     jnp.int32(row), jnp.int32(len(ids)))
+    def _admit_chunk(self, chunk: list[_Slot], rows: list[int], S: int,
+                     R: int = _MAX_ADMIT_CHUNK) -> None:
+        """One fused dispatch: batched prefill of ``chunk`` + kv splice into
+        ``rows`` + first-token sample per row.
 
-        first = sample_np(np.asarray(logits[0, len(ids) - 1]), slot.rng,
-                          opts.temperature, opts.top_k, opts.top_p)
-        if slot.stats is not None:
-            slot.stats.ttft_s = time.monotonic() - slot.req.arrival_time
-        slot.ctx_len = len(ids)
-        self._slots[row] = slot
-        self._next_tokens[row, 0] = first
-        if not self._append_token(slot, row, first):
-            # finished on the very first token (eos / limits)
-            self._release(row)
+        The program shape is (R, S) with R from a two-size ladder: short
+        chunks are padded with dummy entries that *precede* the real ones
+        and aim at the first real row, so the real (later,
+        sequentially-unrolled) writes win and only two programs per prompt
+        bucket are ever compiled."""
+        pad = R - len(chunk)
+        tokens = np.zeros((R, S), np.int32)
+        ints = np.zeros((4, R), np.int32)           # lens/rows/seeds/top_k
+        floats = np.zeros((2, R), np.float32)       # temperature/top_p
+        ints[0] = 1                                 # padding: 1-token prompt
+        ints[1] = rows[0]                           # padding targets row 0...
+        floats[1] = 1.0
+        for i, (slot, row) in enumerate(zip(chunk, rows)):
+            r = pad + i                             # ...real entries follow
+            tokens[r, : len(slot.prompt_ids)] = slot.prompt_ids
+            o = slot.req.options
+            ints[:, r] = (len(slot.prompt_ids), row, slot.seed, o.top_k)
+            floats[:, r] = (o.temperature, o.top_p)
+
+        (toks_dev, self._cache, self._keys, self._next_dev, self._temps_dev,
+         self._top_ks_dev, self._top_ps_dev) = self._admit_j(
+            self._params, jnp.asarray(tokens), jnp.asarray(ints),
+            jnp.asarray(floats), self._cache, self._keys, self._next_dev,
+            self._temps_dev, self._top_ks_dev, self._top_ps_dev)
+        first_toks = np.asarray(toks_dev)        # tiny sync readback
+
+        now = time.monotonic()
+        for i, (slot, row) in enumerate(zip(chunk, rows)):
+            if slot.stats is not None:
+                slot.stats.ttft_s = now - slot.req.arrival_time
+            slot.ctx_len = len(slot.prompt_ids)
+            self._slots[row] = slot
+            if not self._append_token(slot, row, int(first_toks[pad + i])):
+                # finished on the very first token (eos / limits)
+                self._release(row)
 
     def _decode_tick(self) -> None:
-        """One batched decode step: all active rows advance one token."""
-        active = np.array([s is not None for s in self._slots], bool)
-        logits, self._cache = self._decode_j(
-            self._params, jnp.asarray(self._next_tokens), self._cache,
-            jnp.asarray(active))
-        logits_h = np.asarray(logits[:, 0])    # [B, vocab] one transfer
+        """One batched decode step: all active rows advance one token.
+        One dispatch, one B-int32 readback."""
+        active = tuple(s is not None for s in self._slots)
+        if active != self._active_host:
+            # Re-upload the mask only when the active set changed (it only
+            # moves on admission/finish — not per tick).
+            self._active_host = active
+            self._active_dev = jnp.asarray(np.array(active, bool))
+        decode_j = self._decode_for(self._window())
+        toks_dev, self._next_dev, self._cache, self._keys = decode_j(
+            self._params, self._next_dev, self._cache, self._active_dev,
+            self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys)
+        toks = np.asarray(toks_dev)              # [B] int32 — tiny sync
         for row, slot in enumerate(self._slots):
             if slot is None:
                 continue
             if slot.cancelled.is_set():
                 self._release(row)
                 continue
-            opts = slot.req.options
-            tok = sample_np(logits_h[row], slot.rng, opts.temperature,
-                            opts.top_k, opts.top_p)
-            self._next_tokens[row, 0] = tok
             slot.ctx_len += 1          # decode wrote this row's next kv slot
-            if not self._append_token(slot, row, tok):
+            if not self._append_token(slot, row, int(toks[row])):
                 self._release(row)
 
     def _append_token(self, slot: _Slot, row: int, tok: int) -> bool:
@@ -363,26 +555,24 @@ class BatchScheduler:
         return False
 
     def _recover_cache(self) -> None:
-        """A failed _decode_j/_insert_j call may have consumed the donated
-        KV cache buffer; without this, every later admission dies on
-        'Array has been deleted' while the engine appears up. If the cache
-        is gone, fail any in-flight requests (their context lives in the
-        dead buffer) and start fresh."""
-        if not self._cache.k.is_deleted():
+        """A failed donated call may have consumed the KV cache (or key /
+        next-token) buffers; without this, every later admission dies on
+        'Array has been deleted' while the engine appears up. If any buffer
+        is gone, fail in-flight requests (their context lives in the dead
+        buffer) and start fresh."""
+        if not (self._cache.k.is_deleted() or self._next_dev.is_deleted()
+                or self._keys.is_deleted() or self._temps_dev.is_deleted()):
             return
-        log.warning("KV cache buffer was donated to a failed call; "
-                    "recreating and failing %d in-flight requests",
+        log.warning("device state was donated to a failed call; recreating "
+                    "and failing %d in-flight requests",
                     sum(s is not None for s in self._slots))
         for i, s in enumerate(self._slots):
             if s is not None:
                 s.finish()
                 self._slots[i] = None
-        self._cache = KVCache.create(self.config, self.num_slots,
-                                     self.max_seq, self._params["embed"].dtype)
-        self._next_tokens[:] = 0
+        self._reset_device_state()
 
     def _release(self, row: int) -> None:
         """Free a row (finish() has already been queued where a consumer is
         still listening; cancelled consumers are gone)."""
         self._slots[row] = None
-        self._next_tokens[row, 0] = 0
