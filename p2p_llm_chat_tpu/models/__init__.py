@@ -20,4 +20,19 @@ Design (TPU-first, not a port of any torch code):
 from .configs import ModelConfig, CONFIGS, get_config
 from . import llama
 
-__all__ = ["ModelConfig", "CONFIGS", "get_config", "llama"]
+
+def family_for(config: ModelConfig):
+    """The model module (llama or mixtral) implementing this config.
+
+    Both families expose the same functional surface — init_params,
+    param_axes, prefill, decode_step (identical signatures and KVCache
+    contract) — so the serving stack (serve/scheduler.py, serve/engine.py)
+    and the driver dryrun dispatch on ``config.is_moe`` alone.
+    """
+    if config.is_moe:
+        from . import mixtral
+        return mixtral
+    return llama
+
+
+__all__ = ["ModelConfig", "CONFIGS", "get_config", "llama", "family_for"]

@@ -40,6 +40,10 @@ class ModelConfig:
     # MoE (0 experts => dense MLP)
     num_experts: int = 0
     num_experts_per_tok: int = 0
+    # GShard-style per-expert capacity factor for large prefill chunks:
+    # bucket C = factor * tokens * k / num_experts. None = exact/dropless
+    # (models/mixtral.py moe_mlp; decode is always exact).
+    moe_capacity_factor: Optional[float] = None
     # token ids (llama3 defaults; byte tokenizer overrides)
     bos_token_id: int = 128000
     eos_token_ids: tuple[int, ...] = (128001, 128008, 128009)
@@ -105,6 +109,7 @@ _register(ModelConfig(
     name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
     intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
     head_dim=128, rope_theta=1e6, num_experts=8, num_experts_per_tok=2,
+    moe_capacity_factor=2.0,
     bos_token_id=1, eos_token_ids=(2,), max_seq_len=32768,
 ))
 

@@ -113,11 +113,16 @@ def param_axes(config: ModelConfig) -> dict:
 
 # -- forward ------------------------------------------------------------------
 
+def _default_mlp(x: jax.Array, lp: dict, mesh: Optional[Mesh],
+                 rules: LogicalRules) -> jax.Array:
+    return swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+
+
 def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
            positions: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
            layer: jax.Array, write_pos: jax.Array, mask: jax.Array,
            mesh: Optional[Mesh], rules: LogicalRules,
-           kv_window: Optional[int] = None):
+           kv_window: Optional[int] = None, mlp_fn=None):
     """One decoder block against the full stacked cache.
 
     h: [B,S,H]; cache_k/v: [L,B,max_seq,Hkv,D] (the whole stacked cache —
@@ -130,8 +135,13 @@ def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
     plus one read of this layer's history — not a rewrite of the stacked
     cache (which scan ys would force), and not a ``rep``× expanded read
     (attend_gqa contracts the unexpanded cache).
+
+    ``mlp_fn(x, lp, mesh, rules)`` swaps the dense SwiGLU for another MLP —
+    models/mixtral.py passes its sparse-MoE block here, so the attention/
+    cache mechanics exist in exactly one place.
     """
     B, S, _ = h.shape
+    mlp_fn = mlp_fn or _default_mlp
 
     x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
     q = (x @ lp["wq"]).reshape(B, S, config.num_heads, config.head_dim)
@@ -162,7 +172,7 @@ def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
     h = h + constrain(attn @ lp["wo"], mesh, ("batch", None, "act_embed"), rules)
 
     x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
-    mlp = swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+    mlp = mlp_fn(x, lp, mesh, rules)
     h = h + constrain(mlp, mesh, ("batch", None, "act_embed"), rules)
     return h, cache_k, cache_v
 
@@ -171,7 +181,8 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
             positions: jax.Array, cache: KVCache, mask: jax.Array,
             mesh: Optional[Mesh] = None,
             rules: LogicalRules = DEFAULT_RULES,
-            kv_window: Optional[int] = None) -> tuple[jax.Array, KVCache]:
+            kv_window: Optional[int] = None,
+            mlp_fn=None) -> tuple[jax.Array, KVCache]:
     """Shared forward: embed -> scan(blocks) -> norm -> logits.
 
     tokens/positions: [B,S]; mask: [B or 1,1,S,W] (True = attend) where W
@@ -189,7 +200,8 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
         h, ck, cv = carry
         lp, layer = xs
         h, ck, cv = _block(h, lp, config, inv_freq, positions, ck, cv,
-                           layer, positions, mask, mesh, rules, kv_window)
+                           layer, positions, mask, mesh, rules, kv_window,
+                           mlp_fn)
         return (h, ck, cv), None
 
     (h, new_k, new_v), _ = jax.lax.scan(

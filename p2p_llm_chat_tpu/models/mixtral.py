@@ -1,0 +1,230 @@
+"""Mixtral-family sparse-MoE decoder — functional JAX, TPU-first.
+
+BASELINE.json config 5 (Mixtral-8x7B with expert parallelism). The
+reference delegates all inference to Ollama (web/streamlit_app.py:91-95);
+this module is the in-tree MoE model family. The attention/cache/scan
+mechanics are llama's — :func:`forward` passes the sparse-MoE MLP into
+``llama.forward`` via its ``mlp_fn`` hook, so those mechanics exist in
+exactly one place — and only the expert MLP lives here.
+
+TPU-first choices:
+- **Scatter/gather dispatch** with static capacity buckets: each token's
+  top-k expert assignments are scattered into a ``[NE*C, H]`` bucket
+  array (linear in tokens — never a ``[T, NE, C]`` one-hot), the expert
+  FFNs run as one batched ``[NE, C, H] x [NE, H, F]`` matmul on the MXU,
+  and outputs gather back with renormalised router weights. Shapes are
+  static for fixed (T, C): routing churn never recompiles.
+- **Capacity**: ``capacity=None`` is exact/dropless (C = T; the parity and
+  decode default — decode's T = batch is tiny). For large prefill chunks,
+  ``ModelConfig.moe_capacity_factor`` bounds C at
+  ``factor * T * k / NE`` (the standard GShard-style capacity): overflow
+  tokens lose only their MLP contribution (residual carries them), and
+  bucket memory stays ~``factor/NE``-proportional instead of NE-fold.
+- **Expert parallelism** via the ``"experts": ("ep","tp")`` logical rule
+  (parallel/sharding.py): expert-stacked weights and the ``[NE, C, H]``
+  buckets shard over the expert axis; the combine's contraction becomes
+  one XLA all-reduce — the MoE twin of the Megatron per-block psum.
+- Router math in float32 (softmax over all experts, renormalised top-k),
+  matching HF MixtralSparseMoeBlock so real checkpoints work.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..parallel.sharding import LogicalRules, DEFAULT_RULES, constrain
+from .configs import ModelConfig
+from .layers import DEFAULT_COMPUTE_DTYPE, causal_mask, length_mask
+from . import llama
+from .llama import KVCache  # same cache layout/contract as the dense family
+
+# Sentinel: "derive capacity from config.moe_capacity_factor".
+_AUTO = "auto"
+
+
+# -- parameters ---------------------------------------------------------------
+
+def init_params(config: ModelConfig, key: jax.Array,
+                dtype=DEFAULT_COMPUTE_DTYPE) -> dict:
+    """Random init. Real weights come from models/weights.py (the
+    ``block_sparse_moe`` layout of HF Mixtral)."""
+    assert config.is_moe, "mixtral.init_params needs num_experts > 0"
+    ks = jax.random.split(key, 12)
+    L, H, E = config.num_layers, config.hidden_size, config.intermediate_size
+    NE = config.num_experts
+    std = H ** -0.5
+
+    def normal(k, shape, scale=std):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    params = {
+        "embed": normal(ks[0], (config.vocab_size, H), scale=1.0),
+        "layers": {
+            "attn_norm": jnp.ones((L, H), dtype),
+            "wq": normal(ks[1], (L, H, config.q_dim)),
+            "wk": normal(ks[2], (L, H, config.kv_dim)),
+            "wv": normal(ks[3], (L, H, config.kv_dim)),
+            "wo": normal(ks[4], (L, config.q_dim, H)),
+            "mlp_norm": jnp.ones((L, H), dtype),
+            "router": normal(ks[5], (L, H, NE)),
+            "w_gate": normal(ks[6], (L, NE, H, E)),
+            "w_up": normal(ks[7], (L, NE, H, E)),
+            "w_down": normal(ks[8], (L, NE, E, H)),
+        },
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = normal(ks[9], (H, config.vocab_size))
+    return params
+
+
+def param_axes(config: ModelConfig) -> dict:
+    """Logical-axis tree matching init_params. The expert-stacked FFN
+    weights shard over "experts" -> ("ep","tp") (parallel/sharding.py), so
+    Mixtral-8x7B on 8 chips keeps exactly one expert's weights per chip."""
+    axes = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": (None, "embed"),
+            "wq": (None, "embed", "heads"),
+            "wk": (None, "embed", "kv_heads"),
+            "wv": (None, "embed", "kv_heads"),
+            "wo": (None, "heads", "embed"),
+            "mlp_norm": (None, "embed"),
+            "router": (None, "embed", None),      # tiny; replicated
+            "w_gate": (None, "experts", "embed", "expert_mlp"),
+            "w_up": (None, "experts", "embed", "expert_mlp"),
+            "w_down": (None, "experts", "expert_mlp", "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+    if not config.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+# -- MoE MLP ------------------------------------------------------------------
+
+def moe_mlp(x: jax.Array, router: jax.Array, w_gate: jax.Array,
+            w_up: jax.Array, w_down: jax.Array, num_experts_per_tok: int,
+            mesh: Optional[Mesh] = None,
+            rules: LogicalRules = DEFAULT_RULES,
+            capacity: Optional[int] = None) -> jax.Array:
+    """Sparse-MoE SwiGLU via scatter/gather dispatch into capacity buckets.
+
+    x: [B,S,H]; router: [H,NE]; w_gate/w_up: [NE,H,F]; w_down: [NE,F,H].
+    ``capacity`` is the per-expert bucket size C (None = T = exact).
+    All memory is linear in tokens: the scatter index vector is [T*k] and
+    the bucket array [NE*C, H]; the expert FFN is one batched MXU matmul.
+    """
+    B, S, H = x.shape
+    NE = router.shape[-1]
+    k = num_experts_per_tok
+    T = B * S
+    C = T if capacity is None else max(1, min(capacity, T))
+    xt = x.reshape(T, H)
+
+    # Routing in f32 (HF parity: softmax over ALL experts, then top-k,
+    # then renormalise the selected weights).
+    logits = xt.astype(jnp.float32) @ router.astype(jnp.float32)   # [T,NE]
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_w, top_i = jax.lax.top_k(probs, k)                         # [T,k]
+    top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+
+    # Position-in-expert with (token, selection-slot) priority: cumsum of
+    # the selection one-hot over the t-major flattened [T*k] selections.
+    sel = jax.nn.one_hot(top_i, NE, dtype=jnp.int32)               # [T,k,NE]
+    flat = sel.reshape(T * k, NE)
+    pos = jnp.cumsum(flat, axis=0) - flat
+    slot = jnp.sum(flat * pos, axis=-1)                            # [T*k]
+    expert = top_i.reshape(T * k)
+    # Overflow (slot >= C) is aimed one past the buckets; scatter drops it
+    # and the fill-gather below returns 0 for it.
+    idx = jnp.where(slot < C, expert * C + slot, NE * C)           # [T*k]
+
+    x_rep = jnp.repeat(xt, k, axis=0)                              # [T*k,H]
+    xin = jnp.zeros((NE * C, H), xt.dtype).at[idx].set(x_rep, mode="drop")
+    xin = constrain(xin.reshape(NE, C, H), mesh,
+                    ("experts", None, "act_embed"), rules)
+    g = jax.nn.silu(jnp.einsum("ech,ehf->ecf", xin, w_gate))
+    u = jnp.einsum("ech,ehf->ecf", xin, w_up)
+    y = jnp.einsum("ecf,efh->ech", g * u, w_down)                  # [NE,C,H]
+    y = constrain(y, mesh, ("experts", None, "act_embed"), rules)
+
+    gathered = jnp.take(y.reshape(NE * C, H), idx, axis=0,
+                        mode="fill", fill_value=0)                 # [T*k,H]
+    out = jnp.sum(gathered.reshape(T, k, H).astype(jnp.float32)
+                  * top_w[..., None], axis=1)
+    return out.astype(x.dtype).reshape(B, S, H)
+
+
+# -- forward ------------------------------------------------------------------
+
+def _capacity_for(config: ModelConfig, tokens: int,
+                  capacity) -> Optional[int]:
+    """Resolve the capacity argument: _AUTO -> config.moe_capacity_factor
+    (None factor = exact/dropless)."""
+    if capacity is not _AUTO:
+        return capacity
+    f = config.moe_capacity_factor
+    if f is None:
+        return None
+    return max(1, int(f * tokens * config.num_experts_per_tok
+                      / config.num_experts))
+
+
+def _mlp_fn(config: ModelConfig, capacity: Optional[int]):
+    def fn(x, lp, mesh, rules):
+        return moe_mlp(x, lp["router"], lp["w_gate"], lp["w_up"],
+                       lp["w_down"], config.num_experts_per_tok, mesh,
+                       rules, capacity)
+    return fn
+
+
+def forward(params: dict, config: ModelConfig, tokens: jax.Array,
+            positions: jax.Array, cache: KVCache, mask: jax.Array,
+            mesh: Optional[Mesh] = None,
+            rules: LogicalRules = DEFAULT_RULES,
+            kv_window: Optional[int] = None,
+            capacity=_AUTO) -> tuple[jax.Array, KVCache]:
+    """llama.forward with the sparse-MoE MLP plugged in (same contract)."""
+    cap = _capacity_for(config, int(tokens.shape[0] * tokens.shape[1]),
+                        capacity)
+    return llama.forward(params, config, tokens, positions, cache, mask,
+                         mesh, rules, kv_window,
+                         mlp_fn=_mlp_fn(config, cap))
+
+
+def prefill(params: dict, config: ModelConfig, tokens: jax.Array,
+            prompt_lens: jax.Array, cache: KVCache,
+            mesh: Optional[Mesh] = None,
+            rules: LogicalRules = DEFAULT_RULES,
+            capacity=_AUTO) -> tuple[jax.Array, KVCache]:
+    """Same contract as llama.prefill (right-padded prompts from pos 0)."""
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    mask = causal_mask(S, cache.k.shape[2], 0)
+    logits, cache = forward(params, config, tokens, positions, cache, mask,
+                            mesh, rules, capacity=capacity)
+    return logits, cache._replace(lengths=prompt_lens.astype(jnp.int32))
+
+
+def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
+                cache: KVCache, mesh: Optional[Mesh] = None,
+                rules: LogicalRules = DEFAULT_RULES,
+                active: Optional[jax.Array] = None,
+                kv_window: Optional[int] = None) -> tuple[jax.Array, KVCache]:
+    """Same contract as llama.decode_step, including the parked-row
+    (active=False) overwrite-before-trust invariant. Decode's token count
+    T = B is small, so the MoE bucket is always exact (capacity=None)."""
+    positions = cache.lengths[:, None]
+    window = kv_window if kv_window is not None else cache.k.shape[2]
+    mask = length_mask(window, cache.lengths + 1)
+    logits, cache = forward(params, config, tokens, positions, cache, mask,
+                            mesh, rules, kv_window=kv_window, capacity=None)
+    inc = jnp.ones_like(cache.lengths) if active is None else active.astype(jnp.int32)
+    return logits, cache._replace(lengths=cache.lengths + inc)

@@ -176,13 +176,8 @@ def load_checkpoint(ckpt_dir: str, config: Optional[ModelConfig] = None,
     params = convert_hf_state_dict(state, config, dtype)
     if mesh is not None:
         if param_axes_fn is None:
-            # Import the MoE module only for MoE configs so dense-model
-            # sharded loads don't depend on it.
-            if config.is_moe:
-                from . import mixtral as _family
-            else:
-                from . import llama as _family
-            param_axes_fn = _family.param_axes
+            from . import family_for
+            param_axes_fn = family_for(config).param_axes
         axes = param_axes_fn(config)
         params = jax.tree.map(
             lambda x, a: jax.device_put(x, NamedSharding(mesh, spec_for(a, rules))),

@@ -1,0 +1,24 @@
+"""Pallas TPU kernels and the paged KV-cache machinery.
+
+The north-star serving path (BASELINE.json; SURVEY.md §7 stage 4) replaces
+the dense ``[L, B, max_seq, Hkv, D]`` cache — whose HBM footprint reserves
+``max_seq`` slots for every batch row — with a paged pool: fixed-size pages
+allocated per request for its *actual* context budget, addressed through a
+page table. Decode attention over the paged pool is a Pallas flash-decode
+kernel (ops/paged_attention.py) whose page fetches are driven by
+scalar-prefetched page-table indices, so HBM reads scale with live context,
+never with allocation.
+
+Modules:
+- :mod:`.paged_kv` — PagedKVCache pytree, host-side page allocator, and the
+  pure-JAX page write/gather ops.
+- :mod:`.paged_attention` — the Pallas decode-attention kernel (with a jnp
+  reference oracle and CPU ``interpret=True`` support for hardware-free
+  tests, per SURVEY.md §4).
+"""
+
+from .paged_kv import PagedKVCache, PageAllocator
+from .paged_attention import paged_attention, paged_attention_reference
+
+__all__ = ["PagedKVCache", "PageAllocator", "paged_attention",
+           "paged_attention_reference"]

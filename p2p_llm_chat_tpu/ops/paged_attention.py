@@ -1,0 +1,151 @@
+"""Pallas flash-decode attention over the paged KV pool.
+
+The decode-attention kernel named by the north star (BASELINE.json; the
+reference has no kernels at all — its attention lives inside Ollama,
+web/streamlit_app.py:91). One query token per batch row attends to that
+row's live context through its page table.
+
+Kernel shape (TPU-first):
+- grid ``(B, Hkv, P)`` — one program per (row, kv-head, page), pages
+  innermost so the output block is revisited and accumulation state stays
+  resident in VMEM scratch across the page walk.
+- the page pool stays in HBM (``pl.ANY``); each program's ``[page_size, D]``
+  k/v tiles are DMA'd by the BlockSpec pipeline using **scalar-prefetched
+  page-table indices** — the fetch address is data-dependent (that is the
+  whole point of paging) but known before the program body runs, so Mosaic
+  double-buffers page fetches exactly like a dense pipeline.
+- online softmax (flash accumulation) in f32: running max ``m``, running
+  sum ``l``, unnormalised accumulator ``acc`` live in VMEM scratch; the
+  GQA group's ``rep`` query heads ride the sublane dim so the per-page
+  score matmul ``[rep, D] x [D, page_size]`` lands on the MXU.
+- dead pages (beyond the row's length) are skipped with ``pl.when``; their
+  table entries point at garbage page 0 (ops/paged_kv.py), so the
+  pipeline's fetch stays in bounds.
+
+``interpret=True`` runs the same kernel on CPU for hardware-free tests
+(SURVEY.md §4); :func:`paged_attention_reference` is the jnp oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(pt_ref, len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
+            m_ref, l_ref, acc_ref, *, page_size: int, scale: float):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    num_p = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[b]
+    page_start = p * page_size
+
+    @pl.when(page_start < length)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)               # [rep, D]
+        k = k_ref[0, 0, 0].astype(jnp.float32)         # [page_size, D]
+        v = v_ref[0, 0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(                       # [rep, page_size]
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        pos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=1)
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                          # [rep, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        probs = jnp.exp(s - m_cur)                     # [rep, page_size]
+        l_ref[:, :1] = l_ref[:, :1] * alpha + jnp.sum(probs, -1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            probs, v, preferred_element_type=jnp.float32)
+        m_ref[:, :1] = m_cur
+
+    @pl.when(p == num_p - 1)
+    def _finalise():
+        # length >= 1 by the serving contract (the slot just written is
+        # always attended), so l > 0.
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("pages", "interpret"))
+def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
+                    page_table: jax.Array, lengths: jax.Array,
+                    layer: jax.Array, *, pages: int,
+                    interpret: bool = False) -> jax.Array:
+    """Decode attention for one layer over the paged pool.
+
+    q: [B, Hq, D] (one token per row); k_pages/v_pages: the full pool
+    [L, N, Hkv, page_size, D] (stays in HBM — ``layer`` selects inside the
+    index map, so no layer copy is materialised); page_table: [B, >=pages];
+    lengths: [B] tokens to attend per row (including the slot this step
+    wrote — callers pass ``cache.lengths + 1``); layer: scalar int32;
+    pages: static page-walk count (the serving window ladder:
+    ``ceil(window / page_size)``). Returns [B, Hq, D] in q.dtype.
+    """
+    B, Hq, D = q.shape
+    L, N, Hkv, page_size, _ = k_pages.shape
+    rep = Hq // Hkv
+    scale = 1.0 / (D ** 0.5)
+    pt = page_table[:, :pages].astype(jnp.int32)
+    layer = jnp.asarray(layer, jnp.int32).reshape(1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,       # page_table, lengths, layer
+        grid=(B, Hkv, pages),
+        in_specs=[
+            pl.BlockSpec((1, rep, D), lambda b, h, p, pt, ln, ly: (b, h, 0)),
+            pl.BlockSpec((1, 1, 1, page_size, D),
+                         lambda b, h, p, pt, ln, ly: (ly[0], pt[b, p], h, 0, 0)),
+            pl.BlockSpec((1, 1, 1, page_size, D),
+                         lambda b, h, p, pt, ln, ly: (ly[0], pt[b, p], h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, rep, D),
+                               lambda b, h, p, pt, ln, ly: (b, h, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, 128), jnp.float32),   # running max m
+            pltpu.VMEM((rep, 128), jnp.float32),   # running sum l
+            pltpu.VMEM((rep, D), jnp.float32),     # unnormalised acc
+        ],
+    )
+    # q reshaped so the GQA group is a leading block dim: [B, Hkv, rep, D]
+    # blocks to (1, rep, D) via index (b, h, 0) over shape [B, Hkv*rep, D].
+    return pl.pallas_call(
+        functools.partial(_kernel, page_size=page_size, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=interpret,
+    )(pt, lengths.astype(jnp.int32), layer, q, k_pages, v_pages)
+
+
+def paged_attention_reference(q: jax.Array, k_pages: jax.Array,
+                              v_pages: jax.Array, page_table: jax.Array,
+                              lengths: jax.Array, layer,
+                              *, pages: int) -> jax.Array:
+    """jnp oracle: gather the pages dense, run masked GQA attention
+    (models/layers.attend_gqa). Same signature/semantics as the kernel."""
+    from ..models.layers import attend_gqa
+
+    B = q.shape[0]
+    page_size = k_pages.shape[3]
+    window = pages * page_size
+    pos = jnp.arange(window)
+    phys = page_table[:, :pages][:, pos // page_size]      # [B, window]
+    slot = jnp.broadcast_to(pos % page_size, (B, window))
+    k = k_pages[layer][phys, :, slot]                      # [B, window, Hkv, D]
+    v = v_pages[layer][phys, :, slot]
+    mask = (pos[None, :] < lengths[:, None])[:, None, None, :]  # [B,1,1,W]
+    return attend_gqa(q[:, None], k, v, mask)[:, 0]        # [B, Hq, D]

@@ -1,0 +1,182 @@
+"""Paged KV cache: page pool, page table, allocator, and write ops.
+
+Replaces the dense cache's per-row ``max_seq`` reservation (models/llama.py
+KVCache) with fixed-size pages drawn from a shared pool, so HBM holds the
+sum of live context budgets instead of ``num_slots x max_seq``. The pool
+layout is chosen for the Pallas decode kernel (ops/paged_attention.py):
+
+    k/v: [L, num_pages, Hkv, page_size, D]
+
+— one page of one kv head is a contiguous ``[page_size, D]`` tile (lane
+dim = head_dim, sublane = page slots), the kernel's DMA unit. Page 0 is a
+permanent garbage bin: padded prefill slots and parked decode rows write
+there, so masked writes never need a branch (the overwrite-before-trust
+invariant of the dense path becomes a write-to-trash invariant here).
+
+All device-side state is a pytree (works as a jit carry / donated arg);
+the allocator is host-side bookkeeping owned by the scheduler thread.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.configs import ModelConfig
+
+
+class PagedKVCache(NamedTuple):
+    """k/v: [L, num_pages, Hkv, page_size, D]; page_table: [B, max_pages]
+    (physical page id per logical page; unused entries MUST hold 0 — the
+    garbage page — so kernel-side fetches of dead pages stay in bounds);
+    lengths: [B] live tokens per row."""
+
+    k: jax.Array
+    v: jax.Array
+    page_table: jax.Array
+    lengths: jax.Array
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_pages_per_row(self) -> int:
+        return self.page_table.shape[1]
+
+    @classmethod
+    def create(cls, config: ModelConfig, batch: int, num_pages: int,
+               page_size: int, max_pages_per_row: Optional[int] = None,
+               dtype=jnp.bfloat16) -> "PagedKVCache":
+        shape = (config.num_layers, num_pages, config.num_kv_heads,
+                 page_size, config.head_dim)
+        if max_pages_per_row is None:
+            max_pages_per_row = num_pages
+        return cls(
+            k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+            page_table=jnp.zeros((batch, max_pages_per_row), jnp.int32),
+            lengths=jnp.zeros((batch,), jnp.int32),
+        )
+
+
+class PageAllocator:
+    """Host-side free-list over physical pages 1..num_pages-1 (page 0 is
+    the shared garbage bin and is never handed out). Owned by the
+    scheduler thread; no locking needed there (SURVEY.md §5 single-thread
+    scheduler discipline)."""
+
+    def __init__(self, num_pages: int, page_size: int) -> None:
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is reserved)")
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, tokens: int) -> int:
+        """Pages needed to hold ``tokens`` slots."""
+        return max(1, -(-tokens // self.page_size))
+
+    def alloc(self, n: int) -> Optional[list[int]]:
+        """n physical pages, or None if the pool can't satisfy it (caller
+        backpressures — the request waits, nothing is partially held)."""
+        if n <= 0:
+            raise ValueError(f"alloc({n}): need a positive page count")
+        if n > len(self._free):
+            return None
+        taken = self._free[-n:]
+        del self._free[-n:]
+        return taken
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if not 0 < p < self.num_pages:
+                raise ValueError(f"freeing invalid page {p}")
+        self._free.extend(pages)
+
+
+# -- device-side write ops (pure JAX; used inside jitted serving programs) ----
+
+def write_prefill(cache: PagedKVCache, layer_k: jax.Array, layer_v: jax.Array,
+                  rows: jax.Array, lens: jax.Array) -> PagedKVCache:
+    """Splice a dense prefill chunk's KV into the pool.
+
+    layer_k/v: [L, R, S, Hkv, D] (the small dense cache a prefill chunk
+    produced — serve/scheduler.py admission path); rows: [R] target batch
+    rows; lens: [R] valid tokens per chunk row. Positions past ``lens`` are
+    routed to garbage page 0 slot 0; valid positions go to the page/slot
+    the row's page table maps them to. The row's page_table entries must
+    already be set (set_row_table).
+    """
+    L, R, S, Hkv, D = layer_k.shape
+    ps = cache.page_size
+    pos = jnp.arange(S)[None, :]                       # [1,S]
+    valid = pos < lens[:, None]                        # [R,S]
+    logical = pos // ps                                # [1,S] -> broadcast [R,S]
+    logical = jnp.broadcast_to(logical, (R, S))
+    phys = jnp.take_along_axis(cache.page_table[rows], logical, axis=1)  # [R,S]
+    phys = jnp.where(valid, phys, 0)
+    slot = jnp.where(valid, jnp.broadcast_to(pos % ps, (R, S)), 0)
+
+    # [L,R,S,Hkv,D] -> scatter at (layer, phys, :, slot, :). Advanced
+    # indices (phys, slot) sit around the Hkv slice, so the indexed result
+    # is [R,S,Hkv,D] per layer; keep the layer axis with a leading slice.
+    k = cache.k.at[:, phys, :, slot].set(
+        jnp.moveaxis(layer_k, 0, 2), mode="drop")      # [R,S,L,Hkv,D] update
+    v = cache.v.at[:, phys, :, slot].set(
+        jnp.moveaxis(layer_v, 0, 2), mode="drop")
+    lengths = cache.lengths.at[rows].set(lens.astype(cache.lengths.dtype))
+    return cache._replace(k=k, v=v, lengths=lengths)
+
+
+def write_decode(cache: PagedKVCache, layer: jax.Array, k: jax.Array,
+                 v: jax.Array) -> PagedKVCache:
+    """Write one decode step's k/v for every row into its current slot.
+
+    k/v: [B, Hkv, D]; row b writes page ``page_table[b, lengths[b]//ps]``
+    slot ``lengths[b] % ps`` of ``layer``. Parked rows (whose length the
+    caller will not advance) overwrite the same slot next step — and their
+    page-table entry for a never-grown row is 0, the garbage bin.
+    """
+    B = k.shape[0]
+    ps = cache.page_size
+    logical = cache.lengths // ps                      # [B]
+    phys = jnp.take_along_axis(cache.page_table, logical[:, None],
+                               axis=1)[:, 0]           # [B]
+    slot = cache.lengths % ps
+    new_k = cache.k.at[layer, phys, :, slot].set(k, mode="drop")
+    new_v = cache.v.at[layer, phys, :, slot].set(v, mode="drop")
+    return cache._replace(k=new_k, v=new_v)
+
+
+def set_row_table(cache: PagedKVCache, row: int | jax.Array,
+                  pages: jax.Array) -> PagedKVCache:
+    """Install a row's page map (host-allocated physical ids, padded with
+    0s to max_pages_per_row) and reset its length to 0."""
+    table = cache.page_table.at[row].set(pages.astype(jnp.int32))
+    return cache._replace(page_table=table,
+                          lengths=cache.lengths.at[row].set(0))
+
+
+def gather_dense(cache: PagedKVCache, layer: int, max_seq: int,
+                 ) -> tuple[jax.Array, jax.Array]:
+    """Materialise one layer back to dense [B, max_seq, Hkv, D] (test
+    oracle / debugging only — defeats the point in production)."""
+    ps = cache.page_size
+    pos = jnp.arange(max_seq)
+    logical = pos // ps                                # [max_seq]
+    B = cache.page_table.shape[0]
+    phys = cache.page_table[:, logical]                # [B, max_seq]
+    slot = jnp.broadcast_to(pos % ps, (B, max_seq))
+    k = cache.k[layer][phys, :, slot]                  # [B, max_seq, Hkv, D]
+    v = cache.v[layer][phys, :, slot]
+    return k, v

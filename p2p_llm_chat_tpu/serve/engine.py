@@ -32,7 +32,7 @@ from typing import Iterator, Optional
 import jax
 
 from ..models.configs import get_config
-from ..models import llama
+from ..models import family_for
 from ..models.weights import load_checkpoint
 from ..tokenizer import ByteTokenizer, load_tokenizer
 from ..utils.env import env_int, env_or
@@ -103,10 +103,11 @@ def build_engine_from_env() -> Backend:
         config = get_config(env_or("MODEL_CONFIG", "tiny"))
         log.info("no CKPT_DIR set: serving random-init %s with byte tokenizer",
                  config.name)
-        params = llama.init_params(config, jax.random.PRNGKey(0))
+        family = family_for(config)
+        params = family.init_params(config, jax.random.PRNGKey(0))
         if mesh is not None:
             from ..parallel.sharding import shard_params
-            params = shard_params(params, llama.param_axes(config), mesh)
+            params = shard_params(params, family.param_axes(config), mesh)
         tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
     engine = TPUEngine(params, config, tokenizer, num_slots=num_slots,
                        max_seq=max_seq, mesh=mesh,

@@ -51,7 +51,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models import llama
+from ..models import family_for
 from ..models.configs import ModelConfig
 from ..models.llama import KVCache
 from ..models.sampling import sample_batched
@@ -115,6 +115,10 @@ class BatchScheduler:
         self.mesh = mesh
         self._params = params
         self._dtype = params["embed"].dtype
+        # llama or mixtral — same functional surface (models.family_for),
+        # so dense and MoE configs serve through one scheduler.
+        self._model = family_for(config)
+        model = self._model
 
         self._slots: list[Optional[_Slot]] = [None] * num_slots
         self._stop_ids = set(config.eos_token_ids)
@@ -133,7 +137,7 @@ class BatchScheduler:
         def _make_decode(kv_window: int):
             def _decode(params, tokens, cache, active, temps, top_ks, top_ps,
                         keys):
-                logits, cache = llama.decode_step(params, config, tokens,
+                logits, cache = model.decode_step(params, config, tokens,
                                                   cache, mesh, active=active,
                                                   kv_window=kv_window)
                 toks, keys = sample_batched(logits[:, 0, :], keys, temps,
@@ -166,7 +170,7 @@ class BatchScheduler:
             lens, rows, seeds, chunk_tks = ints[0], ints[1], ints[2], ints[3]
             chunk_temps, chunk_tps = floats[0], floats[1]
             small = KVCache.create(config, R, S, dtype=self._dtype)
-            logits, small = llama.prefill(params, config, tokens, lens,
+            logits, small = model.prefill(params, config, tokens, lens,
                                           small, mesh)
             last = jnp.take_along_axis(
                 logits, (lens - 1)[:, None, None], axis=1)[:, 0, :]   # [R,V]

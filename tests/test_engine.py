@@ -205,6 +205,57 @@ def test_sampling_with_seed_is_reproducible(engine):
     assert a == b
 
 
+def test_moe_family_serves_through_same_scheduler():
+    """tiny-moe through the continuous-batching loop must match a solo
+    mixtral prefill+decode oracle — the scheduler dispatches the model
+    family from the config (models.family_for), not a hardcoded llama."""
+    from p2p_llm_chat_tpu.models import mixtral
+
+    mcfg = get_config("tiny-moe")
+    mparams = mixtral.init_params(mcfg, jax.random.PRNGKey(1),
+                                  dtype=jnp.float32)
+    stop_ids = set(mcfg.eos_token_ids) | {TOK.eos_id}
+
+    def moe_oracle(prompt: str, max_new: int) -> str:
+        ids = TOK.encode(prompt, add_bos=True)
+        cache = KVCache.create(mcfg, 1, 128, jnp.float32)
+        logits, cache = mixtral.prefill(mparams, mcfg, jnp.asarray([ids]),
+                                        jnp.asarray([len(ids)]), cache)
+        last = np.asarray(logits[0, len(ids) - 1])
+        out = []
+        for _ in range(max_new):
+            t = int(last.argmax())
+            if t in stop_ids:
+                break
+            out.append(t)
+            lg, cache = mixtral.decode_step(mparams, mcfg,
+                                            jnp.asarray([[t]]), cache)
+            last = np.asarray(lg[0, 0])
+        return TOK.decode(out)
+
+    eng = TPUEngine(mparams, mcfg, TOK, num_slots=2, max_seq=128)
+    try:
+        prompts = ["moe hello", "a different moe prompt"]
+        want = {p: moe_oracle(p, 8) for p in prompts}
+        got, errs = {}, []
+
+        def worker(p):
+            try:
+                got[p] = run(eng, p, max_tokens=8)[0]
+            except Exception as e:   # noqa: BLE001
+                errs.append((p, e))
+
+        threads = [threading.Thread(target=worker, args=(p,)) for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs
+        assert got == want
+    finally:
+        eng.stop()
+
+
 def test_long_prompt_truncated_to_context():
     eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=64)
     try:

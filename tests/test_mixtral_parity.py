@@ -1,0 +1,154 @@
+"""Numerical parity vs HuggingFace transformers MixtralForCausalLM.
+
+Mirrors tests/test_llama_parity.py for the MoE family: tiny random HF
+Mixtral -> convert_hf_state_dict -> our prefill/decode logits must match
+to f32 tolerance. Covers the router (softmax-all, renormalised top-k), the
+einsum dispatch/combine expert MLP, capacity overflow semantics, and the
+KV-cache decode path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_llm_chat_tpu.models import mixtral
+from p2p_llm_chat_tpu.models.configs import ModelConfig
+from p2p_llm_chat_tpu.models.llama import KVCache
+from p2p_llm_chat_tpu.models.weights import convert_hf_state_dict
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+pytestmark = pytest.mark.model
+
+
+def make_hf_model(vocab=128, hidden=64, layers=2, heads=4, kv_heads=2,
+                  experts=4, top_k=2):
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=hidden * 2,
+        num_hidden_layers=layers, num_attention_heads=heads,
+        num_key_value_heads=kv_heads, num_local_experts=experts,
+        num_experts_per_tok=top_k, max_position_embeddings=256,
+        rope_theta=10000.0, rms_norm_eps=1e-5, sliding_window=None,
+        router_jitter_noise=0.0, attention_dropout=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.MixtralForCausalLM(hf_cfg).eval()
+    our_cfg = ModelConfig(
+        name="tiny-moe-parity", vocab_size=vocab, hidden_size=hidden,
+        intermediate_size=hidden * 2, num_layers=layers, num_heads=heads,
+        num_kv_heads=kv_heads, head_dim=hidden // heads, max_seq_len=256,
+        rope_theta=10000.0, num_experts=experts, num_experts_per_tok=top_k,
+        bos_token_id=1, eos_token_ids=(2,),
+    )
+    return model, our_cfg
+
+
+def hf_logits(model, tokens: np.ndarray) -> np.ndarray:
+    with torch.no_grad():
+        out = model(torch.from_numpy(tokens))
+    return out.logits.float().numpy()
+
+
+def our_params(model, cfg):
+    state = {k: v.float().numpy() for k, v in model.state_dict().items()}
+    return convert_hf_state_dict(state, cfg, dtype=jnp.float32)
+
+
+def test_prefill_logits_match_hf():
+    model, cfg = make_hf_model()
+    params = our_params(model, cfg)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(2, 12)).astype(np.int32)
+
+    ref = hf_logits(model, tokens)
+    cache = KVCache.create(cfg, batch=2, max_seq=32, dtype=jnp.float32)
+    ours, _ = mixtral.prefill(params, cfg, jnp.asarray(tokens),
+                              jnp.array([12, 12]), cache)
+    ours = np.asarray(ours)
+    np.testing.assert_allclose(ours, ref, atol=5e-3, rtol=2e-2)
+    np.testing.assert_array_equal(ours.argmax(-1), ref.argmax(-1))
+
+
+def test_decode_matches_prefill():
+    """Token-by-token decode through the KV cache must reproduce the full
+    prefill logits (the path serving uses)."""
+    model, cfg = make_hf_model()
+    params = our_params(model, cfg)
+    rng = np.random.default_rng(1)
+    S = 10
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, S)).astype(np.int32)
+
+    cache = KVCache.create(cfg, batch=1, max_seq=32, dtype=jnp.float32)
+    full_logits, _ = mixtral.prefill(params, cfg, jnp.asarray(tokens),
+                                     jnp.array([S]), cache)
+
+    cache = KVCache.create(cfg, batch=1, max_seq=32, dtype=jnp.float32)
+    logits0, cache = mixtral.prefill(params, cfg, jnp.asarray(tokens[:, :1]),
+                                     jnp.array([1]), cache)
+    step_logits = [np.asarray(logits0[:, 0])]
+    for t in range(1, S):
+        lg, cache = mixtral.decode_step(params, cfg,
+                                        jnp.asarray(tokens[:, t:t + 1]), cache)
+        step_logits.append(np.asarray(lg[:, 0]))
+    stepwise = np.stack(step_logits, axis=1)
+    np.testing.assert_allclose(stepwise, np.asarray(full_logits),
+                               atol=2e-4, rtol=2e-3)
+    assert int(cache.lengths[0]) == S
+
+
+def test_capacity_overflow_drops_mlp_only():
+    """With a tight expert capacity, overflow tokens lose only the MLP
+    contribution (residual stream carries on) — never NaN, never another
+    token's output. With capacity >= T, results are exact."""
+    model, cfg = make_hf_model(experts=2, top_k=1)
+    params = our_params(model, cfg)
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+
+    def run(capacity):
+        cache = KVCache.create(cfg, batch=1, max_seq=16, dtype=jnp.float32)
+        logits, _ = mixtral.prefill(params, cfg, jnp.asarray(tokens),
+                                    jnp.array([8]), cache, capacity=capacity)
+        return np.asarray(logits)
+
+    exact = run(None)
+    np.testing.assert_allclose(run(8), exact, atol=1e-6, rtol=1e-6)
+    # capacity=1: at most one token per expert keeps its MLP output.
+    tight = run(1)
+    assert np.isfinite(tight).all()
+    assert not np.allclose(tight, exact)
+
+
+def test_moe_router_weights_renormalise():
+    """The combine weights for each token must be the top-k softmax probs
+    renormalised to sum to 1 (HF MixtralSparseMoeBlock semantics) — check
+    via a router with a known argmax structure."""
+    H, NE, T = 8, 4, 5
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(1, T, H)), jnp.float32)
+    router = jnp.asarray(rng.normal(size=(H, NE)), jnp.float32)
+    # Identity-ish experts: w_gate/w_up chosen so each expert's output is a
+    # distinct constant multiple of the input.
+    w_gate = jnp.stack([jnp.eye(H) * (e + 1) for e in range(NE)]).astype(jnp.float32)
+    w_up = jnp.stack([jnp.eye(H) for _ in range(NE)]).astype(jnp.float32)
+    w_down = jnp.stack([jnp.eye(H) for _ in range(NE)]).astype(jnp.float32)
+
+    out = mixtral.moe_mlp(x, router, w_gate, w_up, w_down, 2)
+
+    logits = np.asarray(x.reshape(T, H) @ router)
+    probs = jax.nn.softmax(jnp.asarray(logits), axis=-1)
+    top_w, top_i = jax.lax.top_k(probs, 2)
+    top_w = np.asarray(top_w / top_w.sum(-1, keepdims=True))
+    top_i = np.asarray(top_i)
+    xt = np.asarray(x.reshape(T, H))
+    expected = np.zeros_like(xt)
+    for t in range(T):
+        for j in range(2):
+            e = top_i[t, j]
+            g = xt[t] * (e + 1)
+            expected[t] += top_w[t, j] * (g / (1 + np.exp(-g))) * xt[t]
+    np.testing.assert_allclose(np.asarray(out).reshape(T, H), expected,
+                               atol=1e-5, rtol=1e-5)

@@ -1,0 +1,190 @@
+"""Paged KV cache + Pallas paged-attention kernel tests (CPU).
+
+The kernel runs in ``interpret=True`` mode against two oracles (SURVEY.md
+§4 "TPU without a TPU"): the jnp reference over gathered-dense pages, and
+models/layers.attend_gqa over an equivalent dense cache. Write ops are
+checked for slot/page math, garbage-page routing, and allocator hygiene.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_llm_chat_tpu.models.configs import get_config
+from p2p_llm_chat_tpu.ops import (PageAllocator, PagedKVCache,
+                                  paged_attention, paged_attention_reference)
+from p2p_llm_chat_tpu.ops import paged_kv
+
+pytestmark = pytest.mark.model
+
+CFG = get_config("tiny")          # Hkv=2, Hq=4, D=32, L=2
+PS = 8                            # page size (slots)
+
+
+def make_cache(batch=3, num_pages=16, max_rows_pages=4):
+    return PagedKVCache.create(CFG, batch, num_pages, PS,
+                               max_pages_per_row=max_rows_pages,
+                               dtype=jnp.float32)
+
+
+def random_filled_cache(rng, lengths, num_pages=16):
+    """Cache where each row's first ``lengths[b]`` slots hold random kv,
+    installed through the real write ops (prefill splice)."""
+    B = len(lengths)
+    alloc = PageAllocator(num_pages, PS)
+    cache = make_cache(batch=B, num_pages=num_pages)
+    S = int(max(lengths))
+    L = CFG.num_layers
+    dense_k = rng.normal(size=(L, B, S, CFG.num_kv_heads,
+                               CFG.head_dim)).astype(np.float32)
+    dense_v = rng.normal(size=(L, B, S, CFG.num_kv_heads,
+                               CFG.head_dim)).astype(np.float32)
+    rows_pages = []
+    for b in range(B):
+        pages = alloc.alloc(alloc.pages_for(int(lengths[b]) + 1))
+        assert pages is not None
+        rows_pages.append(pages)
+        padded = np.zeros((cache.max_pages_per_row,), np.int32)
+        padded[: len(pages)] = pages
+        cache = paged_kv.set_row_table(cache, b, jnp.asarray(padded))
+    cache = paged_kv.write_prefill(
+        cache, jnp.asarray(dense_k), jnp.asarray(dense_v),
+        jnp.arange(B), jnp.asarray(lengths, jnp.int32))
+    return cache, dense_k, dense_v, alloc, rows_pages
+
+
+def test_allocator_basics():
+    a = PageAllocator(8, PS)
+    assert a.free_pages == 7                  # page 0 reserved
+    got = a.alloc(3)
+    assert got is not None and len(got) == 3 and 0 not in got
+    assert a.alloc(5) is None                 # only 4 left — all-or-nothing
+    assert a.free_pages == 4
+    a.free(got)
+    assert a.free_pages == 7
+    with pytest.raises(ValueError):
+        a.free([0])
+    assert a.pages_for(1) == 1
+    assert a.pages_for(PS) == 1
+    assert a.pages_for(PS + 1) == 2
+
+
+def test_write_prefill_then_gather_roundtrip():
+    rng = np.random.default_rng(0)
+    lengths = [5, 13, 1]
+    cache, dense_k, dense_v, _, _ = random_filled_cache(rng, lengths)
+    for layer in range(CFG.num_layers):
+        k, v = paged_kv.gather_dense(cache, layer, max_seq=16)
+        for b, n in enumerate(lengths):
+            np.testing.assert_array_equal(np.asarray(k[b, :n]),
+                                          dense_k[layer, b, :n])
+            np.testing.assert_array_equal(np.asarray(v[b, :n]),
+                                          dense_v[layer, b, :n])
+    assert list(np.asarray(cache.lengths)) == lengths
+
+
+def test_write_prefill_pads_go_to_garbage_page():
+    rng = np.random.default_rng(1)
+    cache, dense_k, _, _, rows_pages = random_filled_cache(rng, [3, 9])
+    # Row 0's only real page holds its 3 slots; slots 3.. of that page are
+    # untouched (zero), not clobbered by row padding.
+    p0 = rows_pages[0][0]
+    page = np.asarray(cache.k[0, p0])                 # [Hkv, PS, D]
+    np.testing.assert_array_equal(page[:, 3:], np.zeros_like(page[:, 3:]))
+
+
+def test_write_decode_appends_at_length():
+    rng = np.random.default_rng(2)
+    lengths = [5, 8]                                   # row1 exactly at a page boundary
+    cache, dense_k, dense_v, alloc, rows_pages = random_filled_cache(rng, lengths)
+    L = CFG.num_layers
+    k_new = rng.normal(size=(L, 2, CFG.num_kv_heads,
+                             CFG.head_dim)).astype(np.float32)
+    v_new = rng.normal(size=(L, 2, CFG.num_kv_heads,
+                             CFG.head_dim)).astype(np.float32)
+    for layer in range(L):
+        cache = paged_kv.write_decode(cache, jnp.asarray(layer),
+                                      jnp.asarray(k_new[layer]),
+                                      jnp.asarray(v_new[layer]))
+    cache = cache._replace(lengths=cache.lengths + 1)
+    for layer in range(L):
+        k, v = paged_kv.gather_dense(cache, layer, max_seq=16)
+        for b, n in enumerate(lengths):
+            np.testing.assert_array_equal(np.asarray(k[b, n]), k_new[layer, b])
+            np.testing.assert_array_equal(np.asarray(v[b, n]), v_new[layer, b])
+            np.testing.assert_array_equal(np.asarray(k[b, :n]),
+                                          dense_k[layer, b, :n])
+
+
+def test_parked_row_with_zero_table_writes_garbage_only():
+    """A released row (table zeroed) keeps scattering its per-step kv —
+    it must land in garbage page 0 and corrupt nothing."""
+    rng = np.random.default_rng(3)
+    cache, dense_k, _, _, _ = random_filled_cache(rng, [5, 7])
+    zeros = jnp.zeros((cache.max_pages_per_row,), jnp.int32)
+    cache = paged_kv.set_row_table(cache, 0, zeros)    # release row 0
+    junk = jnp.full((CFG.num_kv_heads, CFG.head_dim), 99.0, jnp.float32)
+    snap_k = np.asarray(cache.k[0, 1:])                # all real pages, layer 0
+    cache2 = paged_kv.write_decode(
+        cache, jnp.asarray(0),
+        jnp.stack([junk, jnp.zeros_like(junk)]),
+        jnp.stack([junk, jnp.zeros_like(junk)]))
+    # Row 1's write went to its own slot; row 0's junk went to page 0.
+    np.testing.assert_array_equal(np.asarray(cache2.k[0, 1:])
+                                  [np.asarray(cache.page_table[1, :1])[0] - 1],
+                                  snap_k[np.asarray(cache.page_table[1, :1])[0] - 1])
+    assert np.any(np.asarray(cache2.k[0, 0]) == 99.0)
+
+
+@pytest.mark.parametrize("lengths", [[1, 9, 16], [8, 8, 8], [3, 27, 1]])
+def test_kernel_matches_reference_and_dense(lengths):
+    rng = np.random.default_rng(7)
+    cache, dense_k, dense_v, _, _ = random_filled_cache(
+        rng, lengths, num_pages=32)
+    B = len(lengths)
+    q = jnp.asarray(rng.normal(size=(B, CFG.num_heads, CFG.head_dim)),
+                    jnp.float32)
+    lens = jnp.asarray(lengths, jnp.int32)
+    pages = -(-max(lengths) // PS)
+
+    for layer in range(CFG.num_layers):
+        got = paged_attention(q, cache.k, cache.v, cache.page_table, lens,
+                              jnp.asarray(layer), pages=pages, interpret=True)
+        ref = paged_attention_reference(q, cache.k, cache.v,
+                                        cache.page_table, lens, layer,
+                                        pages=pages)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        # Independent dense oracle straight from the original kv.
+        from p2p_llm_chat_tpu.models.layers import attend_gqa
+        S = int(max(lengths))
+        mask = (np.arange(S)[None, :] < np.asarray(lengths)[:, None]
+                )[:, None, None, :]
+        dense = attend_gqa(q[:, None], jnp.asarray(dense_k[layer]),
+                           jnp.asarray(dense_v[layer]),
+                           jnp.asarray(mask))[:, 0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(dense),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_kernel_ignores_garbage_table_entries_past_length():
+    """Dead page-table entries (0) beyond a row's live pages must not
+    affect the result even when the page walk covers them."""
+    rng = np.random.default_rng(8)
+    cache, _, _, _, _ = random_filled_cache(rng, [3, 20], num_pages=32)
+    # Poison the garbage page with huge values.
+    cache = cache._replace(k=cache.k.at[:, 0].set(1e4),
+                           v=cache.v.at[:, 0].set(1e4))
+    B = 2
+    q = jnp.asarray(rng.normal(size=(B, CFG.num_heads, CFG.head_dim)),
+                    jnp.float32)
+    lens = jnp.asarray([3, 20], jnp.int32)
+    got = paged_attention(q, cache.k, cache.v, cache.page_table, lens,
+                          jnp.asarray(0), pages=3, interpret=True)
+    ref = paged_attention_reference(q, cache.k, cache.v, cache.page_table,
+                                    lens, 0, pages=3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+    assert np.all(np.abs(np.asarray(got)) < 1e3)
