@@ -118,6 +118,34 @@ def _default_mlp(x: jax.Array, lp: dict, mesh: Optional[Mesh],
     return swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
 
 
+def _attn_qkv(h: jax.Array, lp: dict, config: ModelConfig,
+              inv_freq: jax.Array, positions: jax.Array,
+              mesh: Optional[Mesh], rules: LogicalRules):
+    """Pre-norm + q/k/v projections + rope. h: [B,S,H] -> q [B,S,Hq,D],
+    k/v [B,S,Hkv,D]. Shared between the dense and paged block variants."""
+    B, S, _ = h.shape
+    x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
+    q = (x @ lp["wq"]).reshape(B, S, config.num_heads, config.head_dim)
+    k = (x @ lp["wk"]).reshape(B, S, config.num_kv_heads, config.head_dim)
+    v = (x @ lp["wv"]).reshape(B, S, config.num_kv_heads, config.head_dim)
+    q = constrain(q, mesh, ("batch", None, "act_heads", None), rules)
+    k = constrain(k, mesh, ("batch", None, "act_heads", None), rules)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    return q, k, v
+
+
+def _post_attn(h: jax.Array, attn: jax.Array, lp: dict, config: ModelConfig,
+               mesh: Optional[Mesh], rules: LogicalRules, mlp_fn) -> jax.Array:
+    """Output projection + residual + MLP + residual. attn: [B,S,Hq,D]."""
+    B, S = attn.shape[:2]
+    attn = attn.reshape(B, S, config.q_dim)
+    h = h + constrain(attn @ lp["wo"], mesh, ("batch", None, "act_embed"), rules)
+    x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
+    mlp = (mlp_fn or _default_mlp)(x, lp, mesh, rules)
+    return h + constrain(mlp, mesh, ("batch", None, "act_embed"), rules)
+
+
 def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
            positions: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
            layer: jax.Array, write_pos: jax.Array, mask: jax.Array,
@@ -141,17 +169,7 @@ def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
     cache mechanics exist in exactly one place.
     """
     B, S, _ = h.shape
-    mlp_fn = mlp_fn or _default_mlp
-
-    x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
-    q = (x @ lp["wq"]).reshape(B, S, config.num_heads, config.head_dim)
-    k = (x @ lp["wk"]).reshape(B, S, config.num_kv_heads, config.head_dim)
-    v = (x @ lp["wv"]).reshape(B, S, config.num_kv_heads, config.head_dim)
-    q = constrain(q, mesh, ("batch", None, "act_heads", None), rules)
-    k = constrain(k, mesh, ("batch", None, "act_heads", None), rules)
-
-    q = apply_rope(q, positions, inv_freq)
-    k = apply_rope(k, positions, inv_freq)
+    q, k, v = _attn_qkv(h, lp, config, inv_freq, positions, mesh, rules)
 
     # Scatter this step's k/v into the carried cache at (layer, row,
     # write_pos); rows write S consecutive slots, in place.
@@ -168,13 +186,8 @@ def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
         v_layer = v_layer[:, :kv_window]
 
     attn = attend_gqa(q, k_layer, v_layer, mask)    # [B,S,H,D]
-    attn = attn.reshape(B, S, config.q_dim)
-    h = h + constrain(attn @ lp["wo"], mesh, ("batch", None, "act_embed"), rules)
-
-    x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
-    mlp = mlp_fn(x, lp, mesh, rules)
-    h = h + constrain(mlp, mesh, ("batch", None, "act_embed"), rules)
-    return h, cache_k, cache_v
+    return _post_attn(h, attn, lp, config, mesh, rules, mlp_fn), \
+        cache_k, cache_v
 
 
 def forward(params: dict, config: ModelConfig, tokens: jax.Array,
@@ -264,3 +277,61 @@ def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
                             mesh, rules, kv_window=kv_window)
     inc = jnp.ones_like(cache.lengths) if active is None else active.astype(jnp.int32)
     return logits, cache._replace(lengths=cache.lengths + inc)
+
+
+# -- paged decode (Pallas kernel path) ----------------------------------------
+
+def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
+                      cache, mesh: Optional[Mesh] = None,
+                      rules: LogicalRules = DEFAULT_RULES,
+                      active: Optional[jax.Array] = None,
+                      *, pages: int, interpret: Optional[bool] = None,
+                      mlp_fn=None):
+    """One autoregressive step over the paged KV pool (ops/paged_kv.py).
+
+    Same contract as :func:`decode_step` — including the parked-row
+    invariant, which paging strengthens: a released row's zeroed page
+    table routes its garbage writes to the shared garbage page, so parked
+    rows cannot touch any live page. Attention runs the Pallas
+    flash-decode kernel (ops/paged_attention.py) walking ``pages`` table
+    entries per row (the serving window ladder:
+    ``pages = ceil(window / page_size)``).
+
+    cache: ops.paged_kv.PagedKVCache. Returns (logits [B,1,vocab], cache
+    with lengths advanced where active).
+    """
+    from ..ops import paged_attention
+    from ..ops.paged_kv import PagedKVCache, write_decode
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    B = tokens.shape[0]
+    positions = cache.lengths[:, None]                 # [B,1]
+    h = params["embed"][tokens]
+    h = constrain(h, mesh, ("batch", None, "act_embed"), rules)
+    inv_freq = rope_frequencies(config)
+
+    def body(carry, xs):
+        h, pk, pv = carry
+        lp, layer = xs
+        q, k, v = _attn_qkv(h, lp, config, inv_freq, positions, mesh, rules)
+        step_cache = cache._replace(k=pk, v=pv)
+        step_cache = write_decode(step_cache, layer, k[:, 0], v[:, 0])
+        attn = paged_attention(q[:, 0], step_cache.k, step_cache.v,
+                               cache.page_table, cache.lengths + 1, layer,
+                               pages=pages, interpret=interpret)
+        h = _post_attn(h, attn[:, None], lp, config, mesh, rules, mlp_fn)
+        return (h, step_cache.k, step_cache.v), None
+
+    (h, new_k, new_v), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v),
+        (params["layers"], jnp.arange(config.num_layers)))
+    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    lm_head = (params["embed"].T if config.tie_embeddings
+               else params["lm_head"])
+    logits = (h @ lm_head).astype(jnp.float32)
+    logits = constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
+    inc = (jnp.ones_like(cache.lengths) if active is None
+           else active.astype(jnp.int32))
+    return logits, cache._replace(k=new_k, v=new_v,
+                                  lengths=cache.lengths + inc)

@@ -228,3 +228,16 @@ def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
                             mesh, rules, kv_window=kv_window, capacity=None)
     inc = jnp.ones_like(cache.lengths) if active is None else active.astype(jnp.int32)
     return logits, cache._replace(lengths=cache.lengths + inc)
+
+
+def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
+                      cache, mesh: Optional[Mesh] = None,
+                      rules: LogicalRules = DEFAULT_RULES,
+                      active: Optional[jax.Array] = None,
+                      *, pages: int, interpret: Optional[bool] = None):
+    """llama.decode_step_paged with the MoE MLP (same contract; decode's
+    token count is tiny, so the expert bucket stays exact)."""
+    return llama.decode_step_paged(params, config, tokens, cache, mesh,
+                                   rules, active, pages=pages,
+                                   interpret=interpret,
+                                   mlp_fn=_mlp_fn(config, None))

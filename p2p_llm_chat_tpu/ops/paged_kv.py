@@ -138,6 +138,33 @@ def write_prefill(cache: PagedKVCache, layer_k: jax.Array, layer_v: jax.Array,
     return cache._replace(k=k, v=v, lengths=lengths)
 
 
+def write_prefill_row(cache: PagedKVCache, row_k: jax.Array,
+                      row_v: jax.Array, row: jax.Array, length: jax.Array,
+                      table_row: jax.Array) -> PagedKVCache:
+    """Splice ONE request's prefill KV into the pool and install its page
+    map — the admission-program unit (serve/scheduler.py unrolls R of
+    these sequentially, so later real entries overwrite earlier padding
+    entries deterministically; padding entries pass an all-zero
+    ``table_row`` so their writes land in garbage page 0).
+
+    row_k/v: [L, S, Hkv, D]; row: scalar target batch row; length: scalar
+    valid tokens; table_row: [max_pages_per_row] physical page ids.
+    """
+    L, S, Hkv, D = row_k.shape
+    ps = cache.page_size
+    pos = jnp.arange(S)
+    valid = pos < length
+    phys = jnp.where(valid, table_row[pos // ps], 0)   # [S]
+    slot = jnp.where(valid, pos % ps, 0)
+    # cache.k: [L, N, Hkv, ps, D]; advanced indices (phys, slot) around the
+    # Hkv slice put the S axis first -> update shape [S, L, Hkv, D].
+    k = cache.k.at[:, phys, :, slot].set(jnp.moveaxis(row_k, 1, 0))
+    v = cache.v.at[:, phys, :, slot].set(jnp.moveaxis(row_v, 1, 0))
+    table = cache.page_table.at[row].set(table_row.astype(jnp.int32))
+    lengths = cache.lengths.at[row].set(length.astype(cache.lengths.dtype))
+    return cache._replace(k=k, v=v, page_table=table, lengths=lengths)
+
+
 def write_decode(cache: PagedKVCache, layer: jax.Array, k: jax.Array,
                  v: jax.Array) -> PagedKVCache:
     """Write one decode step's k/v for every row into its current slot.

@@ -21,7 +21,8 @@ Two provisioning paths (build_engine_from_env):
 
 Env surface (reference-style env-first config, utils/env.py):
 ``SERVE_BACKEND=tpu``, ``CKPT_DIR``, ``MODEL_CONFIG``, ``SERVE_SLOTS``,
-``SERVE_MAX_SEQ``, ``SERVE_TP``, ``LLM_MODEL`` (served model tag).
+``SERVE_MAX_SEQ``, ``SERVE_TP``, ``LLM_MODEL`` (served model tag),
+``SERVE_KV`` (dense|paged), ``SERVE_PAGE_SIZE``, ``SERVE_PAGES``.
 """
 
 from __future__ import annotations
@@ -48,12 +49,16 @@ class TPUEngine:
 
     def __init__(self, params: dict, config, tokenizer, *,
                  num_slots: int = 8, max_seq: int = 1024, mesh=None,
-                 name: Optional[str] = None) -> None:
+                 name: Optional[str] = None, kv_mode: str = "dense",
+                 page_size: int = 64,
+                 num_pages: Optional[int] = None) -> None:
         self.name = name or config.name
         self.config = config
         self.scheduler = BatchScheduler(params, config, tokenizer,
                                         num_slots=num_slots, max_seq=max_seq,
-                                        mesh=mesh)
+                                        mesh=mesh, kv_mode=kv_mode,
+                                        page_size=page_size,
+                                        num_pages=num_pages)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
@@ -90,6 +95,9 @@ def build_engine_from_env() -> Backend:
     num_slots = env_int("SERVE_SLOTS", 8)
     max_seq = env_int("SERVE_MAX_SEQ", 1024)
     tp = env_int("SERVE_TP", 1)
+    kv_mode = env_or("SERVE_KV", "dense")
+    page_size = env_int("SERVE_PAGE_SIZE", 64)
+    num_pages = env_int("SERVE_PAGES", 0) or None
 
     mesh = None
     if tp > 1:
@@ -110,7 +118,8 @@ def build_engine_from_env() -> Backend:
             params = shard_params(params, family.param_axes(config), mesh)
         tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
     engine = TPUEngine(params, config, tokenizer, num_slots=num_slots,
-                       max_seq=max_seq, mesh=mesh,
+                       max_seq=max_seq, mesh=mesh, kv_mode=kv_mode,
+                       page_size=page_size, num_pages=num_pages,
                        name=env_or("LLM_MODEL", config.name))
     warmup = env_or("SERVE_WARMUP", "128,256")
     if warmup and warmup != "0":

@@ -89,6 +89,8 @@ class _Slot:
     streamed: int = 0                                  # len of text already yielded
     max_new: int = 0
     ctx_len: int = 0                                   # host mirror of lengths[row]
+    ctx_budget: int = 0                                # max ctx this slot may hold
+    pages: Optional[list[int]] = None                  # paged mode: physical pages
     cancelled: threading.Event = field(default_factory=threading.Event)
 
     def push(self, delta: str) -> None:
@@ -107,12 +109,24 @@ class BatchScheduler:
 
     def __init__(self, params: dict, config: ModelConfig,
                  tokenizer: Tokenizer, num_slots: int = 8,
-                 max_seq: int = 1024, mesh=None) -> None:
+                 max_seq: int = 1024, mesh=None, kv_mode: str = "dense",
+                 page_size: int = 64,
+                 num_pages: Optional[int] = None) -> None:
+        if kv_mode not in ("dense", "paged"):
+            raise ValueError(f"kv_mode must be dense|paged, got {kv_mode!r}")
         self.config = config
         self.tokenizer = tokenizer
         self.num_slots = num_slots
         self.max_seq = min(max_seq, config.max_seq_len)
         self.mesh = mesh
+        self.kv_mode = kv_mode
+        self.page_size = page_size
+        # Default pool: the dense footprint (num_slots x max_seq) plus the
+        # garbage page — paging then wins by admitting each request at its
+        # *actual* budget, so a smaller pool (or more slots) fits the same
+        # HBM; override via num_pages / SERVE_PAGES.
+        self.num_pages = (num_pages if num_pages is not None else
+                          num_slots * -(-self.max_seq // page_size) + 1)
         self._params = params
         self._dtype = params["embed"].dtype
         # llama or mixtral — same functional surface (models.family_for),
@@ -121,6 +135,7 @@ class BatchScheduler:
         model = self._model
 
         self._slots: list[Optional[_Slot]] = [None] * num_slots
+        self._waiting: list[_Slot] = []    # paged: admitted later, no pages yet
         self._stop_ids = set(config.eos_token_ids)
         eos = getattr(tokenizer, "eos_id", None)
         if eos is not None and 0 <= eos < config.vocab_size:
@@ -137,9 +152,15 @@ class BatchScheduler:
         def _make_decode(kv_window: int):
             def _decode(params, tokens, cache, active, temps, top_ks, top_ps,
                         keys):
-                logits, cache = model.decode_step(params, config, tokens,
-                                                  cache, mesh, active=active,
-                                                  kv_window=kv_window)
+                if self.kv_mode == "paged":
+                    pages = -(-kv_window // self.page_size)
+                    logits, cache = model.decode_step_paged(
+                        params, config, tokens, cache, mesh, active=active,
+                        pages=pages)
+                else:
+                    logits, cache = model.decode_step(
+                        params, config, tokens, cache, mesh, active=active,
+                        kv_window=kv_window)
                 toks, keys = sample_batched(logits[:, 0, :], keys, temps,
                                             top_ks, top_ps)
                 # Parked rows keep their previous input token so their
@@ -193,8 +214,53 @@ class BatchScheduler:
             cache = KVCache(k, v, lengths)
             return toks, cache, keys, next_tokens, temps, top_ks, top_ps
 
-        self._admit_j = jax.jit(_admit_batch,
-                                donate_argnums=(4, 5, 6, 7, 8, 9))
+        def _admit_batch_paged(params, tokens, ints, floats, tables, cache,
+                               keys, next_tokens, temps, top_ks, top_ps):
+            """Paged-mode admission: same fused prefill/sample as
+            _admit_batch, but each chunk row's kv splices into the page
+            pool through its page map (ops/paged_kv.write_prefill_row) and
+            the map+length install rides the same program. Padding entries
+            precede real ones and carry an all-zero table, so their writes
+            land in garbage page 0 and the later real install wins."""
+            R, S = tokens.shape
+            lens, rows, seeds, chunk_tks = ints[0], ints[1], ints[2], ints[3]
+            chunk_temps, chunk_tps = floats[0], floats[1]
+            small = KVCache.create(config, R, S, dtype=self._dtype)
+            logits, small = model.prefill(params, config, tokens, lens,
+                                          small, mesh)
+            last = jnp.take_along_axis(
+                logits, (lens - 1)[:, None, None], axis=1)[:, 0, :]
+            row_keys = jax.vmap(jax.random.PRNGKey)(seeds)
+            toks, row_keys = sample_batched(last, row_keys, chunk_temps,
+                                            chunk_tks, chunk_tps)
+            from ..ops.paged_kv import write_prefill_row
+            for r in range(R):      # static unroll — sequential, pads first
+                cache = write_prefill_row(cache, small.k[:, r], small.v[:, r],
+                                          rows[r], lens[r], tables[r])
+                keys = keys.at[rows[r]].set(row_keys[r])
+                next_tokens = next_tokens.at[rows[r], 0].set(toks[r])
+                temps = temps.at[rows[r]].set(chunk_temps[r])
+                top_ks = top_ks.at[rows[r]].set(chunk_tks[r])
+                top_ps = top_ps.at[rows[r]].set(chunk_tps[r])
+            return toks, cache, keys, next_tokens, temps, top_ks, top_ps
+
+        if self.kv_mode == "paged":
+            self._admit_j = jax.jit(_admit_batch_paged,
+                                    donate_argnums=(5, 6, 7, 8, 9, 10))
+            from ..ops.paged_kv import set_row_table
+
+            def _zero_row(cache, row):
+                return set_row_table(
+                    cache, row,
+                    jnp.zeros((cache.page_table.shape[1],), jnp.int32))
+
+            # Row release: zero the table (writes re-route to the garbage
+            # page) BEFORE its pages return to the allocator — a stale
+            # parked row must never scatter into a re-allocated page.
+            self._zero_row_j = jax.jit(_zero_row, donate_argnums=(0,))
+        else:
+            self._admit_j = jax.jit(_admit_batch,
+                                    donate_argnums=(4, 5, 6, 7, 8, 9))
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="batch-scheduler")
@@ -243,18 +309,33 @@ class BatchScheduler:
                 w *= 2
             windows = tuple(sorted(ws))
         B = self.num_slots
+
+        def throwaway_cache():
+            if self.kv_mode == "paged":
+                from ..ops.paged_kv import PagedKVCache
+                return PagedKVCache.create(
+                    self.config, B, self.num_pages, self.page_size,
+                    max_pages_per_row=-(-self.max_seq // self.page_size),
+                    dtype=self._dtype)
+            return KVCache.create(self.config, B, self.max_seq, self._dtype)
+
         for R in chunk_sizes:
             for S in buckets:
-                cache = KVCache.create(self.config, B, self.max_seq, self._dtype)
+                cache = throwaway_cache()
                 ints = np.ones((4, R), np.int32)
-                self._admit_j(
-                    self._params, jnp.zeros((R, S), jnp.int32),
-                    jnp.asarray(ints), jnp.ones((2, R), jnp.float32),
-                    cache, jnp.zeros((B, 2), jnp.uint32),
-                    jnp.zeros((B, 1), jnp.int32), jnp.zeros((B,), jnp.float32),
-                    jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32))
+                args = [self._params, jnp.zeros((R, S), jnp.int32),
+                        jnp.asarray(ints), jnp.ones((2, R), jnp.float32)]
+                if self.kv_mode == "paged":
+                    args.append(jnp.zeros(
+                        (R, cache.max_pages_per_row), jnp.int32))
+                args += [cache, jnp.zeros((B, 2), jnp.uint32),
+                         jnp.zeros((B, 1), jnp.int32),
+                         jnp.zeros((B,), jnp.float32),
+                         jnp.zeros((B,), jnp.int32),
+                         jnp.ones((B,), jnp.float32)]
+                self._admit_j(*args)
         for w in windows:
-            cache = KVCache.create(self.config, B, self.max_seq, self._dtype)
+            cache = throwaway_cache()
             self._decode_for(w)(
                 self._params, jnp.zeros((B, 1), jnp.int32), cache,
                 jnp.zeros((B,), bool), jnp.zeros((B,), jnp.float32),
@@ -265,7 +346,16 @@ class BatchScheduler:
 
     def _reset_device_state(self) -> None:
         B = self.num_slots
-        self._cache = KVCache.create(self.config, B, self.max_seq, self._dtype)
+        if self.kv_mode == "paged":
+            from ..ops.paged_kv import PageAllocator, PagedKVCache
+            self._alloc = PageAllocator(self.num_pages, self.page_size)
+            self._cache = PagedKVCache.create(
+                self.config, B, self.num_pages, self.page_size,
+                max_pages_per_row=-(-self.max_seq // self.page_size),
+                dtype=self._dtype)
+        else:
+            self._cache = KVCache.create(self.config, B, self.max_seq,
+                                         self._dtype)
         self._next_dev = jnp.zeros((B, 1), jnp.int32)
         self._keys = jnp.zeros((B, 2), jnp.uint32)
         # Per-row sampling options live on device; admission scatters them
@@ -313,6 +403,9 @@ class BatchScheduler:
             if s is not None:
                 s.finish()
                 self._slots[i] = None
+        for s in self._waiting:
+            s.finish()
+        self._waiting = []
         while True:
             try:
                 s = self._admit_q.get_nowait()
@@ -383,19 +476,67 @@ class BatchScheduler:
             want = opts.max_tokens if opts.max_tokens > 0 else budget
             slot.max_new = max(1, min(want, budget))
             slot.prompt_ids = ids
+            slot.ctx_budget = self.max_seq
             if slot.stats is not None:
                 slot.stats.prompt_tokens = len(ids)
             out.append(slot)
         return out
 
+    def _try_reserve(self, slot: _Slot) -> bool:
+        """Paged mode: claim the slot's page budget (prompt + generation
+        room + the next-write slot). All-or-nothing; False = pool pressure,
+        the request waits."""
+        need = self._alloc.pages_for(len(slot.prompt_ids) + slot.max_new + 1)
+        need = min(need, self._cache.max_pages_per_row)
+        pages = self._alloc.alloc(need)
+        if pages is None:
+            return False
+        slot.pages = pages
+        slot.ctx_budget = min(need * self.page_size, self.max_seq)
+        return True
+
+    def _wait_or_fail(self, slot: _Slot) -> None:
+        """Queue a page-starved request for retry — unless it could never
+        fit even an empty pool (misconfigured pool), which fails fast."""
+        need = self._alloc.pages_for(len(slot.prompt_ids) + slot.max_new + 1)
+        if need > self.num_pages - 1:
+            log.warning("request needs %d pages but the pool only has %d; "
+                        "failing it", need, self.num_pages - 1)
+            slot.finish()
+        else:
+            self._waiting.append(slot)
+
     def _admit_pending(self, block: bool) -> None:
         """Admit pending requests into free rows: group by prompt bucket,
         prefill each group in power-of-two chunks (one fused dispatch per
-        chunk)."""
+        chunk). Paged mode first retries page-starved waiters (FIFO), then
+        pulls fresh requests while pages and rows last."""
         free = self._free_rows()
         if not free:
             return
-        pending = self._collect_pending(len(free), block)
+        pending: list[_Slot] = []
+        if self.kv_mode == "paged" and self._waiting:
+            still: list[_Slot] = []
+            for s in self._waiting:
+                if s.cancelled.is_set():
+                    continue
+                if len(pending) < len(free) and self._try_reserve(s):
+                    pending.append(s)
+                else:
+                    still.append(s)
+            self._waiting = still
+        room = len(free) - len(pending)
+        if room > 0:
+            fresh = self._collect_pending(
+                room, block and not pending and not self._waiting)
+            if self.kv_mode == "paged":
+                for s in fresh:
+                    if self._try_reserve(s):
+                        pending.append(s)
+                    else:
+                        self._wait_or_fail(s)
+            else:
+                pending.extend(fresh)
         if not pending:
             return
         by_bucket: dict[int, list[_Slot]] = {}
@@ -419,6 +560,9 @@ class BatchScheduler:
                                   len(chunk))
                     for s in chunk:
                         s.finish()
+                        if s.pages:
+                            self._alloc.free(s.pages)
+                            s.pages = None
                     for r in rows:
                         self._slots[r] = None
                         free.append(r)
@@ -448,11 +592,28 @@ class BatchScheduler:
             ints[:, r] = (len(slot.prompt_ids), row, slot.seed, o.top_k)
             floats[:, r] = (o.temperature, o.top_p)
 
-        (toks_dev, self._cache, self._keys, self._next_dev, self._temps_dev,
-         self._top_ks_dev, self._top_ps_dev) = self._admit_j(
-            self._params, jnp.asarray(tokens), jnp.asarray(ints),
-            jnp.asarray(floats), self._cache, self._keys, self._next_dev,
-            self._temps_dev, self._top_ks_dev, self._top_ps_dev)
+        if self.kv_mode == "paged":
+            # Padding entries keep an all-zero table: their prefill writes
+            # land in garbage page 0 and their (earlier) install of row 0's
+            # table is overwritten by the real entry's.
+            tables = np.zeros((R, self._cache.max_pages_per_row), np.int32)
+            for i, slot in enumerate(chunk):
+                tables[pad + i, : len(slot.pages)] = slot.pages
+            (toks_dev, self._cache, self._keys, self._next_dev,
+             self._temps_dev, self._top_ks_dev, self._top_ps_dev) = \
+                self._admit_j(
+                    self._params, jnp.asarray(tokens), jnp.asarray(ints),
+                    jnp.asarray(floats), jnp.asarray(tables), self._cache,
+                    self._keys, self._next_dev, self._temps_dev,
+                    self._top_ks_dev, self._top_ps_dev)
+        else:
+            (toks_dev, self._cache, self._keys, self._next_dev,
+             self._temps_dev, self._top_ks_dev, self._top_ps_dev) = \
+                self._admit_j(
+                    self._params, jnp.asarray(tokens), jnp.asarray(ints),
+                    jnp.asarray(floats), self._cache, self._keys,
+                    self._next_dev, self._temps_dev, self._top_ks_dev,
+                    self._top_ps_dev)
         first_toks = np.asarray(toks_dev)        # tiny sync readback
 
         now = time.monotonic()
@@ -508,8 +669,9 @@ class BatchScheduler:
             slot.finish()
             return False
         # Context full: the next decode step would write slot ctx_len,
-        # which must stay < max_seq (host mirror avoids a device sync).
-        if slot.ctx_len + 1 >= self.max_seq:
+        # which must stay < the slot's budget — max_seq for dense, the
+        # admitted page budget for paged (host mirror avoids a device sync).
+        if slot.ctx_len + 1 >= slot.ctx_budget:
             self._flush_text(slot, final=True)
             slot.finish()
             return False
@@ -578,5 +740,19 @@ class BatchScheduler:
 
     def _release(self, row: int) -> None:
         """Free a row (finish() has already been queued where a consumer is
-        still listening; cancelled consumers are gone)."""
+        still listening; cancelled consumers are gone). Paged mode zeroes
+        the row's page table on device BEFORE returning its pages to the
+        allocator — a stale parked row keeps scattering per-step garbage,
+        which must land in the garbage page, never a re-allocated one."""
+        slot = self._slots[row]
         self._slots[row] = None
+        if self.kv_mode == "paged" and slot is not None and slot.pages:
+            try:
+                self._cache = self._zero_row_j(
+                    self._cache, jnp.asarray(row, jnp.int32))
+            except Exception:   # noqa: BLE001
+                log.exception("row-table zero failed; recovering")
+                self._recover_cache()
+                return          # recovery reset the allocator wholesale
+            self._alloc.free(slot.pages)
+            slot.pages = None
