@@ -133,7 +133,8 @@ def config_from_hf_json(path: str) -> ModelConfig:
         num_layers=int(hf["num_hidden_layers"]),
         num_heads=num_heads,
         num_kv_heads=int(hf.get("num_key_value_heads", num_heads)),
-        head_dim=int(hf.get("head_dim", hf["hidden_size"] // num_heads)),
+        # Mixtral configs carry an explicit ``"head_dim": null``.
+        head_dim=int(hf.get("head_dim") or hf["hidden_size"] // num_heads),
         max_seq_len=int(hf.get("max_position_embeddings", 8192)),
         rope_theta=float(hf.get("rope_theta", 10000.0)),
         rope_scaling=rope_scaling,

@@ -55,7 +55,7 @@ def _kernel(pt_ref, len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(page_start < length)
     def _accumulate():
-        q = q_ref[0].astype(jnp.float32)               # [rep, D]
+        q = q_ref[0, 0].astype(jnp.float32)            # [rep, D]
         k = k_ref[0, 0, 0].astype(jnp.float32)         # [page_size, D]
         v = v_ref[0, 0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(                       # [rep, page_size]
@@ -78,7 +78,7 @@ def _kernel(pt_ref, len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
     def _finalise():
         # length >= 1 by the serving contract (the slot just written is
         # always attended), so l > 0.
-        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("pages", "interpret"))
@@ -103,32 +103,39 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     pt = page_table[:, :pages].astype(jnp.int32)
     layer = jnp.asarray(layer, jnp.int32).reshape(1)
 
+    # q laid out [B, Hkv, rep, D] so each program's block (1, 1, rep, D) is
+    # EQUAL to the array's trailing dims — Mosaic requires trailing block
+    # dims divisible by (8, 128) *or* equal to the full dims, and rep is
+    # small (llama3.1: 4; tiny: 2), so equality is the only layout that
+    # lowers on real TPUs.
+    q4 = q.reshape(B, Hkv, rep, D)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,       # page_table, lengths, layer
         grid=(B, Hkv, pages),
         in_specs=[
-            pl.BlockSpec((1, rep, D), lambda b, h, p, pt, ln, ly: (b, h, 0)),
+            pl.BlockSpec((1, 1, rep, D),
+                         lambda b, h, p, pt, ln, ly: (b, h, 0, 0)),
             pl.BlockSpec((1, 1, 1, page_size, D),
                          lambda b, h, p, pt, ln, ly: (ly[0], pt[b, p], h, 0, 0)),
             pl.BlockSpec((1, 1, 1, page_size, D),
                          lambda b, h, p, pt, ln, ly: (ly[0], pt[b, p], h, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, rep, D),
-                               lambda b, h, p, pt, ln, ly: (b, h, 0)),
+        out_specs=pl.BlockSpec((1, 1, rep, D),
+                               lambda b, h, p, pt, ln, ly: (b, h, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((rep, 128), jnp.float32),   # running max m
             pltpu.VMEM((rep, 128), jnp.float32),   # running sum l
             pltpu.VMEM((rep, D), jnp.float32),     # unnormalised acc
         ],
     )
-    # q reshaped so the GQA group is a leading block dim: [B, Hkv, rep, D]
-    # blocks to (1, rep, D) via index (b, h, 0) over shape [B, Hkv*rep, D].
-    return pl.pallas_call(
+    out = pl.pallas_call(
         functools.partial(_kernel, page_size=page_size, scale=scale),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep, D), q.dtype),
         interpret=interpret,
-    )(pt, lengths.astype(jnp.int32), layer, q, k_pages, v_pages)
+    )(pt, lengths.astype(jnp.int32), layer, q4, k_pages, v_pages)
+    return out.reshape(B, Hq, D)
 
 
 def paged_attention_reference(q: jax.Array, k_pages: jax.Array,

@@ -173,22 +173,16 @@ class BatchScheduler:
         self._make_decode = _make_decode
         self._decode_programs: dict[int, object] = {}
 
-        def _admit_batch(params, tokens, ints, floats, cache, keys,
-                         next_tokens, temps, top_ks, top_ps):
-            """Prefill R prompts together, splice each row's kv into the big
-            cache, and sample each row's first token. R comes from a
-            two-size ladder (short chunks carry padding entries aimed at a
-            real entry's row but written *before* it, so the real write
-            wins); S is the prompt bucket — two compiled programs per
-            bucket. All per-row updates are sequentially unrolled: a vector
-            scatter with duplicate row indices has undefined write order.
+        def _prefill_first_token(params, tokens, ints, floats):
+            """Shared admission prologue (dense and paged): batched prefill
+            of R prompts + each row's first sampled token.
 
             Host scalars arrive packed (``ints`` [4,R] = lens/rows/seeds/
             top_k, ``floats`` [2,R] = temperature/top_p): every separate
             H2D upload costs a tunnel round-trip, so the dispatch carries
             three arrays, not eight."""
             R, S = tokens.shape
-            lens, rows, seeds, chunk_tks = ints[0], ints[1], ints[2], ints[3]
+            lens, seeds = ints[0], ints[2]
             chunk_temps, chunk_tps = floats[0], floats[1]
             small = KVCache.create(config, R, S, dtype=self._dtype)
             logits, small = model.prefill(params, config, tokens, lens,
@@ -197,7 +191,23 @@ class BatchScheduler:
                 logits, (lens - 1)[:, None, None], axis=1)[:, 0, :]   # [R,V]
             row_keys = jax.vmap(jax.random.PRNGKey)(seeds)
             toks, row_keys = sample_batched(last, row_keys, chunk_temps,
-                                            chunk_tks, chunk_tps)
+                                            ints[3], chunk_tps)
+            return small, toks, row_keys
+
+        def _admit_batch(params, tokens, ints, floats, cache, keys,
+                         next_tokens, temps, top_ks, top_ps):
+            """Prefill R prompts together, splice each row's kv into the big
+            cache, and sample each row's first token. R comes from a
+            two-size ladder (short chunks carry padding entries aimed at a
+            real entry's row but written *before* it, so the real write
+            wins); S is the prompt bucket — two compiled programs per
+            bucket. All per-row updates are sequentially unrolled: a vector
+            scatter with duplicate row indices has undefined write order."""
+            R = tokens.shape[0]
+            lens, rows, chunk_tks = ints[0], ints[1], ints[3]
+            chunk_temps, chunk_tps = floats[0], floats[1]
+            small, toks, row_keys = _prefill_first_token(params, tokens,
+                                                         ints, floats)
 
             k, v, lengths = cache.k, cache.v, cache.lengths
             for r in range(R):      # static unroll, R == _MAX_ADMIT_CHUNK
@@ -222,17 +232,11 @@ class BatchScheduler:
             the map+length install rides the same program. Padding entries
             precede real ones and carry an all-zero table, so their writes
             land in garbage page 0 and the later real install wins."""
-            R, S = tokens.shape
-            lens, rows, seeds, chunk_tks = ints[0], ints[1], ints[2], ints[3]
+            R = tokens.shape[0]
+            lens, rows, chunk_tks = ints[0], ints[1], ints[3]
             chunk_temps, chunk_tps = floats[0], floats[1]
-            small = KVCache.create(config, R, S, dtype=self._dtype)
-            logits, small = model.prefill(params, config, tokens, lens,
-                                          small, mesh)
-            last = jnp.take_along_axis(
-                logits, (lens - 1)[:, None, None], axis=1)[:, 0, :]
-            row_keys = jax.vmap(jax.random.PRNGKey)(seeds)
-            toks, row_keys = sample_batched(last, row_keys, chunk_temps,
-                                            chunk_tks, chunk_tps)
+            small, toks, row_keys = _prefill_first_token(params, tokens,
+                                                         ints, floats)
             from ..ops.paged_kv import write_prefill_row
             for r in range(R):      # static unroll — sequential, pads first
                 cache = write_prefill_row(cache, small.k[:, r], small.v[:, r],
@@ -427,11 +431,7 @@ class BatchScheduler:
                 self._decode_tick()
             except Exception:   # noqa: BLE001 — fail requests, keep serving
                 log.exception("decode tick failed; failing in-flight requests")
-                for i, s in enumerate(self._slots):
-                    if s is not None:
-                        s.finish()
-                        self._slots[i] = None
-                self._recover_cache()
+                self._fail_all_and_reset()
 
     def _any_active(self) -> bool:
         return any(s is not None for s in self._slots)
@@ -520,7 +520,11 @@ class BatchScheduler:
             for s in self._waiting:
                 if s.cancelled.is_set():
                     continue
-                if len(pending) < len(free) and self._try_reserve(s):
+                # Strict FIFO: the first waiter that can't reserve blocks
+                # everyone behind it (otherwise smaller later requests leap
+                # a large one forever and it starves).
+                if (not still and len(pending) < len(free)
+                        and self._try_reserve(s)):
                     pending.append(s)
                 else:
                     still.append(s)
@@ -531,7 +535,15 @@ class BatchScheduler:
                 room, block and not pending and not self._waiting)
             if self.kv_mode == "paged":
                 for s in fresh:
-                    if self._try_reserve(s):
+                    # Strict FIFO vs page-starved waiters: once anything is
+                    # waiting for pages, fresh requests queue *behind* it —
+                    # a stream of small requests must not bypass (and so
+                    # indefinitely starve) a large waiter. _wait_or_fail
+                    # still fail-fasts never-fits requests, which must not
+                    # become permanent head-of-line blockers.
+                    if self._waiting:
+                        self._wait_or_fail(s)
+                    elif self._try_reserve(s):
                         pending.append(s)
                     else:
                         self._wait_or_fail(s)
@@ -543,7 +555,8 @@ class BatchScheduler:
         for s in pending:
             by_bucket.setdefault(_bucket(len(s.prompt_ids), self.max_seq),
                                  []).append(s)
-        for S, group in sorted(by_bucket.items()):
+        groups = sorted(by_bucket.items())
+        for gi, (S, group) in enumerate(groups):
             while group:
                 # A backlog burst is admitted through the full-width program
                 # (one prefill for up to num_slots requests) instead of
@@ -560,9 +573,18 @@ class BatchScheduler:
                                   len(chunk))
                     for s in chunk:
                         s.finish()
-                        if s.pages:
-                            self._alloc.free(s.pages)
-                            s.pages = None
+                    if self.kv_mode == "paged":
+                        # The chunk's pages may already be installed in row
+                        # tables (the failure can postdate the device call),
+                        # and every not-yet-admitted slot holds pages from
+                        # the allocator about to be reset — abort the whole
+                        # round wholesale rather than risk freeing pages a
+                        # live table still points at / double-allocating.
+                        for s in group + [x for _, g in groups[gi + 1:]
+                                          for x in g]:
+                            s.finish()
+                        self._fail_all_and_reset()
+                        return
                     for r in rows:
                         self._slots[r] = None
                         free.append(r)
@@ -720,18 +742,30 @@ class BatchScheduler:
             slot.streamed = emit_to
         return False
 
-    def _recover_cache(self) -> None:
+    def _recover_cache(self) -> bool:
         """A failed donated call may have consumed the KV cache (or key /
         next-token) buffers; without this, every later admission dies on
         'Array has been deleted' while the engine appears up. If any buffer
         is gone, fail in-flight requests (their context lives in the dead
-        buffer) and start fresh."""
+        buffer) and start fresh. Returns True when a reset happened."""
         if not (self._cache.k.is_deleted() or self._next_dev.is_deleted()
                 or self._keys.is_deleted() or self._temps_dev.is_deleted()):
-            return
+            return False
         log.warning("device state was donated to a failed call; recreating "
                     "and failing %d in-flight requests",
                     sum(s is not None for s in self._slots))
+        self._fail_all_and_reset()
+        return True
+
+    def _fail_all_and_reset(self) -> None:
+        """Error-path recovery: fail every in-flight request and rebuild the
+        device state (and, in paged mode, the page allocator) from scratch.
+        Wholesale by design — selective recovery here risks leaking pages
+        (slots cleared without ``_alloc.free``) or leaving a stale row
+        table aimed at pages the allocator has handed to a new request,
+        whose KV a parked row's per-step garbage scatter would then
+        corrupt. All compiled programs key on shapes, which don't change,
+        so the only cost is re-allocating the buffers."""
         for i, s in enumerate(self._slots):
             if s is not None:
                 s.finish()
@@ -751,8 +785,11 @@ class BatchScheduler:
                 self._cache = self._zero_row_j(
                     self._cache, jnp.asarray(row, jnp.int32))
             except Exception:   # noqa: BLE001
-                log.exception("row-table zero failed; recovering")
-                self._recover_cache()
-                return          # recovery reset the allocator wholesale
+                # Whether or not the donated cache survived, the row's
+                # table was not provably zeroed, so its pages can't go
+                # back to the allocator — reset wholesale (leak-free).
+                log.exception("row-table zero failed; resetting")
+                self._fail_all_and_reset()
+                return
             self._alloc.free(slot.pages)
             slot.pages = None

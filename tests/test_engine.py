@@ -237,8 +237,58 @@ def test_paged_pool_exhaustion_backpressures_then_completes():
             t.join(timeout=180)
         assert not errs
         assert got == want
-        # All pages returned to the pool after completion.
+        # All pages returned to the pool after completion. The consumer is
+        # unblocked (finish()) *before* the scheduler thread runs _release,
+        # so poll: the release itself includes a device dispatch.
+        deadline = time.monotonic() + 30
+        while (eng.scheduler._alloc.free_pages != 7
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
         assert eng.scheduler._alloc.free_pages == 7
+    finally:
+        eng.stop()
+
+
+def test_paged_oversized_fails_fast_even_behind_waiters():
+    """Regression: a never-fits request arriving while other requests are
+    page-starved must still fail fast — not queue behind them as a
+    permanent head-of-line blocker that deadlocks all future admissions."""
+    # 3 usable pages x 16: the holder's budget (21 prompt + 26 + 1 = 48
+    # tokens = 3 pages) pins the whole pool while it decodes.
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=3, max_seq=128,
+                    kv_mode="paged", page_size=16, num_pages=4)
+    try:
+        results = {}
+
+        def worker(name, prompt, max_tokens):
+            req = GenerateRequest(prompt=prompt,
+                                  options=GenerateOptions(max_tokens=max_tokens))
+            results[name] = "".join(eng.generate_stream(req, RequestStats()))
+
+        hold = threading.Thread(target=worker,
+                                args=("hold", "hold the pool please", 26))
+        hold.start()
+        deadline = time.monotonic() + 30
+        while eng.scheduler._alloc.free_pages > 0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+        small = threading.Thread(target=worker, args=("small", "ok", 4))
+        small.start()
+        while not eng.scheduler._waiting and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+        # Needs 128 tokens = 8 pages > 3 usable: must fail fast even though
+        # _waiting is (very likely) non-empty right now.
+        big = threading.Thread(target=worker, args=("big", "x" * 70, 60))
+        big.start()
+        big.join(timeout=60)
+        assert not big.is_alive(), "oversized request deadlocked behind waiters"
+        assert results["big"] == ""
+
+        hold.join(timeout=120)
+        small.join(timeout=120)
+        assert results["hold"] == oracle("hold the pool please", 26)
+        assert results["small"] == oracle("ok", 4)
     finally:
         eng.stop()
 
