@@ -1,0 +1,124 @@
+"""Ring attention / sequence-parallel parity vs the dense single-device
+oracle (models/llama.py), on the conftest's 8-virtual-device CPU mesh.
+
+Long-context is first-class: these pin that a prompt sharded over the
+``sp`` ring (parallel/ring.py) produces bit-for-bit-tolerance logits and a
+usable sequence-sharded KV cache for distributed decode.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_llm_chat_tpu.models import llama
+from p2p_llm_chat_tpu.models.configs import get_config
+from p2p_llm_chat_tpu.models.llama import KVCache
+from p2p_llm_chat_tpu.parallel.mesh import MeshConfig, make_mesh
+from p2p_llm_chat_tpu.parallel.ring import ring_prefill, sp_decode_step
+
+pytestmark = pytest.mark.model
+
+CFG = get_config("tiny")
+
+
+def _params():
+    return llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def _tokens(B, S, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, CFG.vocab_size, (B, S)), jnp.int32)
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_prefill_matches_dense(sp):
+    params = _params()
+    B, S = 2, 32
+    tokens = _tokens(B, S)
+    lens = jnp.array([S, S], jnp.int32)
+
+    cache = KVCache.create(CFG, B, S, dtype=jnp.float32)
+    ref, ref_cache = llama.prefill(params, CFG, tokens, lens, cache)
+
+    mesh = make_mesh(MeshConfig(sp=sp))
+    got, got_cache = ring_prefill(params, CFG, tokens, lens, mesh)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-3)
+    # The sequence-sharded cache holds the same k/v (global view).
+    np.testing.assert_allclose(np.asarray(got_cache.k), np.asarray(ref_cache.k),
+                               atol=2e-5, rtol=2e-4)
+    np.testing.assert_array_equal(np.asarray(got_cache.lengths),
+                                  np.asarray(ref_cache.lengths))
+
+
+def test_ring_prefill_ragged_rows_match_dense():
+    """Right-padded rows: causal masking keeps pads invisible; every real
+    position's logits must match the dense oracle."""
+    params = _params()
+    B, S, sp = 2, 32, 4
+    tokens = np.array(_tokens(B, S))
+    lens_np = np.array([20, 32])
+    tokens[0, 20:] = 0
+    tokens = jnp.asarray(tokens)
+    lens = jnp.asarray(lens_np, jnp.int32)
+
+    cache = KVCache.create(CFG, B, S, dtype=jnp.float32)
+    ref, _ = llama.prefill(params, CFG, tokens, lens, cache)
+    mesh = make_mesh(MeshConfig(sp=sp))
+    got, _ = ring_prefill(params, CFG, tokens, lens, mesh)
+
+    for b in range(B):
+        n = int(lens_np[b])
+        np.testing.assert_allclose(np.asarray(got)[b, :n],
+                                   np.asarray(ref)[b, :n],
+                                   atol=2e-4, rtol=2e-3)
+
+
+def test_sp_decode_matches_dense_decode():
+    """Ring prefill -> several sp decode steps == dense prefill -> dense
+    decode steps, including the parked-row (active) contract."""
+    params = _params()
+    B, S, sp, steps = 2, 32, 4, 5
+    prompt_len = 24
+    tokens = np.array(_tokens(B, S))
+    tokens[:, prompt_len:] = 0
+    tokens = jnp.asarray(tokens)
+    lens = jnp.full((B,), prompt_len, jnp.int32)
+
+    # Dense oracle: max_seq = S gives room for `steps` decode tokens.
+    cache = KVCache.create(CFG, B, S, dtype=jnp.float32)
+    ref_logits, ref_cache = llama.prefill(
+        params, CFG, tokens[:, :prompt_len], lens, cache)
+    mesh = make_mesh(MeshConfig(sp=sp))
+    got_logits, got_cache = ring_prefill(params, CFG, tokens, lens, mesh)
+    np.testing.assert_allclose(np.asarray(got_logits)[:, :prompt_len],
+                               np.asarray(ref_logits),
+                               atol=2e-4, rtol=2e-3)
+
+    active = jnp.array([True, False])
+    next_tok = jnp.argmax(np.asarray(ref_logits)[:, prompt_len - 1],
+                          axis=-1).astype(jnp.int32)[:, None]
+    for t in range(steps):
+        ref_l, ref_cache = llama.decode_step(params, CFG, next_tok,
+                                             ref_cache, active=active)
+        got_l, got_cache = sp_decode_step(params, CFG, next_tok,
+                                          got_cache, mesh, active=active)
+        # Active rows match; parked rows' logits are garbage by contract.
+        np.testing.assert_allclose(np.asarray(got_l)[:1], np.asarray(ref_l)[:1],
+                                   atol=2e-4, rtol=2e-3)
+        np.testing.assert_array_equal(np.asarray(got_cache.lengths),
+                                      np.asarray(ref_cache.lengths))
+        next_tok = jnp.argmax(np.asarray(ref_l)[:, 0], axis=-1).astype(
+            jnp.int32)[:, None]
+    assert int(got_cache.lengths[0]) == prompt_len + steps
+    assert int(got_cache.lengths[1]) == prompt_len
+
+
+def test_ring_prefill_rejects_mixed_mesh():
+    mesh = make_mesh(MeshConfig(sp=2, tp=2))
+    params = _params()
+    with pytest.raises(AssertionError):
+        ring_prefill(params, CFG, _tokens(2, 16), jnp.array([16, 16]), mesh)
