@@ -57,6 +57,8 @@ def main() -> None:
     max_seq = int(os.environ.get("BENCH_MAX_SEQ", "1024"))
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "32"))
     decode_steps = int(os.environ.get("BENCH_DECODE_STEPS", "64"))
+    kv_mode = os.environ.get("BENCH_KV", "dense")   # dense | paged
+    page_size = int(os.environ.get("BENCH_PAGE_SIZE", "64"))
 
     platform = jax.devices()[0].platform
     log(f"bench: {cfg_name} on {jax.devices()[0]} ({platform}), "
@@ -69,13 +71,37 @@ def main() -> None:
     n_params = sum(x.size for x in jax.tree.leaves(params))
     log(f"params: {n_params/1e9:.2f}B ({dtype.__name__})")
 
-    # -- raw batched decode throughput (pure device step, serving shapes) ----
-    def _step(params, tokens, cache, active):
-        return llama.decode_step(params, config, tokens, cache, active=active)
+    # -- raw batched decode throughput (pure device step, serving shapes,
+    # matching the selected kv_mode) -----------------------------------------
+    if kv_mode == "paged":
+        from p2p_llm_chat_tpu.ops.paged_kv import PagedKVCache
 
+        mppr = -(-max_seq // page_size)
+        num_pages = slots * mppr + 1
+
+        # Attention window must cover the initial 64-token context plus
+        # every decoded position, or the kernel walks a truncated page
+        # table and the paged tok/s is not comparable to dense.
+        window_pages = min(mppr, -(-(64 + decode_steps + 1) // page_size))
+
+        def _step(params, tokens, cache, active):
+            return llama.decode_step_paged(params, config, tokens, cache,
+                                           active=active, pages=window_pages)
+
+        cache = PagedKVCache.create(config, slots, num_pages, page_size,
+                                    max_pages_per_row=mppr, dtype=dtype)
+        table = (1 + jnp.arange(slots * mppr, dtype=jnp.int32)
+                 ).reshape(slots, mppr)
+        cache = cache._replace(page_table=table,
+                               lengths=jnp.full((slots,), 64, jnp.int32))
+    else:
+        def _step(params, tokens, cache, active):
+            return llama.decode_step(params, config, tokens, cache,
+                                     active=active)
+
+        cache = KVCache.create(config, slots, max_seq, dtype)
+        cache = cache._replace(lengths=jnp.full((slots,), 64, jnp.int32))
     decode_j = jax.jit(_step, donate_argnums=(2,))
-    cache = KVCache.create(config, slots, max_seq, dtype)
-    cache = cache._replace(lengths=jnp.full((slots,), 64, jnp.int32))
     toks = jnp.ones((slots, 1), jnp.int32)
     active = jnp.ones((slots,), bool)
     # NB: block_until_ready returns early on the tunneled 'axon' platform;
@@ -96,9 +122,11 @@ def main() -> None:
     del cache, logits
 
     # -- end-to-end serving: p50 TTFT at `slots` concurrent peers ------------
+    admit_chunk = int(os.environ.get("BENCH_ADMIT_CHUNK", "0")) or None
     tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
     sched = BatchScheduler(params, config, tokenizer, num_slots=slots,
-                           max_seq=max_seq)
+                           max_seq=max_seq, kv_mode=kv_mode,
+                           page_size=page_size, admit_chunk=admit_chunk)
     prompt = ("Draft a concise, friendly reply to the following message:\n\n"
               "Hey, are we still meeting tomorrow at 10?\n\nReply:")
     opts = GenerateOptions(max_tokens=new_tokens, temperature=0.7, top_p=0.9,
@@ -122,13 +150,21 @@ def main() -> None:
     ttft_single_ms = (s1.ttft_s or 0.0) * 1e3
     log(f"single-request TTFT: {ttft_single_ms:.1f} ms")
 
+    # BENCH_PROFILE=/dir captures a jax.profiler trace of the concurrent
+    # section (view with tensorboard / xprof; SURVEY.md §5 tracing plan).
+    import contextlib
+    profile_dir = os.environ.get("BENCH_PROFILE", "")
+    trace_cm = (jax.profiler.trace(profile_dir) if profile_dir
+                else contextlib.nullcontext())
+
     all_stats = [RequestStats() for _ in range(slots)]
     threads = [threading.Thread(target=run_one, args=(s,)) for s in all_stats]
     t = time.monotonic()
-    for th in threads:
-        th.start()
-    for th in threads:
-        th.join()
+    with trace_cm:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
     wall = time.monotonic() - t
     ttfts = sorted(s.ttft_s * 1e3 for s in all_stats if s.ttft_s is not None)
     done_tokens = sum(s.completion_tokens for s in all_stats)
@@ -148,6 +184,8 @@ def main() -> None:
         "vs_baseline": round(150.0 / p50, 3) if p50 > 0 else None,
         "extra": {
             "platform": platform,
+            "kv_mode": kv_mode,
+            "page_size": page_size if kv_mode == "paged" else None,
             "config": cfg_name,
             "n_params_b": round(n_params / 1e9, 3),
             "slots": slots,

@@ -51,14 +51,16 @@ class TPUEngine:
                  num_slots: int = 8, max_seq: int = 1024, mesh=None,
                  name: Optional[str] = None, kv_mode: str = "dense",
                  page_size: int = 64,
-                 num_pages: Optional[int] = None) -> None:
+                 num_pages: Optional[int] = None,
+                 admit_chunk: Optional[int] = None) -> None:
         self.name = name or config.name
         self.config = config
         self.scheduler = BatchScheduler(params, config, tokenizer,
                                         num_slots=num_slots, max_seq=max_seq,
                                         mesh=mesh, kv_mode=kv_mode,
                                         page_size=page_size,
-                                        num_pages=num_pages)
+                                        num_pages=num_pages,
+                                        admit_chunk=admit_chunk)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
@@ -98,6 +100,7 @@ def build_engine_from_env() -> Backend:
     kv_mode = env_or("SERVE_KV", "dense")
     page_size = env_int("SERVE_PAGE_SIZE", 64)
     num_pages = env_int("SERVE_PAGES", 0) or None
+    admit_chunk = env_int("SERVE_ADMIT_CHUNK", 0) or None
 
     mesh = None
     if tp > 1:
@@ -120,6 +123,7 @@ def build_engine_from_env() -> Backend:
     engine = TPUEngine(params, config, tokenizer, num_slots=num_slots,
                        max_seq=max_seq, mesh=mesh, kv_mode=kv_mode,
                        page_size=page_size, num_pages=num_pages,
+                       admit_chunk=admit_chunk,
                        name=env_or("LLM_MODEL", config.name))
     warmup = env_or("SERVE_WARMUP", "128,256")
     if warmup and warmup != "0":

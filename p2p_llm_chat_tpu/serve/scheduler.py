@@ -24,12 +24,13 @@ Design, shaped by XLA's compilation model (SURVEY.md §7 "hard parts"):
   requests (drained through a ~3 ms arrival-gap window so a concurrent
   burst lands together) are grouped by power-of-two prompt bucket and
   prefilled *together* in chunks from a two-size ladder (8 or num_slots
-  rows; short chunks carry padding entries whose writes a real entry
-  overwrites), then one fused program splices every chunk row's kv into
-  the big cache with ``dynamic_update_slice`` and samples each row's first
-  token from its prefill logits — one device dispatch + one tiny readback
-  per chunk, so TTFT does not wait for the next decode tick and a
-  32-request burst costs one dispatch, not 32.
+  rows; short chunks carry padding entries whose installs are
+  scatter-dropped via an out-of-range row sentinel), then one fused
+  program splices the whole chunk's kv into the big cache in a single
+  vector scatter and samples each row's first token from its prefill
+  logits — one device dispatch + one tiny readback per chunk, so TTFT
+  does not wait for the next decode tick and a 32-request burst costs
+  one dispatch, not 32.
 - **Single scheduler thread.** All device work and slot bookkeeping happen
   on one thread (the race-safety strategy SURVEY.md §5 prescribes); HTTP
   threads communicate via queues only.
@@ -111,9 +112,19 @@ class BatchScheduler:
                  tokenizer: Tokenizer, num_slots: int = 8,
                  max_seq: int = 1024, mesh=None, kv_mode: str = "dense",
                  page_size: int = 64,
-                 num_pages: Optional[int] = None) -> None:
+                 num_pages: Optional[int] = None,
+                 admit_chunk: Optional[int] = None) -> None:
+        """``admit_chunk``: burst-admission width. None (default) admits a
+        backlog burst through one full-width prefill (minimal dispatches —
+        best p95/throughput); a fixed power-of-two (e.g. 8) staggers the
+        burst through smaller prefills so early chunks' first tokens land
+        before the whole burst's prefill compute finishes (better p50
+        TTFT, one extra dispatch + readback per chunk)."""
         if kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {kv_mode!r}")
+        if admit_chunk is not None and admit_chunk < 1:
+            raise ValueError(f"admit_chunk must be >= 1, got {admit_chunk}")
+        self.admit_chunk = admit_chunk
         self.config = config
         self.tokenizer = tokenizer
         self.num_slots = num_slots
@@ -194,58 +205,58 @@ class BatchScheduler:
                                             ints[3], chunk_tps)
             return small, toks, row_keys
 
+        def _install_rows(rows, row_keys, toks, ints, floats, keys,
+                          next_tokens, temps, top_ks, top_ps):
+            """Vectorized per-row sampling-state installs. Padding entries
+            carry an out-of-range row sentinel (num_slots) and are dropped;
+            real rows are unique, so the scatters are order-independent."""
+            keys = keys.at[rows].set(row_keys, mode="drop")
+            next_tokens = next_tokens.at[rows, 0].set(toks, mode="drop")
+            temps = temps.at[rows].set(floats[0], mode="drop")
+            top_ks = top_ks.at[rows].set(ints[3], mode="drop")
+            top_ps = top_ps.at[rows].set(floats[1], mode="drop")
+            return keys, next_tokens, temps, top_ks, top_ps
+
         def _admit_batch(params, tokens, ints, floats, cache, keys,
                          next_tokens, temps, top_ks, top_ps):
             """Prefill R prompts together, splice each row's kv into the big
             cache, and sample each row's first token. R comes from a
-            two-size ladder (short chunks carry padding entries aimed at a
-            real entry's row but written *before* it, so the real write
-            wins); S is the prompt bucket — two compiled programs per
-            bucket. All per-row updates are sequentially unrolled: a vector
-            scatter with duplicate row indices has undefined write order."""
-            R = tokens.shape[0]
-            lens, rows, chunk_tks = ints[0], ints[1], ints[3]
-            chunk_temps, chunk_tps = floats[0], floats[1]
+            two-size ladder (short chunks carry padding entries whose row
+            index is the out-of-range sentinel, so every install of theirs
+            is dropped); S is the prompt bucket — two compiled programs per
+            bucket. One vector scatter installs the whole chunk."""
+            S = tokens.shape[1]
+            lens, rows = ints[0], ints[1]
             small, toks, row_keys = _prefill_first_token(params, tokens,
                                                          ints, floats)
-
-            k, v, lengths = cache.k, cache.v, cache.lengths
-            for r in range(R):      # static unroll, R == _MAX_ADMIT_CHUNK
-                k = jax.lax.dynamic_update_slice(
-                    k, small.k[:, r: r + 1], (0, rows[r], 0, 0, 0))
-                v = jax.lax.dynamic_update_slice(
-                    v, small.v[:, r: r + 1], (0, rows[r], 0, 0, 0))
-                lengths = lengths.at[rows[r]].set(lens[r].astype(lengths.dtype))
-                keys = keys.at[rows[r]].set(row_keys[r])
-                next_tokens = next_tokens.at[rows[r], 0].set(toks[r])
-                temps = temps.at[rows[r]].set(chunk_temps[r])
-                top_ks = top_ks.at[rows[r]].set(chunk_tks[r])
-                top_ps = top_ps.at[rows[r]].set(chunk_tps[r])
+            k = cache.k.at[:, rows, :S].set(small.k, mode="drop")
+            v = cache.v.at[:, rows, :S].set(small.v, mode="drop")
+            lengths = cache.lengths.at[rows].set(
+                lens.astype(cache.lengths.dtype), mode="drop")
             cache = KVCache(k, v, lengths)
+            keys, next_tokens, temps, top_ks, top_ps = _install_rows(
+                rows, row_keys, toks, ints, floats, keys, next_tokens,
+                temps, top_ks, top_ps)
             return toks, cache, keys, next_tokens, temps, top_ks, top_ps
 
         def _admit_batch_paged(params, tokens, ints, floats, tables, cache,
                                keys, next_tokens, temps, top_ks, top_ps):
             """Paged-mode admission: same fused prefill/sample as
-            _admit_batch, but each chunk row's kv splices into the page
-            pool through its page map (ops/paged_kv.write_prefill_row) and
-            the map+length install rides the same program. Padding entries
-            precede real ones and carry an all-zero table, so their writes
-            land in garbage page 0 and the later real install wins."""
-            R = tokens.shape[0]
-            lens, rows, chunk_tks = ints[0], ints[1], ints[3]
-            chunk_temps, chunk_tps = floats[0], floats[1]
+            _admit_batch, but the chunk's kv splices into the page pool
+            through the rows' page maps in ONE scatter
+            (ops/paged_kv.write_prefill_batch — the R-sequential-scatters
+            version made paged TTFT ~8x dense). Padding entries carry an
+            all-zero table (writes land in garbage page 0) and the
+            out-of-range row sentinel (installs dropped)."""
+            lens, rows = ints[0], ints[1]
             small, toks, row_keys = _prefill_first_token(params, tokens,
                                                          ints, floats)
-            from ..ops.paged_kv import write_prefill_row
-            for r in range(R):      # static unroll — sequential, pads first
-                cache = write_prefill_row(cache, small.k[:, r], small.v[:, r],
-                                          rows[r], lens[r], tables[r])
-                keys = keys.at[rows[r]].set(row_keys[r])
-                next_tokens = next_tokens.at[rows[r], 0].set(toks[r])
-                temps = temps.at[rows[r]].set(chunk_temps[r])
-                top_ks = top_ks.at[rows[r]].set(chunk_tks[r])
-                top_ps = top_ps.at[rows[r]].set(chunk_tps[r])
+            from ..ops.paged_kv import write_prefill_batch
+            cache = write_prefill_batch(cache, small.k, small.v, rows, lens,
+                                        tables)
+            keys, next_tokens, temps, top_ks, top_ps = _install_rows(
+                rows, row_keys, toks, ints, floats, keys, next_tokens,
+                temps, top_ks, top_ps)
             return toks, cache, keys, next_tokens, temps, top_ks, top_ps
 
         if self.kv_mode == "paged":
@@ -298,8 +309,12 @@ class BatchScheduler:
         device state is untouched (synthetic buffers are donated and
         discarded)."""
         if chunk_sizes is None:
-            chunk_sizes = tuple(sorted({_MAX_ADMIT_CHUNK,
-                                        max(self.num_slots, _MAX_ADMIT_CHUNK)}))
+            if self.admit_chunk:
+                # A fixed admit width is the ONLY program admission uses.
+                chunk_sizes = (self.admit_chunk,)
+            else:
+                chunk_sizes = tuple(sorted({
+                    _MAX_ADMIT_CHUNK, max(self.num_slots, _MAX_ADMIT_CHUNK)}))
         buckets = sorted({_bucket(b, self.max_seq) for b in prompt_buckets})
         if windows is None:
             # The whole ladder up to max_seq: any window left uncompiled
@@ -560,9 +575,13 @@ class BatchScheduler:
             while group:
                 # A backlog burst is admitted through the full-width program
                 # (one prefill for up to num_slots requests) instead of
-                # queueing behind _MAX_ADMIT_CHUNK-sized dispatches.
-                R = (max(self.num_slots, _MAX_ADMIT_CHUNK)
-                     if len(group) > _MAX_ADMIT_CHUNK else _MAX_ADMIT_CHUNK)
+                # queueing behind _MAX_ADMIT_CHUNK-sized dispatches — unless
+                # a fixed admit_chunk asks for staggered-TTFT chunking.
+                if self.admit_chunk:
+                    R = self.admit_chunk
+                else:
+                    R = (max(self.num_slots, _MAX_ADMIT_CHUNK)
+                         if len(group) > _MAX_ADMIT_CHUNK else _MAX_ADMIT_CHUNK)
                 chunk = group[:R]
                 group = group[R:]
                 rows = [free.pop(0) for _ in range(len(chunk))]
@@ -596,19 +615,19 @@ class BatchScheduler:
         ``rows`` + first-token sample per row.
 
         The program shape is (R, S) with R from a two-size ladder: short
-        chunks are padded with dummy entries that *precede* the real ones
-        and aim at the first real row, so the real (later,
-        sequentially-unrolled) writes win and only two programs per prompt
-        bucket are ever compiled."""
+        chunks are padded with dummy entries whose row index is the
+        out-of-range sentinel ``num_slots`` — every install of theirs is
+        scatter-dropped — so only two programs per prompt bucket are ever
+        compiled."""
         pad = R - len(chunk)
         tokens = np.zeros((R, S), np.int32)
         ints = np.zeros((4, R), np.int32)           # lens/rows/seeds/top_k
         floats = np.zeros((2, R), np.float32)       # temperature/top_p
         ints[0] = 1                                 # padding: 1-token prompt
-        ints[1] = rows[0]                           # padding targets row 0...
+        ints[1] = self.num_slots                    # padding: dropped rows
         floats[1] = 1.0
         for i, (slot, row) in enumerate(zip(chunk, rows)):
-            r = pad + i                             # ...real entries follow
+            r = pad + i
             tokens[r, : len(slot.prompt_ids)] = slot.prompt_ids
             o = slot.req.options
             ints[:, r] = (len(slot.prompt_ids), row, slot.seed, o.top_k)
@@ -616,8 +635,8 @@ class BatchScheduler:
 
         if self.kv_mode == "paged":
             # Padding entries keep an all-zero table: their prefill writes
-            # land in garbage page 0 and their (earlier) install of row 0's
-            # table is overwritten by the real entry's.
+            # land in garbage page 0 (their table/length installs are
+            # dropped via the row sentinel).
             tables = np.zeros((R, self._cache.max_pages_per_row), np.int32)
             for i, slot in enumerate(chunk):
                 tables[pad + i, : len(slot.pages)] = slot.pages

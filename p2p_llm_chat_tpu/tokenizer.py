@@ -148,6 +148,88 @@ class BPETokenizer:
                                sorted(self._special, key=len, reverse=True)) + ")")
         else:
             self._special_re = None
+        self._native = self._init_native()
+
+    def _init_native(self):
+        """Bind the C++ merge core (native/bpe_core.cc) when buildable.
+
+        BPE is re-keyed into vocab-id space once here — pair
+        (left_id, right_id) -> (rank, merged_id) — so the per-call ctypes
+        boundary is plain int32 arrays and the C++ loop never sees
+        strings. Returns (lib, handle) or None (pure-Python fallback,
+        identical output — pinned by tests/test_tokenizer.py)."""
+        import ctypes
+
+        from .utils import native
+
+        lib = native.load("bpe_core")
+        if lib is None:
+            return None
+        keys, vals = [], []
+        for (l, r), rank in self._ranks.items():
+            li, ri = self._vocab.get(l), self._vocab.get(r)
+            mi = self._vocab.get(l + r)
+            if li is None or ri is None or mi is None:
+                # A merge the id-keyed table can't represent: the Python
+                # path would still apply it (then decompose the unknown
+                # fragment), so a lossy table would diverge from the
+                # pure-Python oracle. Bail to the fallback instead.
+                return None
+            keys.append((li << 32) | ri)
+            vals.append((rank << 32) | mi)
+        lib.bpe_new.restype = ctypes.c_void_p
+        lib.bpe_new.argtypes = [ctypes.POINTER(ctypes.c_uint64),
+                                ctypes.POINTER(ctypes.c_uint64),
+                                ctypes.c_int64]
+        # Without argtypes ctypes passes the handle as a 32-bit int —
+        # pointer truncation, segfault in the finalizer.
+        lib.bpe_free.argtypes = [ctypes.c_void_p]
+        lib.bpe_apply.restype = ctypes.c_int32
+        lib.bpe_apply.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_int32),
+                                  ctypes.c_int32,
+                                  ctypes.POINTER(ctypes.c_int32)]
+        lib.bpe_apply_batch.restype = ctypes.c_int64
+        lib.bpe_apply_batch.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(ctypes.c_int32),
+                                        ctypes.POINTER(ctypes.c_int32),
+                                        ctypes.c_int32,
+                                        ctypes.POINTER(ctypes.c_int32)]
+        # Direct byte -> initial-symbol-id table: the native path skips
+        # the byte->unicode string mapping entirely. Only usable when the
+        # vocab covers all 256 byte symbols (true for llama3/Mixtral).
+        byte_id = [self._vocab.get(self._b2u[b]) for b in range(256)]
+        if any(i is None for i in byte_id):
+            return None
+        self._byte_id = byte_id
+        n = len(keys)
+        handle = lib.bpe_new((ctypes.c_uint64 * n)(*keys),
+                             (ctypes.c_uint64 * n)(*vals), n)
+        if not handle:
+            return None
+        import weakref
+        weakref.finalize(self, lib.bpe_free, handle)
+        return (lib, handle, ctypes)
+
+    def _encode_chunk_native(self, chunk: str) -> list[int]:
+        """Pre-tokenize + merge one chunk through the C++ core in a single
+        FFI call (ids concatenated, one length per piece)."""
+        lib, handle, ctypes = self._native
+        byte_id = self._byte_id
+        flat: list[int] = []
+        lens: list[int] = []
+        for piece in _PRETOKEN_RE.findall(chunk):
+            bs = piece.encode("utf-8")
+            flat.extend(byte_id[b] for b in bs)
+            lens.append(len(bs))
+        if not flat:
+            return []
+        n = len(flat)
+        out = (ctypes.c_int32 * n)()
+        m = lib.bpe_apply_batch(handle, (ctypes.c_int32 * n)(*flat),
+                                (ctypes.c_int32 * len(lens))(*lens),
+                                len(lens), out)
+        return list(out[:m])
 
     # -- loading -------------------------------------------------------------
 
@@ -166,6 +248,18 @@ class BPETokenizer:
     # -- bpe core ------------------------------------------------------------
 
     def _bpe(self, token: str) -> list[int]:
+        if self._native is not None and len(token) > 1:
+            ids = [self._vocab.get(ch) for ch in token]
+            if None not in ids:       # unknown chars: rare; python fallback
+                lib, handle, ctypes = self._native
+                n = len(ids)
+                buf = (ctypes.c_int32 * n)(*ids)
+                out = (ctypes.c_int32 * n)()
+                m = lib.bpe_apply(handle, buf, n, out)
+                return list(out[:m])
+        return self._bpe_py(token)
+
+    def _bpe_py(self, token: str) -> list[int]:
         parts = list(token)
         if len(parts) == 1:
             return [self._vocab[token]] if token in self._vocab else []
@@ -195,6 +289,9 @@ class BPETokenizer:
                 continue
             if chunk in self._special:
                 ids.append(self._special[chunk])
+                continue
+            if self._native is not None:
+                ids.extend(self._encode_chunk_native(chunk))
                 continue
             for piece in _PRETOKEN_RE.findall(chunk):
                 mapped = "".join(self._b2u[b] for b in piece.encode("utf-8"))

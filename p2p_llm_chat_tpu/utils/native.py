@@ -1,0 +1,56 @@
+"""Loader for the in-tree native (C++) runtime components.
+
+The reference's native-performance pieces live out-of-tree in Ollama's
+C++ runtime; ours live in ``native/`` as small C-ABI shared objects
+consumed via ctypes (no pybind11 in this image). Loading is lazy and
+fail-soft: if the library is missing we try one quiet ``make``; if the
+toolchain is unavailable the caller falls back to its pure-Python path,
+so the framework never *requires* the native build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from .log import get_logger
+
+log = get_logger("native")
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+
+_lock = threading.Lock()
+_cache: dict[str, object] = {}
+
+
+def load(name: str) -> object | None:
+    """dlopen ``native/lib<name>.so``, building it on first miss.
+
+    Returns the ctypes.CDLL or None (caller falls back to Python).
+    Results (including failures) are cached per process.
+    """
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        path = os.path.join(_NATIVE_DIR, f"lib{name}.so")
+        if not os.path.exists(path):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR, f"lib{name}.so"],
+                               capture_output=True, timeout=120, check=True)
+            except Exception as e:   # noqa: BLE001 — missing toolchain etc.
+                log.info("native %s unavailable (build failed: %s); "
+                         "using pure-Python path", name, e)
+                _cache[name] = None
+                return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError as e:
+            log.info("native %s unavailable (%s); using pure-Python path",
+                     name, e)
+            lib = None
+        _cache[name] = lib
+        return lib

@@ -95,6 +95,53 @@ def test_write_prefill_pads_go_to_garbage_page():
     np.testing.assert_array_equal(page[:, 3:], np.zeros_like(page[:, 3:]))
 
 
+@pytest.mark.parametrize("S", [4, 8, 16, 12])   # <page, =page, multi, ragged
+def test_write_prefill_batch_matches_row_path(S):
+    """The one-scatter admission splice (the production path in
+    serve/scheduler.py) must agree with write_prefill_row for every S
+    shape class, drop sentinel-row installs, and route past-allocation
+    pages to garbage page 0."""
+    rng = np.random.default_rng(9)
+    B, R, L = 3, 4, CFG.num_layers
+    lens = [max(1, S - 2), S, 1]                 # 3 real rows + 1 pad entry
+    alloc = PageAllocator(32, PS)
+    tables = np.zeros((R, 4), np.int32)
+    for i, n in enumerate(lens):
+        pages = alloc.alloc(alloc.pages_for(n + 1))
+        tables[i, : len(pages)] = pages
+    chunk_k = rng.normal(size=(L, R, S, CFG.num_kv_heads,
+                               CFG.head_dim)).astype(np.float32)
+    chunk_v = rng.normal(size=(L, R, S, CFG.num_kv_heads,
+                               CFG.head_dim)).astype(np.float32)
+    rows = jnp.asarray([0, 1, 2, B], jnp.int32)  # last entry: pad sentinel
+    lens_j = jnp.asarray(lens + [1], jnp.int32)
+
+    base = PagedKVCache.create(CFG, B, 32, PS, max_pages_per_row=4,
+                               dtype=jnp.float32)
+    got = paged_kv.write_prefill_batch(base, jnp.asarray(chunk_k),
+                                       jnp.asarray(chunk_v), rows, lens_j,
+                                       jnp.asarray(tables))
+    ref = base
+    for i in range(B):                            # oracle: per-row splice
+        ref = paged_kv.write_prefill_row(ref, jnp.asarray(chunk_k[:, i]),
+                                         jnp.asarray(chunk_v[:, i]),
+                                         jnp.asarray(i),
+                                         jnp.asarray(lens[i]),
+                                         jnp.asarray(tables[i]))
+    np.testing.assert_array_equal(np.asarray(got.page_table),
+                                  np.asarray(ref.page_table))
+    np.testing.assert_array_equal(np.asarray(got.lengths),
+                                  np.asarray(ref.lengths))
+    for layer in range(L):
+        gk, gv = paged_kv.gather_dense(got, layer, max_seq=2 * S)
+        rk, rv = paged_kv.gather_dense(ref, layer, max_seq=2 * S)
+        for b, n in enumerate(lens[:B]):          # compare live slots only
+            np.testing.assert_array_equal(np.asarray(gk[b, :n]),
+                                          np.asarray(rk[b, :n]))
+            np.testing.assert_array_equal(np.asarray(gv[b, :n]),
+                                          np.asarray(rv[b, :n]))
+
+
 def test_write_decode_appends_at_length():
     rng = np.random.default_rng(2)
     lengths = [5, 8]                                   # row1 exactly at a page boundary

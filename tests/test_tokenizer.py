@@ -141,3 +141,56 @@ def test_pretokenizer_matches_llama3_regex_oracle():
     for s in cases:
         oracle = [p for p, _ in pt.pre_tokenize_str(s)]
         assert _PRETOKEN_RE.findall(s) == oracle, f"pretoken mismatch on {s!r}"
+
+
+def test_native_bpe_matches_python(tmp_path):
+    """The C++ merge core (native/bpe_core.cc) must produce exactly the
+    pure-Python loop's ids on the toy tokenizer — including multi-step and
+    rank-priority merges."""
+    path, _ = _toy_tokenizer_json(tmp_path)
+    tok = BPETokenizer.from_file(path)
+    if tok._native is None:
+        pytest.skip("native bpe_core not buildable in this environment")
+    tok_py = BPETokenizer.from_file(path)
+    tok_py._native = None
+    cases = ["hello", "hello hello world", "hell no", "he llo",
+             "héllo ✨ 12345", "  spaces  ", "a" * 200, "hellohellohello"]
+    for s in cases:
+        native_ids = tok.encode(s, add_bos=True)
+        assert native_ids == tok_py.encode(s, add_bos=True), s
+        assert tok.decode(native_ids) == tok_py.decode(native_ids)
+
+
+def test_native_bpe_fuzz_matches_python(tmp_path):
+    """Randomized merge tables + random byte strings: native and Python
+    merge loops must agree everywhere (greedy lowest-rank, leftmost-first)."""
+    import random
+
+    from p2p_llm_chat_tpu.tokenizer import _byte_to_unicode
+
+    rng = random.Random(0)
+    b2u = _byte_to_unicode()
+    alpha = [b2u[ord(c)] for c in "abcdef"]
+    vocab = {b2u[b]: b for b in range(256)}
+    nxt = 256
+    merges = []
+    # Random merges over a tiny alphabet so chains actually fire.
+    pool = list(alpha)
+    for _ in range(40):
+        l, r = rng.choice(pool), rng.choice(pool)
+        if (l, r) in merges or l + r in vocab:
+            continue
+        merges.append((l, r))
+        vocab[l + r] = nxt
+        pool.append(l + r)
+        nxt += 1
+    tok = BPETokenizer(vocab, merges, {"<|begin_of_text|>": nxt,
+                                       "<|end_of_text|>": nxt + 1})
+    if tok._native is None:
+        pytest.skip("native bpe_core not buildable in this environment")
+    tok_py = BPETokenizer(vocab, merges, {"<|begin_of_text|>": nxt,
+                                          "<|end_of_text|>": nxt + 1})
+    tok_py._native = None
+    for _ in range(200):
+        s = "".join(rng.choice("abcdef") for _ in range(rng.randrange(1, 60)))
+        assert tok.encode(s) == tok_py.encode(s), s
