@@ -22,7 +22,8 @@ Two provisioning paths (build_engine_from_env):
 Env surface (reference-style env-first config, utils/env.py):
 ``SERVE_BACKEND=tpu``, ``CKPT_DIR``, ``MODEL_CONFIG``, ``SERVE_SLOTS``,
 ``SERVE_MAX_SEQ``, ``SERVE_TP``, ``LLM_MODEL`` (served model tag),
-``SERVE_KV`` (dense|paged), ``SERVE_PAGE_SIZE``, ``SERVE_PAGES``.
+``SERVE_KV`` (dense|paged), ``SERVE_PAGE_SIZE``, ``SERVE_PAGES``,
+``SERVE_ADMIT_CHUNK``, ``SERVE_QUEUE_TIMEOUT`` (seconds, 0 disables).
 """
 
 from __future__ import annotations
@@ -52,7 +53,8 @@ class TPUEngine:
                  name: Optional[str] = None, kv_mode: str = "dense",
                  page_size: int = 64,
                  num_pages: Optional[int] = None,
-                 admit_chunk: Optional[int] = None) -> None:
+                 admit_chunk: Optional[int] = None,
+                 queue_timeout_s: Optional[float] = 60.0) -> None:
         self.name = name or config.name
         self.config = config
         self.scheduler = BatchScheduler(params, config, tokenizer,
@@ -60,7 +62,8 @@ class TPUEngine:
                                         mesh=mesh, kv_mode=kv_mode,
                                         page_size=page_size,
                                         num_pages=num_pages,
-                                        admit_chunk=admit_chunk)
+                                        admit_chunk=admit_chunk,
+                                        queue_timeout_s=queue_timeout_s)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
@@ -101,6 +104,10 @@ def build_engine_from_env() -> Backend:
     page_size = env_int("SERVE_PAGE_SIZE", 64)
     num_pages = env_int("SERVE_PAGES", 0) or None
     admit_chunk = env_int("SERVE_ADMIT_CHUNK", 0) or None
+    # Admission deadline (seconds; 0 disables). Default mirrors the
+    # reference client's 60 s LLM timeout (web/streamlit_app.py:95).
+    qt = float(env_or("SERVE_QUEUE_TIMEOUT", "60"))
+    queue_timeout_s = qt if qt > 0 else None
 
     mesh = None
     if tp > 1:
@@ -124,6 +131,7 @@ def build_engine_from_env() -> Backend:
                        max_seq=max_seq, mesh=mesh, kv_mode=kv_mode,
                        page_size=page_size, num_pages=num_pages,
                        admit_chunk=admit_chunk,
+                       queue_timeout_s=queue_timeout_s,
                        name=env_or("LLM_MODEL", config.name))
     warmup = env_or("SERVE_WARMUP", "128,256")
     if warmup and warmup != "0":

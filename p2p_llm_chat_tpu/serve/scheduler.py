@@ -93,6 +93,7 @@ class _Slot:
     ctx_budget: int = 0                                # max ctx this slot may hold
     pages: Optional[list[int]] = None                  # paged mode: physical pages
     cancelled: threading.Event = field(default_factory=threading.Event)
+    error: Optional[str] = None                        # surfaced by submit()
 
     def push(self, delta: str) -> None:
         if delta:
@@ -102,6 +103,13 @@ class _Slot:
         if self.stats is not None and self.stats.total_s is None:
             self.stats.total_s = time.monotonic() - self.req.arrival_time
         self.out_q.put(None)
+
+    def fail(self, msg: str) -> None:
+        """Finish with an error the consumer re-raises (the API front maps
+        it to Ollama's error record / 500, which the UI degrades to the
+        reference's "(LLM error)" string)."""
+        self.error = msg
+        self.finish()
 
 
 class BatchScheduler:
@@ -113,18 +121,26 @@ class BatchScheduler:
                  max_seq: int = 1024, mesh=None, kv_mode: str = "dense",
                  page_size: int = 64,
                  num_pages: Optional[int] = None,
-                 admit_chunk: Optional[int] = None) -> None:
+                 admit_chunk: Optional[int] = None,
+                 queue_timeout_s: Optional[float] = 60.0) -> None:
         """``admit_chunk``: burst-admission width. None (default) admits a
         backlog burst through one full-width prefill (minimal dispatches —
         best p95/throughput); a fixed power-of-two (e.g. 8) staggers the
         burst through smaller prefills so early chunks' first tokens land
         before the whole burst's prefill compute finishes (better p50
-        TTFT, one extra dispatch + readback per chunk)."""
+        TTFT, one extra dispatch + readback per chunk).
+
+        ``queue_timeout_s``: server-side admission deadline. A request
+        that has not reached a batch row this long after arrival fails
+        with an error instead of waiting forever (the reference's client
+        gives up at 60 s — web/streamlit_app.py:95 — so holding its
+        request longer only wastes pool space). None disables."""
         if kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {kv_mode!r}")
         if admit_chunk is not None and admit_chunk < 1:
             raise ValueError(f"admit_chunk must be >= 1, got {admit_chunk}")
         self.admit_chunk = admit_chunk
+        self.queue_timeout_s = queue_timeout_s
         self.config = config
         self.tokenizer = tokenizer
         self.num_slots = num_slots
@@ -353,13 +369,25 @@ class BatchScheduler:
                          jnp.zeros((B,), jnp.int32),
                          jnp.ones((B,), jnp.float32)]
                 self._admit_j(*args)
+        toks = None
         for w in windows:
             cache = throwaway_cache()
-            self._decode_for(w)(
+            toks, *_ = self._decode_for(w)(
                 self._params, jnp.zeros((B, 1), jnp.int32), cache,
                 jnp.zeros((B,), bool), jnp.zeros((B,), jnp.float32),
                 jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
                 jnp.zeros((B, 2), jnp.uint32))
+        if self.kv_mode == "paged":
+            # The row-release program (_zero_row_j) otherwise compiles on
+            # the first request's release — inside a later request's TTFT.
+            cache = self._zero_row_j(throwaway_cache(),
+                                     jnp.asarray(0, jnp.int32))
+            np.asarray(cache.lengths[:1])
+        if toks is not None:
+            # Drain the dispatch queue: warmup executions (and the axon
+            # tunnel's deferred per-program loads) are async — without a
+            # readback the first real request queues behind all of them.
+            np.asarray(toks[:1])
         log.info("warmup compiled: admit %s x buckets %s, decode windows %s",
                  chunk_sizes, buckets, windows)
 
@@ -407,6 +435,8 @@ class BatchScheduler:
             while True:
                 delta = slot.out_q.get()
                 if delta is None:
+                    if slot.error is not None:
+                        raise RuntimeError(slot.error)
                     return
                 yield delta
         finally:
@@ -477,6 +507,8 @@ class BatchScheduler:
                 break
             if slot.cancelled.is_set():
                 continue
+            if self._expired(slot):
+                continue
             opts = slot.req.options
             ids = self.tokenizer.encode(slot.req.prompt, add_bos=True)
             # Context budget: keep the prompt tail (recent context wins, the
@@ -496,6 +528,20 @@ class BatchScheduler:
                 slot.stats.prompt_tokens = len(ids)
             out.append(slot)
         return out
+
+    def _expired(self, slot: _Slot) -> bool:
+        """Fail a request that outlived the admission deadline (it never
+        reached a row; the client has almost certainly given up)."""
+        if self.queue_timeout_s is None:
+            return False
+        age = time.monotonic() - slot.req.arrival_time
+        if age <= self.queue_timeout_s:
+            return False
+        log.warning("request waited %.1fs for admission (deadline %.1fs); "
+                    "failing it", age, self.queue_timeout_s)
+        slot.fail(f"not admitted within {self.queue_timeout_s:.0f}s "
+                  "(server at capacity)")
+        return True
 
     def _try_reserve(self, slot: _Slot) -> bool:
         """Paged mode: claim the slot's page budget (prompt + generation
@@ -517,7 +563,8 @@ class BatchScheduler:
         if need > self.num_pages - 1:
             log.warning("request needs %d pages but the pool only has %d; "
                         "failing it", need, self.num_pages - 1)
-            slot.finish()
+            slot.fail(f"request needs {need} KV pages; the pool has "
+                      f"{self.num_pages - 1}")
         else:
             self._waiting.append(slot)
 
@@ -534,6 +581,8 @@ class BatchScheduler:
             still: list[_Slot] = []
             for s in self._waiting:
                 if s.cancelled.is_set():
+                    continue
+                if self._expired(s):
                     continue
                 # Strict FIFO: the first waiter that can't reserve blocks
                 # everyone behind it (otherwise smaller later requests leap
@@ -591,7 +640,7 @@ class BatchScheduler:
                     log.exception("admission failed for %d request(s)",
                                   len(chunk))
                     for s in chunk:
-                        s.finish()
+                        s.fail("internal error: admission failed")
                     if self.kv_mode == "paged":
                         # The chunk's pages may already be installed in row
                         # tables (the failure can postdate the device call),
@@ -601,7 +650,7 @@ class BatchScheduler:
                         # live table still points at / double-allocating.
                         for s in group + [x for _, g in groups[gi + 1:]
                                           for x in g]:
-                            s.finish()
+                            s.fail("internal error: admission failed")
                         self._fail_all_and_reset()
                         return
                     for r in rows:
@@ -787,7 +836,7 @@ class BatchScheduler:
         so the only cost is re-allocating the buffers."""
         for i, s in enumerate(self._slots):
             if s is not None:
-                s.finish()
+                s.fail("internal error: serving state was reset")
                 self._slots[i] = None
         self._reset_device_state()
 

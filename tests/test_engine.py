@@ -258,12 +258,15 @@ def test_paged_oversized_fails_fast_even_behind_waiters():
     eng = TPUEngine(PARAMS, CFG, TOK, num_slots=3, max_seq=128,
                     kv_mode="paged", page_size=16, num_pages=4)
     try:
-        results = {}
+        results, errors = {}, {}
 
         def worker(name, prompt, max_tokens):
             req = GenerateRequest(prompt=prompt,
                                   options=GenerateOptions(max_tokens=max_tokens))
-            results[name] = "".join(eng.generate_stream(req, RequestStats()))
+            try:
+                results[name] = "".join(eng.generate_stream(req, RequestStats()))
+            except RuntimeError as e:
+                errors[name] = str(e)
 
         hold = threading.Thread(target=worker,
                                 args=("hold", "hold the pool please", 26))
@@ -283,7 +286,7 @@ def test_paged_oversized_fails_fast_even_behind_waiters():
         big.start()
         big.join(timeout=60)
         assert not big.is_alive(), "oversized request deadlocked behind waiters"
-        assert results["big"] == ""
+        assert "big" not in results and "pages" in errors["big"]
 
         hold.join(timeout=120)
         small.join(timeout=120)
@@ -295,16 +298,35 @@ def test_paged_oversized_fails_fast_even_behind_waiters():
 
 def test_paged_oversized_request_fails_fast_not_deadlocks():
     """A request whose budget exceeds the whole pool must fail cleanly
-    (empty stream), not wait forever."""
+    (surfaced error), not wait forever."""
     eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128,
                     kv_mode="paged", page_size=16, num_pages=3)
     try:
         # prompt+generation budget needs > 2 pages (32 tokens)
         req = GenerateRequest(prompt="x" * 80,
                               options=GenerateOptions(max_tokens=60))
-        out = list(eng.generate_stream(req, RequestStats()))
-        assert out == []
+        with pytest.raises(RuntimeError, match="pages"):
+            list(eng.generate_stream(req, RequestStats()))
         # Engine still serves a small request afterwards.
+        text, _ = run(eng, "ok", max_tokens=4)
+        assert text == oracle("ok", 4)
+    finally:
+        eng.stop()
+
+
+def test_queue_timeout_fails_overdue_request():
+    """A request that outlives the admission deadline fails with a
+    surfaced error (SURVEY.md §5 failure-detection: serve-side request
+    timeout), and the engine keeps serving afterwards. Deterministic via a
+    back-dated arrival_time — the same _expired check also reaps
+    page-starved waiters each scheduling round."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128,
+                    queue_timeout_s=5.0)
+    try:
+        req = GenerateRequest(prompt="too late", arrival_time=time.monotonic() - 10,
+                              options=GenerateOptions(max_tokens=4))
+        with pytest.raises(RuntimeError, match="not admitted"):
+            list(eng.generate_stream(req, RequestStats()))
         text, _ = run(eng, "ok", max_tokens=4)
         assert text == oracle("ok", 4)
     finally:
