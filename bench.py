@@ -67,9 +67,14 @@ def main() -> None:
     config = get_config(cfg_name)
     dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
     params = llama.init_params(config, jax.random.PRNGKey(0), dtype=dtype)
-    jax.block_until_ready(params)
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    log(f"params: {n_params/1e9:.2f}B ({dtype.__name__})")
+    quant = os.environ.get("BENCH_QUANT", "")    # "" | int8
+    if quant == "int8":
+        from p2p_llm_chat_tpu.models.quant import quantize_params
+        params = quantize_params(params)
+    jax.block_until_ready(params)
+    log(f"params: {n_params/1e9:.2f}B ({dtype.__name__}"
+        f"{', int8 weights' if quant else ''})")
 
     # -- raw batched decode throughput (pure device step, serving shapes,
     # matching the selected kv_mode) -----------------------------------------
@@ -185,6 +190,7 @@ def main() -> None:
         "extra": {
             "platform": platform,
             "kv_mode": kv_mode,
+            "quant": quant or None,
             "page_size": page_size if kv_mode == "paged" else None,
             "config": cfg_name,
             "n_params_b": round(n_params / 1e9, 3),

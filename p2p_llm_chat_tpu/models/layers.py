@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .configs import ModelConfig, RopeScaling
+from .quant import mm
 
 DEFAULT_COMPUTE_DTYPE = jnp.bfloat16
 
@@ -132,10 +133,11 @@ def attend_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
            w_down: jax.Array) -> jax.Array:
-    """SwiGLU MLP: down(silu(x@gate) * (x@up))."""
-    g = jax.nn.silu(x @ w_gate)
-    u = x @ w_up
-    return (g * u) @ w_down
+    """SwiGLU MLP: down(silu(x@gate) * (x@up)). Weights may be int8
+    QTensors (models/quant.py)."""
+    g = jax.nn.silu(mm(x, w_gate))
+    u = mm(x, w_up)
+    return mm(g * u, w_down)
 
 
 def causal_mask(q_len: int, kv_len: int, q_offset: jax.Array | int) -> jax.Array:

@@ -27,6 +27,7 @@ from jax.sharding import Mesh
 
 from ..parallel.sharding import LogicalRules, DEFAULT_RULES, constrain
 from .configs import ModelConfig
+from .quant import mm
 from .layers import (
     DEFAULT_COMPUTE_DTYPE,
     apply_rope,
@@ -125,9 +126,9 @@ def _attn_qkv(h: jax.Array, lp: dict, config: ModelConfig,
     k/v [B,S,Hkv,D]. Shared between the dense and paged block variants."""
     B, S, _ = h.shape
     x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
-    q = (x @ lp["wq"]).reshape(B, S, config.num_heads, config.head_dim)
-    k = (x @ lp["wk"]).reshape(B, S, config.num_kv_heads, config.head_dim)
-    v = (x @ lp["wv"]).reshape(B, S, config.num_kv_heads, config.head_dim)
+    q = mm(x, lp["wq"]).reshape(B, S, config.num_heads, config.head_dim)
+    k = mm(x, lp["wk"]).reshape(B, S, config.num_kv_heads, config.head_dim)
+    v = mm(x, lp["wv"]).reshape(B, S, config.num_kv_heads, config.head_dim)
     q = constrain(q, mesh, ("batch", None, "act_heads", None), rules)
     k = constrain(k, mesh, ("batch", None, "act_heads", None), rules)
     q = apply_rope(q, positions, inv_freq)
@@ -140,7 +141,7 @@ def _post_attn(h: jax.Array, attn: jax.Array, lp: dict, config: ModelConfig,
     """Output projection + residual + MLP + residual. attn: [B,S,Hq,D]."""
     B, S = attn.shape[:2]
     attn = attn.reshape(B, S, config.q_dim)
-    h = h + constrain(attn @ lp["wo"], mesh, ("batch", None, "act_embed"), rules)
+    h = h + constrain(mm(attn, lp["wo"]), mesh, ("batch", None, "act_embed"), rules)
     x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
     mlp = (mlp_fn or _default_mlp)(x, lp, mesh, rules)
     return h + constrain(mlp, mesh, ("batch", None, "act_embed"), rules)
@@ -223,7 +224,7 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
     h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
     lm_head = (params["embed"].T if config.tie_embeddings
                else params["lm_head"])
-    logits = (h @ lm_head).astype(jnp.float32)
+    logits = mm(h, lm_head).astype(jnp.float32)
     logits = constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
     return logits, KVCache(new_k, new_v, cache.lengths)
 
@@ -329,7 +330,7 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
     lm_head = (params["embed"].T if config.tie_embeddings
                else params["lm_head"])
-    logits = (h @ lm_head).astype(jnp.float32)
+    logits = mm(h, lm_head).astype(jnp.float32)
     logits = constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
     inc = (jnp.ones_like(cache.lengths) if active is None
            else active.astype(jnp.int32))

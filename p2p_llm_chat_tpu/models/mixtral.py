@@ -39,6 +39,7 @@ from jax.sharding import Mesh
 from ..parallel.sharding import LogicalRules, DEFAULT_RULES, constrain
 from .configs import ModelConfig
 from .layers import DEFAULT_COMPUTE_DTYPE, causal_mask, length_mask
+from .quant import q_einsum
 from . import llama
 from .llama import KVCache  # same cache layout/contract as the dense family
 
@@ -150,9 +151,9 @@ def moe_mlp(x: jax.Array, router: jax.Array, w_gate: jax.Array,
     xin = jnp.zeros((NE * C, H), xt.dtype).at[idx].set(x_rep, mode="drop")
     xin = constrain(xin.reshape(NE, C, H), mesh,
                     ("experts", None, "act_embed"), rules)
-    g = jax.nn.silu(jnp.einsum("ech,ehf->ecf", xin, w_gate))
-    u = jnp.einsum("ech,ehf->ecf", xin, w_up)
-    y = jnp.einsum("ecf,efh->ech", g * u, w_down)                  # [NE,C,H]
+    g = jax.nn.silu(q_einsum("ech,ehf->ecf", xin, w_gate))
+    u = q_einsum("ech,ehf->ecf", xin, w_up)
+    y = q_einsum("ecf,efh->ech", g * u, w_down)                    # [NE,C,H]
     y = constrain(y, mesh, ("experts", None, "act_embed"), rules)
 
     gathered = jnp.take(y.reshape(NE * C, H), idx, axis=0,

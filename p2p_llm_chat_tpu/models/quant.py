@@ -1,0 +1,119 @@
+"""Weight-only int8 quantization (w8a16) for the serving stack.
+
+Decode is HBM-bandwidth-bound: every step streams the full weight set
+through the MXU at batch sizes far too small to amortise it (SURVEY.md §6
+north-star shapes). Storing matmul weights as int8 with per-output-channel
+scales halves that traffic vs bf16 — and is the memory lever that fits
+llama3.1-70B on a v5e-8 slice (BASELINE.json config 4; the reference
+delegates this entirely to Ollama's quantised GGUF models, README.md:52).
+
+TPU-first shape of the idea:
+- **storage**: ``QTensor(q: int8[..., in, out], s: f32[..., 1, out])`` —
+  symmetric per-out-channel scales over the contraction axis. A NamedTuple,
+  so it is a pytree: it rides ``lax.scan`` over stacked layers, donation,
+  and ``jax.sharding`` untouched (q inherits the weight's sharding spec;
+  s is tiny and follows the out axis).
+- **compute**: ``mm(x, w) = (x @ w.q.astype(bf16)) * w.s`` — the int8->bf16
+  convert fuses into the matmul's HBM read (XLA), the MXU runs its native
+  bf16 pipeline, and the scale is one fused per-channel multiply on the
+  output. Activations stay bf16 end-to-end; no activation quantisation,
+  no calibration data needed.
+- embeddings and norms stay bf16: the embed gather reads one row per
+  token (bandwidth-irrelevant) and norms are numerically sensitive.
+
+Accuracy: per-channel symmetric int8 keeps |w - dequant(w)| <= s/2
+elementwise (tests/test_quant.py pins the bound and end-to-end logit
+agreement).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QTensor(NamedTuple):
+    """int8 weight + f32 per-output-channel scale (contraction axis kept
+    as size-1 so ``q * s`` and post-matmul scaling both broadcast)."""
+
+    q: jax.Array
+    s: jax.Array
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self) -> int:
+        return self.q.ndim
+
+
+def quantize(w: jax.Array, axis: int = -2) -> QTensor:
+    """Symmetric int8 quantization with per-channel scales over ``axis``
+    (the matmul contraction axis — every channel that feeds one output
+    unit shares a scale)."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / s), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, s=s)
+
+
+def dequantize(w: QTensor, dtype=jnp.bfloat16) -> jax.Array:
+    return (w.q.astype(jnp.float32) * w.s).astype(dtype)
+
+
+def mm(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` for a plain array or a :class:`QTensor`.
+
+    The quantized path scales after the matmul (one multiply per output
+    element) so the contraction itself reads int8 from HBM."""
+    if isinstance(w, QTensor):
+        return (x @ w.q.astype(x.dtype)) * jnp.squeeze(w.s, -2).astype(x.dtype)
+    return x @ w
+
+
+def q_einsum(spec: str, x: jax.Array, w) -> jax.Array:
+    """``einsum(spec, x, w)`` for plain or quantized ``w``. The spec's
+    contraction over ``w`` must be its -2 axis (the quantize() axis) and
+    the output must end with ``w``'s out axis — true for every expert
+    einsum in models/mixtral.py (``ech,ehf->ecf`` / ``ecf,efh->ech``)."""
+    if isinstance(w, QTensor):
+        y = jnp.einsum(spec, x, w.q.astype(x.dtype))
+        return y * w.s.astype(x.dtype)       # s: [..., 1, out] broadcasts
+    return jnp.einsum(spec, x, w)
+
+
+# Matmul weight leaves (llama + mixtral families; models/llama.py and
+# models/mixtral.py init_params). All store the contraction at axis -2.
+_QUANT_LEAVES = frozenset({
+    "wq", "wk", "wv", "wo",            # attention projections
+    "w_gate", "w_up", "w_down",        # SwiGLU / expert FFNs
+    "lm_head",                         # output projection
+})
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize every matmul weight leaf of a model param tree in place of
+    its bf16 array (embed/norms/router stay as-is). Works on sharded
+    params too — quantize *after* ``shard_params`` so q/s derive their
+    shardings from the weight's."""
+    def walk(d: dict) -> dict:
+        out = {}
+        for k, v in d.items():
+            if isinstance(v, dict):
+                out[k] = walk(v)
+            elif k in _QUANT_LEAVES:
+                out[k] = quantize(v)
+            else:
+                out[k] = v
+        return out
+    return walk(params)
+
+
+def is_quantized(params: dict) -> bool:
+    return any(isinstance(x, QTensor)
+               for x in jax.tree.leaves(
+                   params, is_leaf=lambda x: isinstance(x, QTensor)))

@@ -23,7 +23,8 @@ Env surface (reference-style env-first config, utils/env.py):
 ``SERVE_BACKEND=tpu``, ``CKPT_DIR``, ``MODEL_CONFIG``, ``SERVE_SLOTS``,
 ``SERVE_MAX_SEQ``, ``SERVE_TP``, ``LLM_MODEL`` (served model tag),
 ``SERVE_KV`` (dense|paged), ``SERVE_PAGE_SIZE``, ``SERVE_PAGES``,
-``SERVE_ADMIT_CHUNK``, ``SERVE_QUEUE_TIMEOUT`` (seconds, 0 disables).
+``SERVE_ADMIT_CHUNK``, ``SERVE_QUEUE_TIMEOUT`` (seconds, 0 disables),
+``SERVE_QUANT`` (int8 = weight-only quantization, models/quant.py).
 """
 
 from __future__ import annotations
@@ -127,6 +128,13 @@ def build_engine_from_env() -> Backend:
             from ..parallel.sharding import shard_params
             params = shard_params(params, family.param_axes(config), mesh)
         tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
+    quant = env_or("SERVE_QUANT", "")
+    if quant:
+        if quant != "int8":
+            raise SystemExit(f"SERVE_QUANT must be int8 or empty, got {quant!r}")
+        from ..models.quant import quantize_params
+        params = quantize_params(params)
+        log.info("weights quantized to int8 (per-channel, w8a16)")
     engine = TPUEngine(params, config, tokenizer, num_slots=num_slots,
                        max_seq=max_seq, mesh=mesh, kv_mode=kv_mode,
                        page_size=page_size, num_pages=num_pages,

@@ -1,0 +1,191 @@
+"""Weight-only int8 quantization tests (models/quant.py).
+
+Three oracles:
+- the elementwise bound |w - dequant(w)| <= s/2 that symmetric rounding
+  guarantees;
+- exact agreement between the fused quantized matmul path (mm/q_einsum)
+  and a forward over explicitly dequantized weights — same math, so the
+  tolerance is float-roundoff only;
+- end-to-end sanity vs the unquantized model: logits stay highly
+  correlated and greedy decode still matches through the serving engine.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_llm_chat_tpu.models import llama, mixtral
+from p2p_llm_chat_tpu.models.configs import get_config
+from p2p_llm_chat_tpu.models.llama import KVCache
+from p2p_llm_chat_tpu.models.quant import (QTensor, dequantize, mm,
+                                           quantize, quantize_params)
+
+pytestmark = pytest.mark.model
+
+CFG = get_config("tiny")
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def dequantize_tree(params):
+    def walk(d):
+        return {k: (walk(v) if isinstance(v, dict) else
+                    dequantize(v, jnp.float32) if isinstance(v, QTensor)
+                    else v)
+                for k, v in d.items()}
+    return walk(params)
+
+
+def test_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(64, 48)) * 0.1, jnp.float32)
+    qt = quantize(w)
+    deq = dequantize(qt, jnp.float32)
+    bound = np.asarray(qt.s)[0] / 2 + 1e-7          # per out channel
+    assert np.all(np.abs(np.asarray(deq - w)) <= bound[None, :])
+    # int8 payload really is int8, scales kept per-channel.
+    assert qt.q.dtype == jnp.int8 and qt.s.shape == (1, 48)
+
+
+def test_zero_channel_is_stable():
+    w = jnp.zeros((8, 4), jnp.float32).at[:, 1].set(1.0)
+    qt = quantize(w)
+    deq = np.asarray(dequantize(qt, jnp.float32))
+    np.testing.assert_array_equal(deq[:, 0], 0)     # no NaN / div-by-zero
+    np.testing.assert_allclose(deq[:, 1], 1.0, atol=1e-6)
+
+
+def test_mm_matches_explicit_dequant():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(5, 64)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(64, 48)), jnp.float32)
+    qt = quantize(w)
+    got = np.asarray(mm(x, qt))
+    ref = np.asarray(x @ dequantize(qt, jnp.float32))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_forward_matches_dequantized_oracle():
+    """The fused int8 path through the whole model must equal a plain
+    forward over the dequantized weights — quantization error itself
+    cancels out of this comparison."""
+    qparams = quantize_params(PARAMS)
+    dparams = dequantize_tree(qparams)
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, CFG.vocab_size, (2, 12)),
+        jnp.int32)
+    lens = jnp.asarray([12, 9], jnp.int32)
+    cache_q = KVCache.create(CFG, 2, 32, jnp.float32)
+    cache_d = KVCache.create(CFG, 2, 32, jnp.float32)
+    lq, cache_q = llama.prefill(qparams, CFG, tokens, lens, cache_q)
+    ld, cache_d = llama.prefill(dparams, CFG, tokens, lens, cache_d)
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(ld),
+                               rtol=2e-4, atol=2e-4)
+    nxt = jnp.argmax(lq[:, -1], -1).astype(jnp.int32)[:, None]
+    for _ in range(3):
+        lq, cache_q = llama.decode_step(qparams, CFG, nxt, cache_q)
+        ld, cache_d = llama.decode_step(dparams, CFG, nxt, cache_d)
+        np.testing.assert_allclose(np.asarray(lq), np.asarray(ld),
+                                   rtol=2e-4, atol=2e-4)
+        nxt = jnp.argmax(lq[:, 0], -1).astype(jnp.int32)[:, None]
+
+
+def test_quantized_close_to_full_precision():
+    """Sanity vs the ORIGINAL weights: per-channel int8 keeps the logits
+    direction (cosine similarity), not bitwise equality."""
+    qparams = quantize_params(PARAMS)
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, CFG.vocab_size, (1, 10)),
+        jnp.int32)
+    lens = jnp.asarray([10], jnp.int32)
+    lq, _ = llama.prefill(qparams, CFG, tokens, lens,
+                          KVCache.create(CFG, 1, 16, jnp.float32))
+    lf, _ = llama.prefill(PARAMS, CFG, tokens, lens,
+                          KVCache.create(CFG, 1, 16, jnp.float32))
+    a = np.asarray(lq).reshape(-1)
+    b = np.asarray(lf).reshape(-1)
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+    assert cos > 0.99, cos
+
+
+def test_moe_quantized_matches_dequantized_oracle():
+    mcfg = get_config("tiny-moe")
+    mparams = mixtral.init_params(mcfg, jax.random.PRNGKey(1),
+                                  dtype=jnp.float32)
+    qparams = quantize_params(mparams)
+    dparams = dequantize_tree(qparams)
+    tokens = jnp.asarray(
+        np.random.default_rng(4).integers(0, mcfg.vocab_size, (2, 8)),
+        jnp.int32)
+    lens = jnp.asarray([8, 6], jnp.int32)
+    lq, _ = mixtral.prefill(qparams, mcfg, tokens, lens,
+                            KVCache.create(mcfg, 2, 16, jnp.float32))
+    ld, _ = mixtral.prefill(dparams, mcfg, tokens, lens,
+                            KVCache.create(mcfg, 2, 16, jnp.float32))
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(ld),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_quantized_params_serve_through_engine():
+    """QTensor leaves must ride the scheduler's jitted programs (scan,
+    donation, scatter installs) end to end: greedy decode through the
+    batching engine equals the solo quantized oracle."""
+    from p2p_llm_chat_tpu.serve.backend import (GenerateOptions,
+                                                GenerateRequest,
+                                                RequestStats)
+    from p2p_llm_chat_tpu.serve.engine import TPUEngine
+    from p2p_llm_chat_tpu.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer(vocab_size=CFG.vocab_size)
+    qparams = quantize_params(PARAMS)
+    stop_ids = set(CFG.eos_token_ids) | {tok.eos_id}
+
+    def oracle(prompt, max_new):
+        ids = tok.encode(prompt, add_bos=True)
+        cache = KVCache.create(CFG, 1, 64, jnp.float32)
+        logits, cache = llama.prefill(qparams, CFG, jnp.asarray([ids]),
+                                      jnp.asarray([len(ids)]), cache)
+        last = np.asarray(logits[0, len(ids) - 1])
+        out = []
+        for _ in range(max_new):
+            t = int(last.argmax())
+            if t in stop_ids:
+                break
+            out.append(t)
+            lg, cache = llama.decode_step(qparams, CFG, jnp.asarray([[t]]),
+                                          cache)
+            last = np.asarray(lg[0, 0])
+        return tok.decode(out)
+
+    eng = TPUEngine(qparams, CFG, tok, num_slots=2, max_seq=64)
+    try:
+        req = GenerateRequest(prompt="quantized serving",
+                              options=GenerateOptions(max_tokens=8))
+        got = "".join(eng.generate_stream(req, RequestStats()))
+        assert got == oracle("quantized serving", 8)
+    finally:
+        eng.stop()
+
+
+def test_quantize_after_shard_matches_unsharded():
+    """quantize_params on tp-sharded weights: the q/s leaves derive their
+    shardings from the weight's and the forward still matches the
+    single-device quantized oracle."""
+    from p2p_llm_chat_tpu.parallel.mesh import MeshConfig, make_mesh
+    from p2p_llm_chat_tpu.parallel.sharding import shard_params
+
+    mesh = make_mesh(MeshConfig(tp=4))
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, CFG.vocab_size, (2, 8)),
+        jnp.int32)
+    lens = jnp.asarray([8, 8], jnp.int32)
+    ref, _ = llama.prefill(quantize_params(PARAMS), CFG, tokens, lens,
+                           KVCache.create(CFG, 2, 16, jnp.float32))
+    sharded = shard_params(PARAMS, llama.param_axes(CFG), mesh)
+    qsharded = quantize_params(sharded)
+    got, _ = llama.prefill(qsharded, CFG, tokens, lens,
+                           KVCache.create(CFG, 2, 16, jnp.float32),
+                           mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
