@@ -1,16 +1,19 @@
 """Device-mesh construction.
 
 Canonical mesh axes for the whole framework (scoped by BASELINE.json's
-configs — TP for 70B over ICI, EP for Mixtral, DP/batching, and a sequence
-axis so context parallelism can attach, per SURVEY.md §2):
+configs — TP for 70B over ICI, EP for Mixtral, DP/batching, and sequence/
+pipeline axes so context and pipeline parallelism can attach, per
+SURVEY.md §2):
 
 - ``dp``: data parallel (replicated weights, sharded batch)
+- ``pp``: pipeline parallel (layer stack sharded into stages —
+  parallel/pipeline.py)
 - ``tp``: tensor parallel (sharded heads / mlp / vocab)
 - ``ep``: expert parallel (sharded experts; reuses tp chips for dense parts)
 - ``sp``: sequence/context parallel (ring attention shards)
 
 A mesh never needs all axes > 1; size-1 axes cost nothing under XLA's
-partitioner, so every program is written against the full 4-axis mesh.
+partitioner, so every program is written against the full 5-axis mesh.
 """
 
 from __future__ import annotations
@@ -21,19 +24,20 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "ep", "sp", "tp")
+AXES = ("dp", "pp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
 class MeshConfig:
     dp: int = 1
+    pp: int = 1
     ep: int = 1
     sp: int = 1
     tp: int = 1
 
     @property
     def size(self) -> int:
-        return self.dp * self.ep * self.sp * self.tp
+        return self.dp * self.pp * self.ep * self.sp * self.tp
 
     @classmethod
     def for_devices(cls, n: int, tp: int | None = None) -> "MeshConfig":
@@ -53,7 +57,8 @@ def make_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
     devs = devices if devices is not None else jax.devices()
     if cfg.size > len(devs):
         raise ValueError(f"mesh needs {cfg.size} devices, have {len(devs)}")
-    arr = np.array(devs[: cfg.size]).reshape(cfg.dp, cfg.ep, cfg.sp, cfg.tp)
+    arr = np.array(devs[: cfg.size]).reshape(cfg.dp, cfg.pp, cfg.ep, cfg.sp,
+                                             cfg.tp)
     return Mesh(arr, AXES)
 
 
