@@ -116,7 +116,12 @@ def build_engine_from_env() -> Backend:
         mesh = local_mesh(tp=tp)
 
     if ckpt_dir:
-        params, config = load_checkpoint(ckpt_dir, mesh=mesh)
+        from ..models.checkpoint import is_native_checkpoint
+        if is_native_checkpoint(ckpt_dir):
+            from ..models.checkpoint import load_checkpoint as load_native
+            params, config = load_native(ckpt_dir, mesh=mesh)
+        else:
+            params, config = load_checkpoint(ckpt_dir, mesh=mesh)
         tokenizer = load_tokenizer(ckpt_dir, vocab_size=config.vocab_size)
     else:
         config = get_config(env_or("MODEL_CONFIG", "tiny"))
