@@ -72,12 +72,24 @@ class OllamaServer:
         self.router.add("GET", "/", lambda r: Response(
             200, "Ollama is running", content_type="text/plain"))
         self.router.add("HEAD", "/", lambda r: Response(200, ""))
-        self.router.add("GET", "/metrics", lambda r: Response(
-            200, self.metrics.render(), content_type="text/plain; version=0.0.4"))
+        self.router.add("GET", "/metrics", self._metrics)
         self.router.add("GET", "/healthz", lambda r: Response(200, {"status": "ok"}))
         self._server: Optional[HttpServer] = None
 
     # -- helpers -------------------------------------------------------------
+
+    def _metrics(self, req: Request) -> Response:
+        """HTTP-plane registry + the backend's serving-plane gauges (batch
+        occupancy, queue depth, KV pool — SURVEY.md §5 metrics plan)."""
+        text = self.metrics.render()
+        snap = getattr(self.backend, "metrics_snapshot", None)
+        if snap is not None:
+            lines = []
+            for name, v in sorted(snap().items()):
+                kind = "counter" if name.endswith("_total") else "gauge"
+                lines.append(f"# TYPE {name} {kind}\n{name} {v}\n")
+            text += "".join(lines)
+        return Response(200, text, content_type="text/plain; version=0.0.4")
 
     def _finalize_record(self, model: str, stats: RequestStats,
                          started: float) -> dict:

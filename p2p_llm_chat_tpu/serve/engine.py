@@ -90,6 +90,11 @@ class TPUEngine:
     def models(self) -> list[str]:
         return [self.name]
 
+    def metrics_snapshot(self) -> dict[str, float]:
+        """Serving-plane gauges (batch occupancy, queue depth, KV pool)
+        merged into the API front's /metrics (serve/api.py)."""
+        return self.scheduler.metrics_snapshot()
+
     def stop(self) -> None:
         self.scheduler.stop()
 

@@ -172,6 +172,12 @@ class BatchScheduler:
 
         self._admit_q: "queue.Queue[Optional[_Slot]]" = queue.Queue()
         self._closed = threading.Event()
+        # Serving-plane counters (SURVEY.md §5 metrics plan: queue depth,
+        # batch occupancy, decode ticks). Plain ints written only by the
+        # scheduler thread; snapshotted by metrics_snapshot().
+        self._n_admitted = 0
+        self._n_decode_ticks = 0
+        self._n_expired = 0
 
         # Jitted programs. decode is compiled once; admit once per
         # (chunk-rows, prompt-bucket) shape pair — both power-of-two
@@ -541,7 +547,25 @@ class BatchScheduler:
                     "failing it", age, self.queue_timeout_s)
         slot.fail(f"not admitted within {self.queue_timeout_s:.0f}s "
                   "(server at capacity)")
+        self._n_expired += 1
         return True
+
+    def metrics_snapshot(self) -> dict[str, float]:
+        """Serving-plane gauges/counters for the /metrics endpoint (read
+        from any thread; values are monotonically-written ints and
+        len()s, so torn reads are harmless)."""
+        out = {
+            "serve_batch_occupancy": sum(s is not None for s in self._slots),
+            "serve_batch_slots": self.num_slots,
+            "serve_queue_depth": self._admit_q.qsize() + len(self._waiting),
+            "serve_admitted_total": self._n_admitted,
+            "serve_decode_ticks_total": self._n_decode_ticks,
+            "serve_queue_expired_total": self._n_expired,
+        }
+        if self.kv_mode == "paged":
+            out["serve_kv_free_pages"] = self._alloc.free_pages
+            out["serve_kv_total_pages"] = self.num_pages - 1
+        return out
 
     def _try_reserve(self, slot: _Slot) -> bool:
         """Paged mode: claim the slot's page budget (prompt + generation
@@ -707,6 +731,7 @@ class BatchScheduler:
         first_toks = np.asarray(toks_dev)        # tiny sync readback
 
         now = time.monotonic()
+        self._n_admitted += len(chunk)
         for i, (slot, row) in enumerate(zip(chunk, rows)):
             if slot.stats is not None:
                 slot.stats.ttft_s = now - slot.req.arrival_time
@@ -719,6 +744,7 @@ class BatchScheduler:
     def _decode_tick(self) -> None:
         """One batched decode step: all active rows advance one token.
         One dispatch, one B-int32 readback."""
+        self._n_decode_ticks += 1
         active = tuple(s is not None for s in self._slots)
         if active != self._active_host:
             # Re-upload the mask only when the active set changed (it only

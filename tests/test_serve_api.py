@@ -102,3 +102,20 @@ def test_invalid_json_is_400(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(req, timeout=5)
     assert e.value.code == 400
+
+
+def test_metrics_merges_backend_serving_gauges():
+    """A backend exposing metrics_snapshot() (the TPU engine's scheduler
+    gauges — batch occupancy, queue depth) gets merged into /metrics."""
+    class Snappy(FakeLLM):
+        def metrics_snapshot(self):
+            return {"serve_batch_occupancy": 3, "serve_admitted_total": 7}
+
+    srv = OllamaServer(Snappy(), addr="127.0.0.1:0").start()
+    try:
+        with urllib.request.urlopen(f"{srv.url}/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+        assert "# TYPE serve_batch_occupancy gauge\nserve_batch_occupancy 3" in text
+        assert "# TYPE serve_admitted_total counter\nserve_admitted_total 7" in text
+    finally:
+        srv.stop()
