@@ -128,10 +128,12 @@ def main() -> None:
 
     # -- end-to-end serving: p50 TTFT at `slots` concurrent peers ------------
     admit_chunk = int(os.environ.get("BENCH_ADMIT_CHUNK", "0")) or None
+    spec_k = int(os.environ.get("BENCH_SPEC", "0"))
     tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
     sched = BatchScheduler(params, config, tokenizer, num_slots=slots,
                            max_seq=max_seq, kv_mode=kv_mode,
-                           page_size=page_size, admit_chunk=admit_chunk)
+                           page_size=page_size, admit_chunk=admit_chunk,
+                           spec_k=spec_k)
     prompt = ("Draft a concise, friendly reply to the following message:\n\n"
               "Hey, are we still meeting tomorrow at 10?\n\nReply:")
     opts = GenerateOptions(max_tokens=new_tokens, temperature=0.7, top_p=0.9,
@@ -191,6 +193,7 @@ def main() -> None:
             "platform": platform,
             "kv_mode": kv_mode,
             "quant": quant or None,
+            "spec_k": spec_k or None,
             "page_size": page_size if kv_mode == "paged" else None,
             "config": cfg_name,
             "n_params_b": round(n_params / 1e9, 3),

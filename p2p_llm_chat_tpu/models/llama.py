@@ -173,10 +173,13 @@ def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
     q, k, v = _attn_qkv(h, lp, config, inv_freq, positions, mesh, rules)
 
     # Scatter this step's k/v into the carried cache at (layer, row,
-    # write_pos); rows write S consecutive slots, in place.
+    # write_pos); rows write S consecutive slots, in place. mode="drop":
+    # in-bounds for every normal path; the speculative verify_step aims
+    # positions past a near-budget row's cache at max_seq on purpose
+    # (never-trusted draft slots must not clamp onto the last real slot).
     b_idx = jnp.arange(B)[:, None]
-    cache_k = cache_k.at[layer, b_idx, write_pos].set(k)
-    cache_v = cache_v.at[layer, b_idx, write_pos].set(v)
+    cache_k = cache_k.at[layer, b_idx, write_pos].set(k, mode="drop")
+    cache_v = cache_v.at[layer, b_idx, write_pos].set(v, mode="drop")
     k_layer = jax.lax.dynamic_index_in_dim(cache_k, layer, 0, keepdims=False)
     v_layer = jax.lax.dynamic_index_in_dim(cache_v, layer, 0, keepdims=False)
     if kv_window is not None and kv_window < k_layer.shape[1]:
@@ -278,6 +281,40 @@ def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
                             mesh, rules, kv_window=kv_window)
     inc = jnp.ones_like(cache.lengths) if active is None else active.astype(jnp.int32)
     return logits, cache._replace(lengths=cache.lengths + inc)
+
+
+def verify_step(params: dict, config: ModelConfig, tokens: jax.Array,
+                cache: KVCache, mesh: Optional[Mesh] = None,
+                rules: LogicalRules = DEFAULT_RULES,
+                kv_window: Optional[int] = None,
+                mlp_fn=None) -> tuple[jax.Array, KVCache]:
+    """Speculative-decoding verify: score S candidate positions per row in
+    ONE forward (the multi-token generalisation of :func:`decode_step`).
+
+    tokens: [B,S] = [current token, draft_0, ..., draft_{S-2}] per row;
+    row b's position j writes cache slot ``lengths[b]+j`` and attends
+    slots [0, lengths[b]+j]. Lengths are NOT advanced here — the caller
+    runs its acceptance rule (models/sampling.spec_verify_batched) on the
+    returned logits and advances by ``accepted+1``. Slots past the
+    accepted prefix hold rejected drafts' kv: stale beyond the new
+    length, overwritten before anything trusts them (the same invariant
+    that parks rows — speculative rollback is free). The caller caps
+    acceptance for near-budget rows; their untrusted writes past
+    ``max_seq`` drop (see _block).
+
+    Returns (logits [B,S,vocab] f32 — logits[:, j] is the model's
+    distribution for the token AFTER input j — and the cache with the S
+    candidate slots written, lengths unchanged).
+    """
+    B, S = tokens.shape
+    positions = cache.lengths[:, None] + jnp.arange(S)[None, :]   # [B,S]
+    window = kv_window if kv_window is not None else cache.k.shape[2]
+    # Query j of row b may see kv slots [0, lengths[b]+j] (its own slot
+    # included — matches decode_step's lengths+1 masking at S=1).
+    mask = (jnp.arange(window)[None, None, :]
+            <= positions[:, :, None])[:, None]                    # [B,1,S,W]
+    return forward(params, config, tokens, positions, cache, mask,
+                   mesh, rules, kv_window=kv_window, mlp_fn=mlp_fn)
 
 
 # -- paged decode (Pallas kernel path) ----------------------------------------

@@ -231,6 +231,16 @@ def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
     return logits, cache._replace(lengths=cache.lengths + inc)
 
 
+def verify_step(params: dict, config: ModelConfig, tokens: jax.Array,
+                cache: KVCache, mesh: Optional[Mesh] = None,
+                rules: LogicalRules = DEFAULT_RULES,
+                kv_window: Optional[int] = None) -> tuple[jax.Array, KVCache]:
+    """llama.verify_step with the MoE MLP (speculative-decoding verify;
+    the token count is tiny, so the expert bucket stays exact)."""
+    return llama.verify_step(params, config, tokens, cache, mesh, rules,
+                             kv_window, mlp_fn=_mlp_fn(config, None))
+
+
 def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
                       cache, mesh: Optional[Mesh] = None,
                       rules: LogicalRules = DEFAULT_RULES,

@@ -67,6 +67,34 @@ def sample(logits: jax.Array, key: jax.Array, temperature: float = 0.0,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _warp(sorted_logits: jax.Array, temperature: jax.Array,
+          top_k: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Shared per-row warping over a descending top-c candidate axis:
+    temperature, then top-k, then top-p on the renormalised distribution
+    (the filter order of :func:`sample` / :func:`sample_np`). temperature/
+    top_k/top_p are [B] and broadcast over any middle axes of
+    ``sorted_logits`` [B, ..., C]. Returns warped probabilities.
+
+    One implementation on purpose: :func:`sample_batched` (the decode
+    tick) and :func:`spec_verify_batched` (speculative acceptance) MUST
+    warp identically or speculative sampling stops matching sequential
+    sampling's distribution."""
+    extra = sorted_logits.ndim - 2
+    def bx(v):          # [B] -> [B, 1..., 1] matching sorted_logits
+        return v.reshape(v.shape[0], *([1] * extra), 1)
+    C = sorted_logits.shape[-1]
+    ranks = jnp.arange(C)
+    keep_k = (bx(top_k) <= 0) | (ranks < bx(top_k))
+    temp = jnp.maximum(bx(temperature), 1e-6)
+    k_masked = jnp.where(keep_k, sorted_logits / temp, NEG_INF)
+    probs = jax.nn.softmax(k_masked, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = (bx(top_p) >= 1.0) | ((cum - probs) < bx(top_p))
+    keep = (keep_k & keep_p).at[..., 0].set(True)     # never empty
+    return jax.nn.softmax(jnp.where(keep, sorted_logits / temp, NEG_INF),
+                          axis=-1)
+
+
 def sample_batched(logits: jax.Array, keys: jax.Array, temperature: jax.Array,
                    top_k: jax.Array, top_p: jax.Array,
                    top_c: int = 64) -> tuple[jax.Array, jax.Array]:
@@ -91,26 +119,101 @@ def sample_batched(logits: jax.Array, keys: jax.Array, temperature: jax.Array,
     B, V = logits.shape
     C = min(top_c, V)
     sorted_logits, order = jax.lax.top_k(logits, C)        # [B,C] descending
-    ranks = jnp.arange(C)[None, :]
-    keep_k = (top_k[:, None] <= 0) | (ranks < top_k[:, None])
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    # top-p is evaluated on the top-k-filtered, renormalised distribution —
-    # the same order sample/sample_np apply the filters in.
-    k_masked = jnp.where(keep_k, sorted_logits / temp, NEG_INF)
-    probs = jax.nn.softmax(k_masked, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep_p = (top_p[:, None] >= 1.0) | ((cum - probs) < top_p[:, None])
-    keep = keep_k & keep_p
-    keep = keep.at[:, 0].set(True)                         # never empty
-    masked = jnp.where(keep, sorted_logits / temp, NEG_INF)
+    wprobs = _warp(sorted_logits, temperature, top_k, top_p)
 
     split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)   # [B,2,2]
     new_keys, subs = split[:, 0], split[:, 1]
-    choice = jax.vmap(jax.random.categorical)(subs, masked)    # [B] ranks
+    choice = jax.vmap(jax.random.categorical)(
+        subs, jnp.where(wprobs > 0, jnp.log(wprobs), NEG_INF)) # [B] ranks
     sampled = jnp.take_along_axis(order, choice[:, None], axis=-1)[:, 0]
     tok = jnp.where(temperature <= 0.0,
                     jnp.argmax(logits, axis=-1), sampled).astype(jnp.int32)
     return tok, new_keys
+
+
+def spec_verify_batched(logits: jax.Array, drafts: jax.Array,
+                        keys: jax.Array, temperature: jax.Array,
+                        top_k: jax.Array, top_p: jax.Array,
+                        max_accept: jax.Array,
+                        top_c: int = 64) -> tuple[jax.Array, jax.Array,
+                                                  jax.Array]:
+    """Speculative-decoding acceptance over one verify pass.
+
+    logits: [B,S,V] f32 from models.llama.verify_step (position j is the
+    model's distribution AFTER input j); drafts: [B,S-1] proposed tokens
+    (the inputs at positions 1..S-1); keys/temperature/top_k/top_p: [B]
+    per-row sampling state (serve/scheduler.py); max_accept: [B] budget
+    cap (0..S-1).
+
+    The draft distribution q is a point mass (prompt-lookup drafting), so
+    exact speculative sampling reduces to: accept draft_j with
+    probability p_warped(draft_j); on first rejection sample the
+    replacement from p with the draft token removed and renormalised; if
+    every draft is accepted, sample the bonus token from the final
+    position's distribution unmodified. Greedy rows (temperature<=0)
+    accept while draft == argmax and correct with the argmax — bit-exact
+    with the sequential greedy loop. The warped distribution (same
+    temperature/top-k/top-p filters and the same ``top_c`` truncation as
+    :func:`sample_batched`) is what acceptance and residual sampling use,
+    so the emitted stream is distributed exactly as sequential sampling.
+
+    Returns (accepted [B] int32 in [0, S-1], correction [B] int32 — the
+    token at stream position ``accepted`` —, advanced keys [B,2]).
+    """
+    B, S, V = logits.shape
+    K = S - 1
+    C = min(top_c, V)
+    flat = logits.reshape(B * S, V)
+    sorted_logits, order = jax.lax.top_k(flat, C)          # [B*S,C]
+    sorted_logits = sorted_logits.reshape(B, S, C)
+    order = order.reshape(B, S, C)
+    wprobs = _warp(sorted_logits, temperature, top_k, top_p)  # [B,S,C]
+
+    # Per-row keys -> carried key + one dedicated correction key + one
+    # acceptance-uniform key per draft position. The correction key MUST
+    # be distinct from the rejecting position's uniform key: reusing it
+    # correlates the rejection event with the resample and skews the
+    # residual distribution.
+    split = jax.vmap(lambda k: jax.random.split(k, K + 2))(keys)  # [B,K+2,2]
+    new_keys, corr_key, subs = split[:, 0], split[:, 1], split[:, 2:]
+
+    greedy_row = (temperature <= 0.0)[:, None]                    # [B,1]
+    argmax_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)    # [B,S]
+
+    # Acceptance per draft position j (draft_j is scored by logits[:, j]).
+    dmatch = order[:, :K] == drafts[:, :, None]                   # [B,K,C]
+    p_draft = jnp.sum(jnp.where(dmatch, wprobs[:, :K], 0.0), -1)  # [B,K]
+    u = jax.vmap(jax.vmap(jax.random.uniform))(subs)              # [B,K]
+    ok = jnp.where(greedy_row, drafts == argmax_tok[:, :K], u < p_draft)
+    ok &= jnp.arange(K)[None, :] < max_accept[:, None]
+    accepted = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+
+    # Correction at stream position `accepted`. The residual (draft token
+    # removed, renormalised) applies ONLY when the stop was a
+    # *probabilistic* rejection (accepted < max_accept: the accept test
+    # actually ran and failed there). A stop forced by the budget cap —
+    # including the zero-filled drafts of undrafted rows (max_accept=0) —
+    # or the all-accepted bonus position was never tested, so its token
+    # samples from the unmodified warped distribution: removing an
+    # untested token would skew the stream (and can zero out a top_k=1
+    # row's whole distribution).
+    j = accepted[:, None, None]                                   # [B,1,1]
+    probs_j = jnp.take_along_axis(wprobs, j, axis=1)[:, 0]        # [B,C]
+    order_j = jnp.take_along_axis(order, j, axis=1)[:, 0]         # [B,C]
+    prob_rejected = accepted < jnp.minimum(max_accept, K)
+    # Rejected-draft token of this position (only defined when accepted<K).
+    dr = jnp.take_along_axis(drafts, jnp.minimum(accepted, K - 1)[:, None],
+                             axis=1)[:, 0] if K > 0 else jnp.zeros(
+                                 (B,), jnp.int32)
+    drop = (order_j == dr[:, None]) & prob_rejected[:, None]
+    resid = jnp.where(drop, 0.0, probs_j)
+    resid = resid / jnp.maximum(resid.sum(-1, keepdims=True), 1e-20)
+    choice = jax.vmap(jax.random.categorical)(
+        corr_key, jnp.where(resid > 0, jnp.log(resid), NEG_INF))
+    sampled = jnp.take_along_axis(order_j, choice[:, None], -1)[:, 0]
+    g_corr = jnp.take_along_axis(argmax_tok, accepted[:, None], -1)[:, 0]
+    correction = jnp.where(greedy_row[:, 0], g_corr, sampled).astype(jnp.int32)
+    return accepted.astype(jnp.int32), correction, new_keys
 
 
 def sample_np(logits: np.ndarray, rng: np.random.Generator,

@@ -1,0 +1,83 @@
+"""Multi-host (DCN) distributed runtime entry points.
+
+The reference's only "distributed backend" is point-to-point chat streams
+(SURVEY.md §5: no NCCL/MPI/Gloo anywhere); the TPU-native equivalent is
+XLA collectives — ICI within a slice, DCN between hosts — driven entirely
+by device meshes. This module is the multi-host glue:
+
+- :func:`init_distributed` brings a process into the JAX distributed
+  runtime (coordinator handshake, global device visibility). After it,
+  ``jax.devices()`` spans every host and the regular ``make_mesh`` /
+  ``shard_map`` programs run unchanged — XLA routes collectives over ICI
+  inside a slice and DCN across slices.
+- :func:`multihost_mesh` builds the hybrid mesh for that world: the
+  slower DCN axis carries the replication-style parallelism (``dp`` —
+  gradient/batch-level, least-frequent comms) while tp/ep/sp stay inside
+  a slice on ICI, the layout the bandwidth hierarchy demands.
+
+Env surface (cluster-launcher friendly, same env-first style as the rest
+of the framework): ``JAX_COORDINATOR`` (host:port of process 0),
+``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``. On Cloud TPU pods
+``jax.distributed.initialize()`` auto-discovers all three; the envs are
+for bare-metal/manual launches.
+
+Single-host fallback: with no coordinator configured this is a no-op and
+everything runs on the local devices — the same code path the tests and
+the single-chip bench use.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh
+
+from ..utils.log import get_logger
+from .mesh import AXES, MeshConfig, make_mesh
+
+log = get_logger("parallel.distributed")
+
+
+def init_distributed(coordinator: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Join the multi-host runtime; returns True when distributed mode is
+    active. No-op (False) when neither args nor env configure a
+    coordinator and the platform can't auto-discover one."""
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR")
+    n = num_processes if num_processes is not None else int(
+        os.environ.get("JAX_NUM_PROCESSES", "0") or 0)
+    pid = process_id if process_id is not None else int(
+        os.environ.get("JAX_PROCESS_ID", "-1"))
+    if coordinator is None and n == 0:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=n or None,
+        process_id=pid if pid >= 0 else None,
+    )
+    log.info("distributed runtime up: process %d/%d, %d global devices",
+             jax.process_index(), jax.process_count(), len(jax.devices()))
+    return True
+
+
+def multihost_mesh(cfg: MeshConfig) -> Mesh:
+    """Mesh over the global (multi-host) device set with the DCN/ICI
+    split: ``dp`` spans hosts over DCN; pp/ep/sp/tp stay slice-local on
+    ICI. ``cfg.size`` must equal the global device count and ``cfg.dp``
+    must be a multiple of the process count (whole slices per replica).
+    """
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return make_mesh(cfg)
+    if cfg.dp % n_proc:
+        raise ValueError(
+            f"dp={cfg.dp} must be a multiple of process count {n_proc} "
+            "(DCN carries dp; a replica cannot straddle a host boundary)")
+    from jax.experimental import mesh_utils
+    ici = (cfg.dp // n_proc, cfg.pp, cfg.ep, cfg.sp, cfg.tp)
+    dcn = (n_proc, 1, 1, 1, 1)
+    arr = mesh_utils.create_hybrid_device_mesh(ici, dcn)
+    return Mesh(arr, AXES)

@@ -53,13 +53,24 @@ def make_mesh(cfg: MeshConfig, devices: list | None = None) -> Mesh:
     Axis order matters for ICI locality: ``tp`` is innermost so
     tensor-parallel collectives (the per-layer latency-critical ones) ride
     neighbouring chips; ``dp`` is outermost (least-frequent comms).
+
+    When the mesh covers every visible device, device assignment goes
+    through ``mesh_utils.create_device_mesh``, which matches the logical
+    axes onto the slice's physical ICI topology (ring/torus orderings)
+    instead of flat enumeration order — measurably better collective
+    bandwidth on real 2D-torus slices, identical behavior on CPU.
     """
+    shape = (cfg.dp, cfg.pp, cfg.ep, cfg.sp, cfg.tp)
     devs = devices if devices is not None else jax.devices()
     if cfg.size > len(devs):
         raise ValueError(f"mesh needs {cfg.size} devices, have {len(devs)}")
-    arr = np.array(devs[: cfg.size]).reshape(cfg.dp, cfg.pp, cfg.ep, cfg.sp,
-                                             cfg.tp)
-    return Mesh(arr, AXES)
+    if devices is None and cfg.size == len(devs):
+        try:
+            from jax.experimental import mesh_utils
+            return Mesh(mesh_utils.create_device_mesh(shape), AXES)
+        except Exception:   # noqa: BLE001 — topology helper is best-effort
+            pass
+    return Mesh(np.array(devs[: cfg.size]).reshape(shape), AXES)
 
 
 def local_mesh(tp: int | None = None) -> Mesh:

@@ -24,7 +24,8 @@ Env surface (reference-style env-first config, utils/env.py):
 ``SERVE_MAX_SEQ``, ``SERVE_TP``, ``LLM_MODEL`` (served model tag),
 ``SERVE_KV`` (dense|paged), ``SERVE_PAGE_SIZE``, ``SERVE_PAGES``,
 ``SERVE_ADMIT_CHUNK``, ``SERVE_QUEUE_TIMEOUT`` (seconds, 0 disables),
-``SERVE_QUANT`` (int8 = weight-only quantization, models/quant.py).
+``SERVE_QUANT`` (int8 = weight-only quantization, models/quant.py),
+``SERVE_SPEC`` (K>0 = speculative decoding with prompt-lookup drafts).
 """
 
 from __future__ import annotations
@@ -55,7 +56,8 @@ class TPUEngine:
                  page_size: int = 64,
                  num_pages: Optional[int] = None,
                  admit_chunk: Optional[int] = None,
-                 queue_timeout_s: Optional[float] = 60.0) -> None:
+                 queue_timeout_s: Optional[float] = 60.0,
+                 spec_k: int = 0) -> None:
         self.name = name or config.name
         self.config = config
         self.scheduler = BatchScheduler(params, config, tokenizer,
@@ -64,7 +66,8 @@ class TPUEngine:
                                         page_size=page_size,
                                         num_pages=num_pages,
                                         admit_chunk=admit_chunk,
-                                        queue_timeout_s=queue_timeout_s)
+                                        queue_timeout_s=queue_timeout_s,
+                                        spec_k=spec_k)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
@@ -114,6 +117,9 @@ def build_engine_from_env() -> Backend:
     # reference client's 60 s LLM timeout (web/streamlit_app.py:95).
     qt = float(env_or("SERVE_QUEUE_TIMEOUT", "60"))
     queue_timeout_s = qt if qt > 0 else None
+    spec_k = env_int("SERVE_SPEC", 0)
+    if spec_k and kv_mode != "dense":
+        raise SystemExit("SERVE_SPEC needs SERVE_KV=dense")
 
     mesh = None
     if tp > 1:
@@ -149,7 +155,7 @@ def build_engine_from_env() -> Backend:
                        max_seq=max_seq, mesh=mesh, kv_mode=kv_mode,
                        page_size=page_size, num_pages=num_pages,
                        admit_chunk=admit_chunk,
-                       queue_timeout_s=queue_timeout_s,
+                       queue_timeout_s=queue_timeout_s, spec_k=spec_k,
                        name=env_or("LLM_MODEL", config.name))
     warmup = env_or("SERVE_WARMUP", "128,256")
     if warmup and warmup != "0":

@@ -94,6 +94,7 @@ class _Slot:
     pages: Optional[list[int]] = None                  # paged mode: physical pages
     cancelled: threading.Event = field(default_factory=threading.Event)
     error: Optional[str] = None                        # surfaced by submit()
+    drafter: Optional[object] = None                   # spec-decode NGramDrafter
 
     def push(self, delta: str) -> None:
         if delta:
@@ -122,7 +123,8 @@ class BatchScheduler:
                  page_size: int = 64,
                  num_pages: Optional[int] = None,
                  admit_chunk: Optional[int] = None,
-                 queue_timeout_s: Optional[float] = 60.0) -> None:
+                 queue_timeout_s: Optional[float] = 60.0,
+                 spec_k: int = 0) -> None:
         """``admit_chunk``: burst-admission width. None (default) admits a
         backlog burst through one full-width prefill (minimal dispatches —
         best p95/throughput); a fixed power-of-two (e.g. 8) staggers the
@@ -134,13 +136,23 @@ class BatchScheduler:
         that has not reached a batch row this long after arrival fails
         with an error instead of waiting forever (the reference's client
         gives up at 60 s — web/streamlit_app.py:95 — so holding its
-        request longer only wastes pool space). None disables."""
+        request longer only wastes pool space). None disables.
+
+        ``spec_k``: speculative decoding (prompt-lookup drafting,
+        utils/draft.py): each tick verifies up to K drafted tokens per
+        row in one forward (models/llama.verify_step + exact acceptance
+        sampling), so ticks emit 1..K+1 tokens. 0 disables. Dense KV
+        mode only — the paged verify path is future work."""
         if kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {kv_mode!r}")
         if admit_chunk is not None and admit_chunk < 1:
             raise ValueError(f"admit_chunk must be >= 1, got {admit_chunk}")
+        if spec_k and kv_mode != "dense":
+            raise ValueError("spec_k needs kv_mode='dense' (paged verify "
+                             "is not implemented)")
         self.admit_chunk = admit_chunk
         self.queue_timeout_s = queue_timeout_s
+        self.spec_k = spec_k
         self.config = config
         self.tokenizer = tokenizer
         self.num_slots = num_slots
@@ -178,6 +190,7 @@ class BatchScheduler:
         self._n_admitted = 0
         self._n_decode_ticks = 0
         self._n_expired = 0
+        self._n_spec_accepted = 0     # draft tokens accepted by verify
 
         # Jitted programs. decode is compiled once; admit once per
         # (chunk-rows, prompt-bucket) shape pair — both power-of-two
@@ -205,6 +218,31 @@ class BatchScheduler:
 
         self._make_decode = _make_decode
         self._decode_programs: dict[int, object] = {}
+
+        def _make_spec(kv_window: int):
+            """Speculative tick: one verify forward over [cur, draft_0..,
+            draft_{K-1}] per row + exact acceptance + length advance, all
+            fused. Host reads back 2×B int32 (accepted, correction)."""
+            from ..models.sampling import spec_verify_batched
+
+            def _spec(params, tokens, drafts, max_acc, cache, active,
+                      temps, top_ks, top_ps, keys):
+                logits, cache = model.verify_step(
+                    params, config, tokens, cache, mesh,
+                    kv_window=kv_window)
+                accepted, correction, keys = spec_verify_batched(
+                    logits.astype(jnp.float32), drafts, keys, temps,
+                    top_ks, top_ps, max_acc)
+                inc = jnp.where(active, accepted + 1, 0)
+                cache = cache._replace(
+                    lengths=cache.lengths + inc.astype(cache.lengths.dtype))
+                next_tokens = jnp.where(active[:, None],
+                                        correction[:, None], tokens[:, :1])
+                return accepted, correction, next_tokens, cache, keys
+            return jax.jit(_spec, donate_argnums=(4, 9))
+
+        self._make_spec = _make_spec
+        self._spec_programs: dict[int, object] = {}
 
         def _prefill_first_token(params, tokens, ints, floats):
             """Shared admission prologue (dense and paged): batched prefill
@@ -312,10 +350,18 @@ class BatchScheduler:
             self._decode_programs[window] = p
         return p
 
-    def _window(self) -> int:
+    def _spec_for(self, window: int):
+        p = self._spec_programs.get(window)
+        if p is None:
+            p = self._make_spec(window)
+            self._spec_programs[window] = p
+        return p
+
+    def _window(self, extra: int = 0) -> int:
         """Smallest power-of-two (>= 128, <= max_seq) attention window
-        covering every active row's context + the slot being written."""
-        need = 1 + max(s.ctx_len for s in self._slots if s is not None)
+        covering every active row's context + the slot(s) being written
+        (``extra`` > 0: the speculative tick writes K extra candidates)."""
+        need = 1 + extra + max(s.ctx_len for s in self._slots if s is not None)
         w = min(128, self.max_seq)
         while w < need:
             w *= 2
@@ -383,6 +429,15 @@ class BatchScheduler:
                 jnp.zeros((B,), bool), jnp.zeros((B,), jnp.float32),
                 jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
                 jnp.zeros((B, 2), jnp.uint32))
+            if self.spec_k:
+                K = self.spec_k
+                toks, *_ = self._spec_for(w)(
+                    self._params, jnp.zeros((B, K + 1), jnp.int32),
+                    jnp.zeros((B, K), jnp.int32), jnp.zeros((B,), jnp.int32),
+                    throwaway_cache(), jnp.zeros((B,), bool),
+                    jnp.zeros((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
+                    jnp.ones((B,), jnp.float32),
+                    jnp.zeros((B, 2), jnp.uint32))
         if self.kv_mode == "paged":
             # The row-release program (_zero_row_j) otherwise compiles on
             # the first request's release — inside a later request's TTFT.
@@ -562,6 +617,8 @@ class BatchScheduler:
             "serve_decode_ticks_total": self._n_decode_ticks,
             "serve_queue_expired_total": self._n_expired,
         }
+        if self.spec_k:
+            out["serve_spec_accepted_total"] = self._n_spec_accepted
         if self.kv_mode == "paged":
             out["serve_kv_free_pages"] = self._alloc.free_pages
             out["serve_kv_total_pages"] = self.num_pages - 1
@@ -736,14 +793,20 @@ class BatchScheduler:
             if slot.stats is not None:
                 slot.stats.ttft_s = now - slot.req.arrival_time
             slot.ctx_len = len(slot.prompt_ids)
+            if self.spec_k:
+                from ..utils.draft import NGramDrafter
+                slot.drafter = NGramDrafter(slot.prompt_ids, self.spec_k)
             self._slots[row] = slot
             if not self._append_token(slot, row, int(first_toks[pad + i])):
                 # finished on the very first token (eos / limits)
                 self._release(row)
 
     def _decode_tick(self) -> None:
-        """One batched decode step: all active rows advance one token.
-        One dispatch, one B-int32 readback."""
+        """One batched decode step: all active rows advance one token —
+        or, in speculative mode with at least one drafted row, 1..K+1
+        tokens through one verify dispatch (same size readbacks)."""
+        if self.spec_k and self._spec_tick():
+            return
         self._n_decode_ticks += 1
         active = tuple(s is not None for s in self._slots)
         if active != self._active_host:
@@ -766,6 +829,72 @@ class BatchScheduler:
             if not self._append_token(slot, row, int(toks[row])):
                 self._release(row)
 
+    def _spec_tick(self) -> bool:
+        """Speculative decode tick. Returns False (caller falls back to
+        the plain tick) when no active row has a usable draft — the
+        verify program computes K+1 positions for every row, so it only
+        pays off when something is drafted.
+
+        Per row: host proposes up to K tokens from its n-gram index
+        (utils/draft.py), the device verifies [cur, drafts...] in one
+        forward, accepts an exactly-distributed prefix
+        (models/sampling.spec_verify_batched), advances lengths by
+        accepted+1, and hands back (accepted, correction) — 2×B int32.
+        Rejected drafts' kv slots are stale-beyond-length (free
+        rollback); near-budget rows cap acceptance via max_acc so
+        trusted slots never pass their budget."""
+        K = self.spec_k
+        B = self.num_slots
+        tokens = np.zeros((B, K + 1), np.int32)
+        drafts = np.zeros((B, K), np.int32)
+        max_acc = np.zeros((B,), np.int32)
+        any_draft = False
+        for row, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            # Live slots always hold >= 1 generated token (admission
+            # appends the first or releases the row).
+            tokens[row, 0] = slot.ids[-1]
+            d = slot.drafter.draft() if slot.drafter is not None else []
+            budget = slot.ctx_budget - 2 - slot.ctx_len
+            m = max(0, min(len(d), budget))
+            if m:
+                any_draft = True
+                drafts[row, : len(d)] = d
+                tokens[row, 1: 1 + len(d)] = d
+                max_acc[row] = m
+        if not any_draft:
+            return False
+
+        self._n_decode_ticks += 1
+        active = tuple(s is not None for s in self._slots)
+        if active != self._active_host:
+            self._active_host = active
+            self._active_dev = jnp.asarray(np.array(active, bool))
+        spec_j = self._spec_for(self._window(extra=K))
+        (accepted, correction, self._next_dev, self._cache,
+         self._keys) = spec_j(
+            self._params, jnp.asarray(tokens), jnp.asarray(drafts),
+            jnp.asarray(max_acc), self._cache, self._active_dev,
+            self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys)
+        acc = np.asarray(accepted)               # [B] int32 — tiny sync
+        corr = np.asarray(correction)
+        for row, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            if slot.cancelled.is_set():
+                self._release(row)
+                continue
+            a = int(acc[row])
+            self._n_spec_accepted += a
+            emitted = [int(t) for t in drafts[row, :a]] + [int(corr[row])]
+            for t in emitted:
+                slot.ctx_len += 1    # per token, mirroring the plain tick
+                if not self._append_token(slot, row, t):
+                    self._release(row)
+                    break
+        return True
+
     def _append_token(self, slot: _Slot, row: int, tok: int) -> bool:
         """Record one sampled token; stream its text. Returns False when the
         request is finished (eos, stop string, length/context limits)."""
@@ -774,6 +903,8 @@ class BatchScheduler:
             slot.finish()
             return False
         slot.ids.append(tok)
+        if slot.drafter is not None:
+            slot.drafter.append(tok)
         if slot.stats is not None:
             slot.stats.completion_tokens = len(slot.ids)
         stop_hit = self._flush_text(slot)

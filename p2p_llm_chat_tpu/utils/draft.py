@@ -1,0 +1,43 @@
+"""Prompt-lookup drafting for speculative decoding.
+
+Reply suggestions quote and rephrase their context heavily (the co-pilot
+prompt embeds the peer's message verbatim — web/streamlit_app.py:93), so a
+draft model is unnecessary: proposing the continuation that followed the
+most recent earlier occurrence of the current trailing n-gram gets long
+accepted runs for free. The verify pass (models/llama.verify_step +
+sampling.spec_verify_batched) scores the whole draft in one forward.
+
+The index is incremental: O(1) per generated token, last occurrence wins
+(recency beats frequency for chat text).
+"""
+
+from __future__ import annotations
+
+
+class NGramDrafter:
+    """Per-request n-gram index over prompt + generated ids."""
+
+    def __init__(self, ids: list[int], k: int, n: int = 2) -> None:
+        self.k = k
+        self.n = n
+        self.ids = list(ids)
+        # ngram tuple -> position just after its latest occurrence,
+        # excluding the trailing ngram itself (its continuation doesn't
+        # exist yet — it's what we're trying to predict).
+        self._index: dict[tuple, int] = {}
+        for i in range(len(self.ids) - n):
+            self._index[tuple(self.ids[i: i + n])] = i + n
+
+    def append(self, tok: int) -> None:
+        if len(self.ids) >= self.n:
+            self._index[tuple(self.ids[-self.n:])] = len(self.ids)
+        self.ids.append(tok)
+
+    def draft(self) -> list[int]:
+        """Up to k proposed continuation tokens ([] = no match)."""
+        if len(self.ids) < self.n:
+            return []
+        pos = self._index.get(tuple(self.ids[-self.n:]))
+        if pos is None:
+            return []
+        return self.ids[pos: pos + self.k]

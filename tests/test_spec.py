@@ -1,0 +1,238 @@
+"""Speculative decoding tests: verify_step, acceptance sampling, n-gram
+drafting, and end-to-end greedy equivalence through the serving engine.
+
+The load-bearing property: with greedy sampling, speculative mode must be
+BIT-EXACT with the sequential loop (acceptance is argmax-match and the
+correction is the argmax); with sampling, the emitted stream must be
+distributed exactly as sequential sampling (pinned distributionally).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_llm_chat_tpu.models import llama, sampling
+from p2p_llm_chat_tpu.models.configs import get_config
+from p2p_llm_chat_tpu.models.llama import KVCache
+from p2p_llm_chat_tpu.serve.backend import (GenerateOptions, GenerateRequest,
+                                            RequestStats)
+from p2p_llm_chat_tpu.serve.engine import TPUEngine
+from p2p_llm_chat_tpu.tokenizer import ByteTokenizer
+from p2p_llm_chat_tpu.utils.draft import NGramDrafter
+
+pytestmark = pytest.mark.model
+
+CFG = get_config("tiny")
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+TOK = ByteTokenizer(vocab_size=CFG.vocab_size)
+STOP_IDS = set(CFG.eos_token_ids) | {TOK.eos_id}
+
+
+def greedy_oracle(prompt: str, max_new: int, max_seq: int = 128) -> str:
+    ids = TOK.encode(prompt, add_bos=True)
+    cache = KVCache.create(CFG, 1, max_seq, jnp.float32)
+    logits, cache = llama.prefill(PARAMS, CFG, jnp.asarray([ids]),
+                                  jnp.asarray([len(ids)]), cache)
+    last = np.asarray(logits[0, len(ids) - 1])
+    out = []
+    for _ in range(max_new):
+        t = int(last.argmax())
+        if t in STOP_IDS:
+            break
+        out.append(t)
+        lg, cache = llama.decode_step(PARAMS, CFG, jnp.asarray([[t]]), cache)
+        last = np.asarray(lg[0, 0])
+    return TOK.decode(out)
+
+
+# -- drafting -----------------------------------------------------------------
+
+def test_ngram_drafter_proposes_recent_continuation():
+    d = NGramDrafter([1, 2, 3, 4, 1, 2], k=3)
+    assert d.draft() == [3, 4, 1]          # continuation after last (1,2)
+    d2 = NGramDrafter([5, 6, 7], k=3)
+    assert d2.draft() == []                # trailing (6,7) never seen before
+
+
+def test_ngram_drafter_incremental_matches_batch():
+    ids = [1, 2, 3, 1, 2, 4, 1, 2]
+    inc = NGramDrafter(ids[:3], k=2)
+    for t in ids[3:]:
+        inc.append(t)
+    batch = NGramDrafter(ids, k=2)
+    assert inc.draft() == batch.draft() == [4, 1]   # last (1,2) cont.
+
+
+# -- verify_step --------------------------------------------------------------
+
+def test_verify_step_logits_match_sequential_decode():
+    """Feeding the true greedy continuation as drafts: position j's logits
+    must equal the j-th sequential decode_step's logits, and both caches
+    must agree on every trusted slot."""
+    rng = np.random.default_rng(0)
+    B, P, K = 2, 10, 3
+    tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, (B, P)), jnp.int32)
+    lens = jnp.full((B,), P, jnp.int32)
+
+    cache_a = KVCache.create(CFG, B, 32, jnp.float32)
+    logits, cache_a = llama.prefill(PARAMS, CFG, tokens, lens, cache_a)
+    cache_b = jax.tree.map(lambda x: x, cache_a)     # deep copy
+
+    # Sequential: current token + K greedy steps.
+    cur = jnp.argmax(logits[:, P - 1], -1).astype(jnp.int32)[:, None]
+    seq_logits = []
+    toks = [cur]
+    c = cache_a
+    t = cur
+    for _ in range(K + 1):
+        lg, c = llama.decode_step(PARAMS, CFG, t, c)
+        seq_logits.append(np.asarray(lg[:, 0]))
+        t = jnp.argmax(lg[:, 0], -1).astype(jnp.int32)[:, None]
+        toks.append(t)
+    stream = jnp.concatenate(toks[: K + 1], axis=1)   # [B, K+1]
+
+    ver_logits, cache_v = llama.verify_step(PARAMS, CFG, stream, cache_b)
+    for j in range(K + 1):
+        np.testing.assert_allclose(np.asarray(ver_logits[:, j]),
+                                   seq_logits[j], atol=2e-4, rtol=2e-4)
+    # Caches agree over the K+1 written slots.
+    for j in range(K + 1):
+        np.testing.assert_allclose(np.asarray(cache_v.k[:, :, P + j]),
+                                   np.asarray(c.k[:, :, P + j]),
+                                   atol=1e-5, rtol=1e-5)
+
+
+# -- acceptance rule ----------------------------------------------------------
+
+def _onehotish(B, S, V, peaks, sharp=50.0):
+    """Logits [B,S,V] strongly peaked at ``peaks`` [B,S]."""
+    lg = np.zeros((B, S, V), np.float32)
+    for b in range(B):
+        for s in range(S):
+            lg[b, s, peaks[b, s]] = sharp
+    return jnp.asarray(lg)
+
+
+def test_spec_verify_greedy_accepts_matching_prefix():
+    B, K, V = 3, 3, 16
+    peaks = np.array([[1, 2, 3, 4],     # row 0: all drafts match
+                      [1, 9, 9, 9],     # row 1: first draft mismatches
+                      [1, 2, 9, 9]], np.int32)     # row 2: 2 accepted...
+    drafts = jnp.asarray([[1, 2, 3], [2, 3, 4], [1, 9, 7]], jnp.int32)
+    logits = _onehotish(B, K + 1, V, peaks)
+    keys = jnp.zeros((B, 2), jnp.uint32)
+    zeros = jnp.zeros((B,), jnp.float32)
+    acc, corr, _ = sampling.spec_verify_batched(
+        logits, drafts, keys, zeros, jnp.zeros((B,), jnp.int32),
+        jnp.ones((B,), jnp.float32), jnp.full((B,), K, jnp.int32))
+    acc, corr = np.asarray(acc), np.asarray(corr)
+    # Row 0: drafts [1,2,3] == argmax prefix -> all 3 accepted, bonus = 4.
+    assert acc[0] == 3 and corr[0] == 4
+    # Row 1: draft 2 != argmax 1 -> 0 accepted, correction = argmax 1.
+    assert acc[1] == 0 and corr[1] == 1
+    # Row 2: drafts [1,9,...]: pos0 ok (1==1), pos1 9 != 2 -> 1 accepted,
+    # correction = argmax at pos1 = 2.
+    assert acc[2] == 1 and corr[2] == 2
+
+
+def test_spec_verify_respects_max_accept():
+    B, K, V = 1, 3, 8
+    peaks = np.array([[1, 2, 3, 4]], np.int32)
+    drafts = jnp.asarray([[1, 2, 3]], jnp.int32)
+    logits = _onehotish(B, K + 1, V, peaks)
+    acc, corr, _ = sampling.spec_verify_batched(
+        logits, drafts, jnp.zeros((B, 2), jnp.uint32),
+        jnp.zeros((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
+        jnp.ones((B,), jnp.float32), jnp.asarray([1], jnp.int32))
+    assert int(acc[0]) == 1 and int(corr[0]) == 2   # cut at the cap
+
+
+def test_spec_verify_sampled_stream_distribution():
+    """Exactness of speculative sampling for a point-mass draft: the
+    emitted first token's distribution must equal the model's warped
+    distribution, no matter the draft. B parallel rows = B trials."""
+    B, V = 4000, 8
+    probs = np.array([0.5, 0.25, 0.125, 0.0625, 0.0625, 0, 0, 0])
+    logits1 = np.log(np.maximum(probs, 1e-9))[None, :]
+    # Position 0 scores draft token 1 (p=0.25); position 1 is the
+    # correction/bonus position with the same distribution.
+    lg = jnp.asarray(np.repeat(logits1[None], B, 0).repeat(2, 1), jnp.float32)
+    drafts = jnp.ones((B, 1), jnp.int32)
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(B, dtype=jnp.uint32))
+    acc, corr, _ = sampling.spec_verify_batched(
+        lg, drafts, keys, jnp.ones((B,), jnp.float32),
+        jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
+        jnp.ones((B,), jnp.int32))
+    acc, corr = np.asarray(acc), np.asarray(corr)
+    first = np.where(acc > 0, 1, corr)          # emitted first token
+    freq = np.bincount(first, minlength=V) / B
+    # 4-sigma binomial tolerance per bucket.
+    for v in range(V):
+        sigma = np.sqrt(max(probs[v] * (1 - probs[v]), 1e-9) / B)
+        assert abs(freq[v] - probs[v]) < 4 * sigma + 1e-3, (v, freq[v])
+    # And acceptance happened at the expected ~p(draft) rate.
+    assert abs(acc.mean() - 0.25) < 0.03
+
+
+def test_spec_verify_forced_rejection_samples_unmodified_distribution():
+    """An undrafted row in a mixed spec tick carries zero-filled drafts
+    and max_accept=0 — a FORCED stop, not a probabilistic rejection. Its
+    token must come from the unmodified distribution: the residual rule
+    (remove the draft token) would make such a row unable to ever emit
+    token id 0."""
+    B, V = 4000, 8
+    probs = np.array([0.5, 0.25, 0.125, 0.0625, 0.0625, 0, 0, 0])
+    lg = jnp.asarray(
+        np.repeat(np.log(np.maximum(probs, 1e-9))[None, None, :], B, 0)
+        .repeat(2, 1), jnp.float32)
+    drafts = jnp.zeros((B, 1), jnp.int32)           # "draft" = token 0
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(B, dtype=jnp.uint32))
+    acc, corr, _ = sampling.spec_verify_batched(
+        lg, drafts, keys, jnp.ones((B,), jnp.float32),
+        jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
+        jnp.zeros((B,), jnp.int32))                 # max_accept = 0
+    acc, corr = np.asarray(acc), np.asarray(corr)
+    assert (acc == 0).all()
+    freq = np.bincount(corr, minlength=V) / B
+    for v in range(V):
+        sigma = np.sqrt(max(probs[v] * (1 - probs[v]), 1e-9) / B)
+        assert abs(freq[v] - probs[v]) < 4 * sigma + 1e-3, (v, freq[v])
+
+
+# -- end-to-end ---------------------------------------------------------------
+
+def test_spec_engine_greedy_matches_oracle():
+    """Greedy speculative serving is bit-exact with the sequential greedy
+    oracle — accepted drafts and corrections interleave invisibly."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128, spec_k=4)
+    try:
+        # Prompts with internal repetition so the n-gram drafter fires.
+        for prompt in ["abab abab abab", "hello hello hello world",
+                       "no repeats here at all"]:
+            req = GenerateRequest(prompt=prompt,
+                                  options=GenerateOptions(max_tokens=16))
+            got = "".join(eng.generate_stream(req, RequestStats()))
+            assert got == greedy_oracle(prompt, 16), prompt
+    finally:
+        eng.stop()
+
+
+def test_spec_engine_near_budget_matches_plain_engine():
+    """max_acc capping near the context budget: speculative output equals
+    the plain engine's (identical truncation), and trusted slots never
+    pass max_seq (OOB draft writes drop instead of clamping)."""
+    prompt = "xyxy xyxy xyxy"
+    opts = GenerateOptions(max_tokens=64)
+
+    def run(spec_k):
+        eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=32,
+                        spec_k=spec_k)
+        try:
+            req = GenerateRequest(prompt=prompt, options=opts)
+            return "".join(eng.generate_stream(req, RequestStats()))
+        finally:
+            eng.stop()
+
+    assert run(spec_k=4) == run(spec_k=0)
