@@ -45,6 +45,7 @@ from .p2p.transport import (
 )
 from .utils.env import env_int, env_or
 from .utils.log import get_logger
+from .utils import native
 
 log = get_logger("relay")
 
@@ -318,9 +319,33 @@ class RelayService:
         pending.event.set()
 
     def _splice(self, a: socket.socket, b: socket.socket) -> None:
-        """Bidirectional byte pump between dialer and target sockets."""
+        """Bidirectional byte pump between dialer and target sockets.
+
+        Data plane goes native when buildable: one blocking C++
+        poll-loop call per circuit (native/net_splice.cc — ctypes
+        releases the GIL for its duration) instead of two Python
+        recv/sendall threads serialising relayed bytes on the GIL. Same
+        idle-timeout and half-close semantics either way."""
         with self._mu:
             self._active_circuits += 1
+        lib = native.load("net_splice")
+        if lib is not None:
+            import ctypes
+            lib.splice_pair.restype = ctypes.c_int64
+            lib.splice_pair.argtypes = [ctypes.c_int, ctypes.c_int,
+                                        ctypes.c_int]
+            try:
+                lib.splice_pair(a.fileno(), b.fileno(),
+                                int(CIRCUIT_IDLE_TIMEOUT_S * 1000))
+            finally:
+                for s in (a, b):
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+                with self._mu:
+                    self._active_circuits -= 1
+            return
 
         def pump(src: socket.socket, dst: socket.socket) -> None:
             try:
