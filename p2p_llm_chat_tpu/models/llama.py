@@ -319,6 +319,61 @@ def verify_step(params: dict, config: ModelConfig, tokens: jax.Array,
 
 # -- paged decode (Pallas kernel path) ----------------------------------------
 
+def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
+                      cache, mesh: Optional[Mesh] = None,
+                      rules: LogicalRules = DEFAULT_RULES,
+                      *, pages: int, interpret: Optional[bool] = None,
+                      mlp_fn=None):
+    """Speculative verify over the paged pool: :func:`verify_step`'s
+    contract (S candidate positions, lengths unchanged; caller advances
+    by accepted+1) on a PagedKVCache.
+
+    Per layer, all S positions' kv go into the pool in one scatter
+    (ops/paged_kv.write_decode_multi — positions past a row's allocation
+    land in garbage page 0, so rollback/containment is inherent), then
+    the Pallas flash-decode kernel runs once per candidate position with
+    its causal length ``lengths+j+1`` — S small static unrolls; the
+    weight stream, the quantity speculation amortises, is still read
+    once. ``pages`` must cover ``lengths + S``.
+    """
+    from ..ops import paged_attention
+    from ..ops.paged_kv import write_decode_multi
+
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    B, S = tokens.shape
+    positions = cache.lengths[:, None] + jnp.arange(S)[None, :]    # [B,S]
+    h = params["embed"][tokens]
+    h = constrain(h, mesh, ("batch", None, "act_embed"), rules)
+    inv_freq = rope_frequencies(config)
+
+    def body(carry, xs):
+        h, pk, pv = carry
+        lp, layer = xs
+        q, k, v = _attn_qkv(h, lp, config, inv_freq, positions, mesh, rules)
+        step_cache = cache._replace(k=pk, v=pv)
+        step_cache = write_decode_multi(step_cache, layer, k, v)
+        outs = []
+        for j in range(S):         # static unroll — S = spec_k+1, small
+            outs.append(paged_attention(
+                q[:, j], step_cache.k, step_cache.v, cache.page_table,
+                cache.lengths + j + 1, layer, pages=pages,
+                interpret=interpret))
+        attn = jnp.stack(outs, axis=1)                             # [B,S,H,D]
+        h = _post_attn(h, attn, lp, config, mesh, rules, mlp_fn)
+        return (h, step_cache.k, step_cache.v), None
+
+    (h, new_k, new_v), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v),
+        (params["layers"], jnp.arange(config.num_layers)))
+    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    lm_head = (params["embed"].T if config.tie_embeddings
+               else params["lm_head"])
+    logits = mm(h, lm_head).astype(jnp.float32)
+    logits = constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
+    return logits, cache._replace(k=new_k, v=new_v)
+
+
 def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
                       cache, mesh: Optional[Mesh] = None,
                       rules: LogicalRules = DEFAULT_RULES,

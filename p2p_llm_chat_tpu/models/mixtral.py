@@ -252,3 +252,13 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
                                    rules, active, pages=pages,
                                    interpret=interpret,
                                    mlp_fn=_mlp_fn(config, None))
+
+
+def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
+                      cache, mesh: Optional[Mesh] = None,
+                      rules: LogicalRules = DEFAULT_RULES,
+                      *, pages: int, interpret: Optional[bool] = None):
+    """llama.verify_step_paged with the MoE MLP."""
+    return llama.verify_step_paged(params, config, tokens, cache, mesh,
+                                   rules, pages=pages, interpret=interpret,
+                                   mlp_fn=_mlp_fn(config, None))

@@ -242,6 +242,27 @@ def write_decode(cache: PagedKVCache, layer: jax.Array, k: jax.Array,
     return cache._replace(k=new_k, v=new_v)
 
 
+def write_decode_multi(cache: PagedKVCache, layer: jax.Array, k: jax.Array,
+                       v: jax.Array) -> PagedKVCache:
+    """Write S consecutive candidate slots per row for one layer — the
+    speculative-verify generalisation of :func:`write_decode`.
+
+    k/v: [B, S, Hkv, D]; row b's position j goes to page
+    ``page_table[b, (lengths[b]+j) // ps]`` slot ``(lengths[b]+j) % ps``.
+    Positions past the row's page allocation hit table entries that are 0
+    by contract — the garbage page — so near-budget rows' untrusted draft
+    writes are naturally contained (no clamping hazards)."""
+    B, S = k.shape[:2]
+    ps = cache.page_size
+    pos = cache.lengths[:, None] + jnp.arange(S)[None, :]      # [B,S]
+    logical = jnp.minimum(pos // ps, cache.max_pages_per_row - 1)
+    phys = jnp.take_along_axis(cache.page_table, logical, axis=1)  # [B,S]
+    slot = pos % ps
+    new_k = cache.k.at[layer, phys, :, slot].set(k, mode="drop")
+    new_v = cache.v.at[layer, phys, :, slot].set(v, mode="drop")
+    return cache._replace(k=new_k, v=new_v)
+
+
 def set_row_table(cache: PagedKVCache, row: int | jax.Array,
                   pages: jax.Array) -> PagedKVCache:
     """Install a row's page map (host-allocated physical ids, padded with

@@ -118,8 +118,6 @@ def build_engine_from_env() -> Backend:
     qt = float(env_or("SERVE_QUEUE_TIMEOUT", "60"))
     queue_timeout_s = qt if qt > 0 else None
     spec_k = env_int("SERVE_SPEC", 0)
-    if spec_k and kv_mode != "dense":
-        raise SystemExit("SERVE_SPEC needs SERVE_KV=dense")
 
     mesh = None
     if tp > 1:

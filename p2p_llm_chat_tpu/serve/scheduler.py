@@ -140,16 +140,12 @@ class BatchScheduler:
 
         ``spec_k``: speculative decoding (prompt-lookup drafting,
         utils/draft.py): each tick verifies up to K drafted tokens per
-        row in one forward (models/llama.verify_step + exact acceptance
-        sampling), so ticks emit 1..K+1 tokens. 0 disables. Dense KV
-        mode only — the paged verify path is future work."""
+        row in one forward (models/llama.verify_step[_paged] + exact
+        acceptance sampling), so ticks emit 1..K+1 tokens. 0 disables."""
         if kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {kv_mode!r}")
         if admit_chunk is not None and admit_chunk < 1:
             raise ValueError(f"admit_chunk must be >= 1, got {admit_chunk}")
-        if spec_k and kv_mode != "dense":
-            raise ValueError("spec_k needs kv_mode='dense' (paged verify "
-                             "is not implemented)")
         self.admit_chunk = admit_chunk
         self.queue_timeout_s = queue_timeout_s
         self.spec_k = spec_k
@@ -227,9 +223,16 @@ class BatchScheduler:
 
             def _spec(params, tokens, drafts, max_acc, cache, active,
                       temps, top_ks, top_ps, keys):
-                logits, cache = model.verify_step(
-                    params, config, tokens, cache, mesh,
-                    kv_window=kv_window)
+                if self.kv_mode == "paged":
+                    S = tokens.shape[1]
+                    pages = min(-(-(kv_window + S) // self.page_size),
+                                cache.max_pages_per_row)
+                    logits, cache = model.verify_step_paged(
+                        params, config, tokens, cache, mesh, pages=pages)
+                else:
+                    logits, cache = model.verify_step(
+                        params, config, tokens, cache, mesh,
+                        kv_window=kv_window)
                 accepted, correction, keys = spec_verify_batched(
                     logits.astype(jnp.float32), drafts, keys, temps,
                     top_ks, top_ps, max_acc)

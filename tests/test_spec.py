@@ -203,10 +203,13 @@ def test_spec_verify_forced_rejection_samples_unmodified_distribution():
 
 # -- end-to-end ---------------------------------------------------------------
 
-def test_spec_engine_greedy_matches_oracle():
+@pytest.mark.parametrize("kv_mode", ["dense", "paged"])
+def test_spec_engine_greedy_matches_oracle(kv_mode):
     """Greedy speculative serving is bit-exact with the sequential greedy
-    oracle — accepted drafts and corrections interleave invisibly."""
-    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128, spec_k=4)
+    oracle — accepted drafts and corrections interleave invisibly — on
+    both the dense cache and the paged pool (Pallas verify path)."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128, spec_k=4,
+                    kv_mode=kv_mode, page_size=16)
     try:
         # Prompts with internal repetition so the n-gram drafter fires.
         for prompt in ["abab abab abab", "hello hello hello world",
@@ -214,9 +217,40 @@ def test_spec_engine_greedy_matches_oracle():
             req = GenerateRequest(prompt=prompt,
                                   options=GenerateOptions(max_tokens=16))
             got = "".join(eng.generate_stream(req, RequestStats()))
-            assert got == greedy_oracle(prompt, 16), prompt
+            assert got == greedy_oracle(prompt, 16), (kv_mode, prompt)
     finally:
         eng.stop()
+
+
+def test_verify_step_paged_matches_dense():
+    """The paged verify forward (pool writes + per-position Pallas calls)
+    must produce the dense verify_step's logits for the same state."""
+    from p2p_llm_chat_tpu.ops.paged_kv import (PageAllocator, PagedKVCache,
+                                               set_row_table, write_prefill)
+    rng = np.random.default_rng(3)
+    B, P, S, PS = 2, 9, 4, 8
+    tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, (B, P)), jnp.int32)
+    lens = jnp.full((B,), P, jnp.int32)
+
+    dense = KVCache.create(CFG, B, 32, jnp.float32)
+    logits, dense = llama.prefill(PARAMS, CFG, tokens, lens, dense)
+
+    alloc = PageAllocator(16, PS)
+    paged = PagedKVCache.create(CFG, B, 16, PS, max_pages_per_row=4,
+                                dtype=jnp.float32)
+    for b in range(B):
+        pgs = alloc.alloc(alloc.pages_for(P + S + 1))
+        padded = np.zeros((4,), np.int32)
+        padded[: len(pgs)] = pgs
+        paged = set_row_table(paged, b, jnp.asarray(padded))
+    paged = write_prefill(paged, dense.k[:, :, :P],
+                          dense.v[:, :, :P], jnp.arange(B), lens)
+
+    stream = jnp.asarray(rng.integers(0, CFG.vocab_size, (B, S)), jnp.int32)
+    ref, _ = llama.verify_step(PARAMS, CFG, stream, dense)
+    got, _ = llama.verify_step_paged(PARAMS, CFG, stream, paged, pages=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
 
 
 def test_spec_engine_near_budget_matches_plain_engine():
