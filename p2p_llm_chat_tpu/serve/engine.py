@@ -129,6 +129,12 @@ def build_engine_from_env() -> Backend:
         if is_native_checkpoint(ckpt_dir):
             from ..models.checkpoint import load_checkpoint as load_native
             params, config = load_native(ckpt_dir, mesh=mesh)
+        elif mesh is not None:
+            # Mesh loads are the big-model path: stream tensors straight
+            # into the sharded device tree so host RAM never holds the
+            # checkpoint (the 70B memory-fit requirement).
+            from ..models.weights import load_checkpoint_streaming
+            params, config = load_checkpoint_streaming(ckpt_dir, mesh=mesh)
         else:
             params, config = load_checkpoint(ckpt_dir, mesh=mesh)
         tokenizer = load_tokenizer(ckpt_dir, vocab_size=config.vocab_size)

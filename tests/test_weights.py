@@ -150,3 +150,48 @@ def test_config_from_hf_json_llama3_rope_and_eos_list(tmp_path):
     assert cfg.num_kv_heads == 8
     assert cfg.head_dim == 128
     assert not cfg.is_moe
+
+
+def test_streaming_loader_matches_batch_loader(tmp_path):
+    """The memory-bounded streaming loader (one host tensor at a time,
+    device-resident tree) must produce exactly the batch loader's tree —
+    dense, tied, MoE, and mesh-sharded."""
+    from p2p_llm_chat_tpu.models.weights import load_checkpoint_streaming
+    from p2p_llm_chat_tpu.parallel.mesh import MeshConfig, make_mesh
+
+    model, cfg = _tiny_llama()
+    ckpt = _write_ckpt(tmp_path, model)
+    want, _ = load_checkpoint(ckpt, dtype=jnp.float32)
+    got, got_cfg = load_checkpoint_streaming(ckpt, dtype=jnp.float32)
+    assert got_cfg.num_layers == cfg.num_layers
+    _assert_trees_equal(got, want)
+
+    mesh = make_mesh(MeshConfig(tp=2))
+    got_sharded, _ = load_checkpoint_streaming(ckpt, mesh=mesh,
+                                               dtype=jnp.float32)
+    from jax.sharding import NamedSharding
+    for leaf in jax.tree.leaves(got_sharded):
+        assert isinstance(leaf.sharding, NamedSharding)
+    _assert_trees_equal(got_sharded, want)
+
+
+def test_streaming_loader_moe(tmp_path):
+    from p2p_llm_chat_tpu.models.weights import load_checkpoint_streaming
+    from tests.test_mixtral_parity import make_hf_model as make_moe
+
+    model, cfg = make_moe()
+    ckpt = _write_ckpt(tmp_path, model, n_shards=3)
+    want, _ = load_checkpoint(ckpt, dtype=jnp.float32)
+    got, _ = load_checkpoint_streaming(ckpt, dtype=jnp.float32)
+    _assert_trees_equal(got, want)
+
+
+def test_streaming_loader_tied_embeddings(tmp_path):
+    from p2p_llm_chat_tpu.models.weights import load_checkpoint_streaming
+
+    model, cfg = _tiny_llama(tie=True)
+    ckpt = _write_ckpt(tmp_path, model, n_shards=1)
+    want, _ = load_checkpoint(ckpt, dtype=jnp.float32)
+    got, _ = load_checkpoint_streaming(ckpt, dtype=jnp.float32)
+    assert "lm_head" not in got
+    _assert_trees_equal(got, want)
