@@ -118,6 +118,14 @@ def build_engine_from_env() -> Backend:
     qt = float(env_or("SERVE_QUEUE_TIMEOUT", "60"))
     queue_timeout_s = qt if qt > 0 else None
     spec_k = env_int("SERVE_SPEC", 0)
+    # SERVE_PROFILE_PORT=N starts jax.profiler's collection server:
+    # attach TensorBoard/xprof to capture live device traces of the
+    # serving loop (SURVEY.md §5 tracing plan; BENCH_PROFILE covers the
+    # offline bench path).
+    prof_port = env_int("SERVE_PROFILE_PORT", 0)
+    if prof_port:
+        jax.profiler.start_server(prof_port)
+        log.info("jax.profiler server on :%d", prof_port)
 
     mesh = None
     if tp > 1:

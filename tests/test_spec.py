@@ -270,3 +270,41 @@ def test_spec_engine_near_budget_matches_plain_engine():
             eng.stop()
 
     assert run(spec_k=4) == run(spec_k=0)
+
+
+def test_all_serving_features_compose():
+    """int8 weights + paged KV + speculative decoding together, through
+    the batching engine: greedy output must equal the solo oracle run on
+    the SAME quantized weights (the full feature stack composes without
+    interference)."""
+    from p2p_llm_chat_tpu.models.quant import quantize_params
+
+    qparams = quantize_params(PARAMS)
+
+    def oracle(prompt, max_new):
+        ids = TOK.encode(prompt, add_bos=True)
+        cache = KVCache.create(CFG, 1, 128, jnp.float32)
+        logits, cache = llama.prefill(qparams, CFG, jnp.asarray([ids]),
+                                      jnp.asarray([len(ids)]), cache)
+        last = np.asarray(logits[0, len(ids) - 1])
+        out = []
+        for _ in range(max_new):
+            t = int(last.argmax())
+            if t in STOP_IDS:
+                break
+            out.append(t)
+            lg, cache = llama.decode_step(qparams, CFG, jnp.asarray([[t]]),
+                                          cache)
+            last = np.asarray(lg[0, 0])
+        return TOK.decode(out)
+
+    eng = TPUEngine(qparams, CFG, TOK, num_slots=2, max_seq=128,
+                    kv_mode="paged", page_size=16, spec_k=4)
+    try:
+        prompt = "compose compose compose everything"
+        req = GenerateRequest(prompt=prompt,
+                              options=GenerateOptions(max_tokens=12))
+        got = "".join(eng.generate_stream(req, RequestStats()))
+        assert got == oracle(prompt, 12)
+    finally:
+        eng.stop()
