@@ -173,6 +173,8 @@ def main() -> None:
         for th in threads:
             th.join()
     wall = time.monotonic() - t
+    spec_stats = ({k: v for k, v in sched.metrics_snapshot().items()
+                   if "spec" in k} if spec_k else {})
     ttfts = sorted(s.ttft_s * 1e3 for s in all_stats if s.ttft_s is not None)
     done_tokens = sum(s.completion_tokens for s in all_stats)
     p50 = statistics.median(ttfts)
@@ -194,6 +196,7 @@ def main() -> None:
             "kv_mode": kv_mode,
             "quant": quant or None,
             "spec_k": spec_k or None,
+            **spec_stats,
             "page_size": page_size if kv_mode == "paged" else None,
             "config": cfg_name,
             "n_params_b": round(n_params / 1e9, 3),

@@ -64,6 +64,11 @@ log = get_logger("serve.scheduler")
 
 _MIN_BUCKET = 16
 _MAX_ADMIT_CHUNK = 8
+# Adaptive speculation: below this EMA of accepted-drafts-per-tick the
+# verify pass costs more than it saves; probe intermittently instead.
+_SPEC_EMA_FLOOR = 0.5
+_SPEC_EMA_ALPHA = 0.1
+_SPEC_PROBE_EVERY = 8
 
 
 def _bucket(n: int, max_seq: int) -> int:
@@ -187,6 +192,13 @@ class BatchScheduler:
         self._n_decode_ticks = 0
         self._n_expired = 0
         self._n_spec_accepted = 0     # draft tokens accepted by verify
+        # Adaptive speculation: EMA of accepted drafts per spec tick.
+        # The verify forward computes K+1 positions for every row, so
+        # when drafts stop landing (non-repetitive output), paying it
+        # every tick is pure loss — below the floor, only probe every
+        # _SPEC_PROBE_EVERY ticks until acceptance recovers.
+        self._spec_ema = float(spec_k)         # optimistic start
+        self._spec_cooldown = 0
 
         # Jitted programs. decode is compiled once; admit once per
         # (chunk-rows, prompt-bucket) shape pair — both power-of-two
@@ -622,6 +634,7 @@ class BatchScheduler:
         }
         if self.spec_k:
             out["serve_spec_accepted_total"] = self._n_spec_accepted
+            out["serve_spec_accept_ema"] = round(self._spec_ema, 4)
         if self.kv_mode == "paged":
             out["serve_kv_free_pages"] = self._alloc.free_pages
             out["serve_kv_total_pages"] = self.num_pages - 1
@@ -848,6 +861,13 @@ class BatchScheduler:
         trusted slots never pass their budget."""
         K = self.spec_k
         B = self.num_slots
+        if self._spec_ema < _SPEC_EMA_FLOOR:
+            # Acceptance collapsed: probe only every Nth tick; plain
+            # ticks run in between. A successful probe lifts the EMA and
+            # re-enables per-tick speculation.
+            self._spec_cooldown += 1
+            if self._spec_cooldown % _SPEC_PROBE_EVERY:
+                return False
         tokens = np.zeros((B, K + 1), np.int32)
         drafts = np.zeros((B, K), np.int32)
         max_acc = np.zeros((B,), np.int32)
@@ -882,6 +902,10 @@ class BatchScheduler:
             self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys)
         acc = np.asarray(accepted)               # [B] int32 — tiny sync
         corr = np.asarray(correction)
+        n_active = sum(s is not None for s in self._slots)
+        tick_acc = float(acc.sum()) / max(1, n_active)
+        self._spec_ema = ((1 - _SPEC_EMA_ALPHA) * self._spec_ema
+                          + _SPEC_EMA_ALPHA * tick_acc)
         for row, slot in enumerate(self._slots):
             if slot is None:
                 continue
