@@ -105,7 +105,10 @@ class _Slot:
         if delta:
             self.out_q.put(delta)
 
+    done: bool = False                                 # finish() has run
+
     def finish(self) -> None:
+        self.done = True
         if self.stats is not None and self.stats.total_s is None:
             self.stats.total_s = time.monotonic() - self.req.arrival_time
         self.out_q.put(None)
@@ -464,6 +467,11 @@ class BatchScheduler:
             # tunnel's deferred per-program loads) are async — without a
             # readback the first real request queues behind all of them.
             np.asarray(toks[:1])
+        # Admission rounds short prompts UP to the smallest warmed bucket
+        # (_serving_bucket): a bucket-32 program warmup never compiled
+        # would otherwise compile lazily inside someone's TTFT. Recorded
+        # only now, after every program above actually compiled.
+        self._warmed_buckets = buckets
         log.info("warmup compiled: admit %s x buckets %s, decode windows %s",
                  chunk_sizes, buckets, windows)
 
@@ -542,16 +550,43 @@ class BatchScheduler:
     # -- scheduler thread ----------------------------------------------------
 
     def _loop(self) -> None:
+        """Serving loop with one-tick pipelining: tick N+1 is dispatched
+        BEFORE tick N's tokens are read back, so the (tunnel-expensive)
+        device->host readback of N overlaps N+1's device compute instead
+        of serialising with it. The device carries its own next-token
+        feed (_next_dev), so the host's one-tick lag only delays
+        streaming/stop detection by one tick; a stopped row decodes one
+        extra token whose write the release path already tolerates (it
+        lands beyond the trusted length or in the garbage page).
+        Speculative ticks stay synchronous — drafting needs the current
+        ids — and flush the pipeline first."""
+        pending: Optional[tuple] = None      # (toks_dev, slots snapshot)
         while not self._closed.is_set():
-            self._admit_pending(block=not self._any_active())
+            self._admit_pending(block=not self._any_active()
+                                and pending is None)
             if self._closed.is_set():
                 return
-            if not self._any_active():
-                continue
             try:
-                self._decode_tick()
+                if not self._any_active():
+                    if pending is not None:
+                        self._process_tick(*pending)
+                        pending = None
+                    continue
+                if self.spec_k:
+                    if pending is not None:
+                        self._process_tick(*pending)
+                        pending = None
+                    if not self._any_active():
+                        continue
+                    if self._spec_tick():
+                        continue
+                new = self._dispatch_tick()
+                if pending is not None:
+                    self._process_tick(*pending)
+                pending = new
             except Exception:   # noqa: BLE001 — fail requests, keep serving
                 log.exception("decode tick failed; failing in-flight requests")
+                pending = None
                 self._fail_all_and_reset()
 
     def _any_active(self) -> bool:
@@ -604,6 +639,22 @@ class BatchScheduler:
                 slot.stats.prompt_tokens = len(ids)
             out.append(slot)
         return out
+
+    def _serving_bucket(self, prompt_len: int) -> int:
+        """Admission bucket for a prompt: the power-of-two bucket, rounded
+        UP to the smallest warmup-compiled bucket that fits (compiling a
+        fresh small-bucket program mid-serving would stall every stream
+        for tens of seconds on TPU). Prompts longer than every warmed
+        bucket keep their own bucket and compile lazily (logged)."""
+        b = _bucket(prompt_len, self.max_seq)
+        warmed = getattr(self, "_warmed_buckets", None)
+        if warmed:
+            for w in warmed:
+                if w >= b:
+                    return w
+            log.info("prompt bucket %d exceeds warmed buckets %s; compiling "
+                     "lazily", b, warmed)
+        return b
 
     def _expired(self, slot: _Slot) -> bool:
         """Fail a request that outlived the admission deadline (it never
@@ -714,7 +765,7 @@ class BatchScheduler:
             return
         by_bucket: dict[int, list[_Slot]] = {}
         for s in pending:
-            by_bucket.setdefault(_bucket(len(s.prompt_ids), self.max_seq),
+            by_bucket.setdefault(self._serving_bucket(len(s.prompt_ids)),
                                  []).append(s)
         groups = sorted(by_bucket.items())
         for gi, (S, group) in enumerate(groups):
@@ -817,12 +868,11 @@ class BatchScheduler:
                 # finished on the very first token (eos / limits)
                 self._release(row)
 
-    def _decode_tick(self) -> None:
-        """One batched decode step: all active rows advance one token —
-        or, in speculative mode with at least one drafted row, 1..K+1
-        tokens through one verify dispatch (same size readbacks)."""
-        if self.spec_k and self._spec_tick():
-            return
+    def _dispatch_tick(self) -> tuple:
+        """Dispatch one batched decode step (async — returns without a
+        readback). Returns (toks_dev, snapshot of the rows it decoded
+        for); _process_tick consumes it, one tick later under
+        pipelining."""
         self._n_decode_ticks += 1
         active = tuple(s is not None for s in self._slots)
         if active != self._active_host:
@@ -830,13 +880,29 @@ class BatchScheduler:
             # moves on admission/finish — not per tick).
             self._active_host = active
             self._active_dev = jnp.asarray(np.array(active, bool))
-        decode_j = self._decode_for(self._window())
+        # extra=1: under pipelining a row's device length can be one
+        # ahead of the host's ctx_len (its previous token is still
+        # unprocessed), so the window budget covers it.
+        decode_j = self._decode_for(self._window(extra=1))
         toks_dev, self._next_dev, self._cache, self._keys = decode_j(
             self._params, self._next_dev, self._cache, self._active_dev,
             self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys)
+        return toks_dev, list(self._slots)
+
+    def _process_tick(self, toks_dev, snapshot: list) -> None:
+        """Host half of a decode tick: read the sampled tokens back and
+        run per-row bookkeeping for the rows captured at dispatch time.
+        Rows finished/released since (their slot.done is set) are
+        skipped — their in-flight token is discarded, and the write it
+        made sits beyond the trusted length by the overwrite-before-
+        trust invariant."""
         toks = np.asarray(toks_dev)              # [B] int32 — tiny sync
-        for row, slot in enumerate(self._slots):
-            if slot is None:
+        for row, slot in enumerate(snapshot):
+            # Identity check, not just done/None: the row may have been
+            # released AND re-admitted since dispatch — acting on it now
+            # (e.g. the cancelled branch's release) would evict the NEW
+            # occupant.
+            if slot is None or slot.done or self._slots[row] is not slot:
                 continue
             if slot.cancelled.is_set():
                 self._release(row)

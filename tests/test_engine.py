@@ -392,3 +392,19 @@ def test_long_prompt_truncated_to_context():
         assert stats.completion_tokens <= 8
     finally:
         eng.stop()
+
+
+def test_serving_bucket_rounds_up_to_warmed():
+    """Post-warmup, short prompts must admit through an already-compiled
+    bucket (compiling a fresh small-bucket program mid-serving would
+    stall every stream); longer-than-warmed prompts keep their own."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=256)
+    try:
+        sched = eng.scheduler
+        assert sched._serving_bucket(20) == 32          # pre-warmup: natural
+        sched.warmup(prompt_buckets=(64, 128), windows=(128,))
+        assert sched._serving_bucket(20) == 64          # rounded up
+        assert sched._serving_bucket(100) == 128
+        assert sched._serving_bucket(200) == 256        # beyond warmed: lazy
+    finally:
+        eng.stop()
