@@ -66,6 +66,12 @@ def main() -> int:
     ap.add_argument("--ui-port-base", type=int,
                     default=int(os.environ.get("UI_PORT_BASE", "8501")),
                     help="first UI port (default 8501, reference layout)")
+    ap.add_argument("--dir-port", type=int,
+                    default=int(os.environ.get("DIR_PORT", "8080")))
+    ap.add_argument("--serve-port", type=int,
+                    default=int(os.environ.get("SERVE_PORT", "11434")))
+    ap.add_argument("--relay-port", type=int,
+                    default=int(os.environ.get("RELAY_PORT", "4100")))
     args = ap.parse_args()
 
     users = [u.strip() for u in args.users.split(",") if u.strip()]
@@ -88,9 +94,13 @@ def main() -> int:
 
     print("🚀 starting p2p-llm-chat-tpu stack")
     try:
-        spawn("directory", "p2p_llm_chat_tpu.directory", {"ADDR": "127.0.0.1:8080"}, procs)
+        dir_url = f"http://127.0.0.1:{args.dir_port}"
+        serve_url = f"http://127.0.0.1:{args.serve_port}"
+        spawn("directory", "p2p_llm_chat_tpu.directory",
+              {"ADDR": f"127.0.0.1:{args.dir_port}"}, procs)
         spawn("serve", "p2p_llm_chat_tpu.serve.api",
-              {"SERVE_ADDR": "127.0.0.1:11434", "SERVE_BACKEND": args.backend}, procs)
+              {"SERVE_ADDR": f"127.0.0.1:{args.serve_port}",
+               "SERVE_BACKEND": args.backend}, procs)
         relay_addrs = ""
         if args.relay:
             # The relay publishes its fresh multiaddr (identity is per-start)
@@ -99,7 +109,7 @@ def main() -> int:
             addr_file = os.path.join(tempfile.mkdtemp(prefix="p2pchat-relay-"),
                                      "relay.maddr")
             spawn("relay", "p2p_llm_chat_tpu.relay",
-                  {"RELAY_ADDR": "127.0.0.1:4100",
+                  {"RELAY_ADDR": f"127.0.0.1:{args.relay_port}",
                    "RELAY_ADDR_FILE": addr_file}, procs)
             deadline = time.time() + 15
             while time.time() < deadline and not os.path.exists(addr_file):
@@ -110,8 +120,9 @@ def main() -> int:
                 relay_addrs = f.read().strip()
             shutil.rmtree(os.path.dirname(addr_file), ignore_errors=True)
             print(f"  relay multiaddr: {relay_addrs}")
-        wait_http("http://127.0.0.1:8080/healthz")
-        wait_http("http://127.0.0.1:11434/healthz", timeout=300 if args.backend != "fake" else 30)
+        wait_http(f"{dir_url}/healthz")
+        wait_http(f"{serve_url}/healthz",
+                  timeout=300 if args.backend != "fake" else 30)
 
         for i, user in enumerate(users):
             node_port = args.node_port_base + i
@@ -119,7 +130,7 @@ def main() -> int:
             node_env = {
                 "MYNAMEIS": user,
                 "HTTP_ADDR": f"127.0.0.1:{node_port}",
-                "DIRECTORY_URL": "http://127.0.0.1:8080",
+                "DIRECTORY_URL": dir_url,
             }
             if relay_addrs:
                 node_env["RELAY_ADDRS"] = relay_addrs
@@ -127,7 +138,7 @@ def main() -> int:
             wait_http(f"http://127.0.0.1:{node_port}/healthz")
             spawn(f"ui-{user}", "p2p_llm_chat_tpu.ui", {
                 "NODE_HTTP": f"http://127.0.0.1:{node_port}",
-                "OLLAMA_URL": "http://127.0.0.1:11434",
+                "OLLAMA_URL": serve_url,
                 "UI_ADDR": f"127.0.0.1:{ui_port}",
             }, procs)
     except Exception as e:  # noqa: BLE001 — never leave orphaned children
@@ -138,7 +149,7 @@ def main() -> int:
     for i, user in enumerate(users):
         print(f"   {user}: UI http://127.0.0.1:{args.ui_port_base + i}  "
               f"node http://127.0.0.1:{args.node_port_base + i}")
-    print("   LLM API http://127.0.0.1:11434  directory http://127.0.0.1:8080\n")
+    print(f"   LLM API {serve_url}  directory {dir_url}\n")
     print("Ctrl-C to stop.")
 
     while True:
