@@ -131,6 +131,82 @@ def attend_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.reshape(B, Sq, Hq, D)
 
 
+def flash_attend_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
+                     mask: Optional[jax.Array],
+                     chunk: int = 512) -> jax.Array:
+    """attend_gqa with online-softmax accumulation over KV chunks — the
+    score tensor never materialises past ``[B,G,rep,Sq,chunk]``.
+
+    Same contract/results as :func:`attend_gqa` (f32 statistics); used by
+    the model when the full ``[...,Sq,Skv]`` scores would blow the HBM
+    budget (long-context prefill at serving batch sizes). The
+    chunk-update math is the same flash recurrence parallel/ring.py runs
+    across devices; here it runs across KV chunks on one device via
+    ``lax.scan`` (constant-size graph for any context length).
+
+    Fully-masked chunks contribute zero weight (their statistics scale
+    out), so ragged lengths and causal masks need no special-casing.
+    """
+    B, Sq, Hq, D = q.shape
+    Skv, G = k.shape[1], k.shape[2]
+    rep = Hq // G
+    if Skv <= chunk:
+        return attend_gqa(q, k, v, mask)
+    assert Skv % chunk == 0, (Skv, chunk)   # power-of-two windows hold this
+    N = Skv // chunk
+    qg = q.reshape(B, Sq, G, rep, D)
+    if mask is None:
+        mask = jnp.ones((1, 1, Sq, Skv), bool)
+    if mask.ndim == 4:
+        mask = mask[:, :, None]             # [B|1, 1, 1, Sq, Skv]
+    mask = jnp.broadcast_to(mask, (B, 1, 1, Sq, Skv))
+
+    kc = k.reshape(B, N, chunk, G, D).transpose(1, 0, 2, 3, 4)
+    vc = v.reshape(B, N, chunk, G, D).transpose(1, 0, 2, 3, 4)
+    mc = mask.reshape(B, 1, 1, Sq, N, chunk).transpose(4, 0, 1, 2, 3, 5)
+
+    def body(carry, xs):
+        m, l, acc = carry
+        kb, vb, mb = xs                     # [B,chunk,G,D], mask [B,1,1,Sq,chunk]
+        s = jnp.einsum("bsgrd,btgd->bgrst", qg, kb,
+                       preferred_element_type=jnp.float32)
+        s = s / jnp.sqrt(D).astype(jnp.float32)
+        s = jnp.where(mb, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # Fully-masked-so-far rows keep m at NEG_INF; exp(NEG_INF-NEG_INF)
+        # would poison alpha, so clamp the shift.
+        alpha = jnp.exp(jnp.minimum(m - m_new, 0.0))
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, alpha)
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(m_new[..., None] <= NEG_INF / 2, 0.0, p)
+        l = l * alpha + p.sum(axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bgrst,btgd->bgrsd", p, vb.astype(jnp.float32))
+        return (m_new, l, acc), None
+
+    m0 = jnp.full((B, G, rep, Sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, G, rep, Sq), jnp.float32)
+    a0 = jnp.zeros((B, G, rep, Sq, D), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), (kc, vc, mc))
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, Hq, D).astype(q.dtype)
+
+
+# Score tensors past this many f32 elements take the chunked flash path
+# ([B,G,rep,Sq,Skv] at 2^27 = 512 MB of HBM just for one layer's scores).
+_FLASH_SCORE_ELEMS = 2 ** 27
+
+
+def attend_gqa_auto(q: jax.Array, k: jax.Array, v: jax.Array,
+                    mask: Optional[jax.Array]) -> jax.Array:
+    """attend_gqa, switching to the chunked flash path when the score
+    tensor would be HBM-hostile (long-context prefill at batch)."""
+    B, Sq, Hq, D = q.shape
+    if B * Hq * Sq * k.shape[1] > _FLASH_SCORE_ELEMS and k.shape[1] >= 1024:
+        return flash_attend_gqa(q, k, v, mask)
+    return attend_gqa(q, k, v, mask)
+
+
 def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array,
            w_down: jax.Array) -> jax.Array:
     """SwiGLU MLP: down(silu(x@gate) * (x@up)). Weights may be int8

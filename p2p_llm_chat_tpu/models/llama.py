@@ -32,6 +32,7 @@ from .layers import (
     DEFAULT_COMPUTE_DTYPE,
     apply_rope,
     attend_gqa,
+    attend_gqa_auto,
     causal_mask,
     length_mask,
     rms_norm,
@@ -189,7 +190,7 @@ def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
         k_layer = k_layer[:, :kv_window]
         v_layer = v_layer[:, :kv_window]
 
-    attn = attend_gqa(q, k_layer, v_layer, mask)    # [B,S,H,D]
+    attn = attend_gqa_auto(q, k_layer, v_layer, mask)  # [B,S,H,D]
     return _post_attn(h, attn, lp, config, mesh, rules, mlp_fn), \
         cache_k, cache_v
 

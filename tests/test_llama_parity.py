@@ -164,3 +164,24 @@ def test_generate_scan_matches_host_loop():
                                         max_new_tokens=8)).tolist()
     trimmed = compiled[: compiled.index(2)] if 2 in compiled else compiled
     assert trimmed == host
+
+
+def test_flash_attend_gqa_matches_dense():
+    """Chunked online-softmax attention must equal attend_gqa exactly
+    (same f32 statistics) for causal, ragged, and fully-masked rows."""
+    from p2p_llm_chat_tpu.models.layers import (attend_gqa, causal_mask,
+                                                flash_attend_gqa,
+                                                length_mask)
+    rng = np.random.default_rng(0)
+    B, Sq, Skv, G, rep, D = 2, 8, 64, 2, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, Sq, G * rep, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, Skv, G, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, Skv, G, D)), jnp.float32)
+
+    for mask in [causal_mask(Sq, Skv, 3),
+                 length_mask(Skv, jnp.asarray([5, 60])),
+                 None]:
+        want = attend_gqa(q, k, v, mask)
+        got = flash_attend_gqa(q, k, v, mask, chunk=16)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
