@@ -67,6 +67,8 @@ class OllamaServer:
         self.router.add("POST", "/api/generate", self._generate)
         self.router.add("POST", "/api/chat", self._chat)
         self.router.add("GET", "/api/tags", self._tags)
+        self.router.add("POST", "/api/show", self._show)
+        self.router.add("GET", "/api/ps", self._ps)
         self.router.add("GET", "/api/version", lambda r: Response(200, {
             "version": "0.1.0-p2p-llm-chat-tpu"}))
         self.router.add("GET", "/", lambda r: Response(
@@ -189,6 +191,44 @@ class OllamaServer:
         return Response(200, {"models": [
             {"name": m, "model": m, "modified_at": now_rfc3339(),
              "size": 0, "digest": "", "details": {"family": "p2p-llm-chat-tpu"}}
+            for m in self.backend.models()
+        ]})
+
+    def _show(self, req: Request) -> Response:
+        """Ollama `POST /api/show`: model metadata. Clients (CLIs, health
+        dashboards) probe this before generating; serve what we know from
+        the backend's config when it has one."""
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        name = str(body.get("model") or body.get("name") or "")
+        models = self.backend.models()
+        if name and name not in models:
+            return Response(404, {"error": f"model {name!r} not found"})
+        cfg = getattr(self.backend, "config", None)
+        details = {"family": "p2p-llm-chat-tpu", "format": "jax",
+                   "parameter_size": "", "quantization_level": ""}
+        info = {}
+        if cfg is not None:
+            info = {"general.architecture": "llama" if cfg.num_experts == 0
+                    else "mixtral",
+                    "llama.context_length": cfg.max_seq_len,
+                    "llama.embedding_length": cfg.hidden_size,
+                    "llama.block_count": cfg.num_layers,
+                    "llama.attention.head_count": cfg.num_heads,
+                    "llama.attention.head_count_kv": cfg.num_kv_heads,
+                    "llama.vocab_size": cfg.vocab_size}
+        return Response(200, {"modelfile": "", "parameters": "",
+                              "template": "", "details": details,
+                              "model_info": info})
+
+    def _ps(self, req: Request) -> Response:
+        """Ollama `GET /api/ps`: loaded models. Everything we serve is
+        resident (no lazy loading), so list the backend's models."""
+        return Response(200, {"models": [
+            {"name": m, "model": m, "size": 0, "digest": "",
+             "expires_at": "", "size_vram": 0}
             for m in self.backend.models()
         ]})
 

@@ -119,3 +119,21 @@ def test_metrics_merges_backend_serving_gauges():
         assert "# TYPE serve_admitted_total counter\nserve_admitted_total 7" in text
     finally:
         srv.stop()
+
+
+def test_show_and_ps_endpoints(server):
+    """Ollama drop-in surface: /api/show and /api/ps respond with model
+    metadata so Ollama-aware clients can probe before generating."""
+    import urllib.error
+    _, body = http_json("POST", f"{server.url}/api/show", {"model": "fake-llm"})
+    assert "details" in body
+    with urllib.request.urlopen(f"{server.url}/api/ps", timeout=5) as r:
+        ps = json.loads(r.read())
+    assert ps["models"] and ps["models"][0]["name"]
+    req = urllib.request.Request(
+        f"{server.url}/api/show",
+        data=json.dumps({"model": "nope"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 404
