@@ -200,9 +200,14 @@ _FLASH_SCORE_ELEMS = 2 ** 27
 def attend_gqa_auto(q: jax.Array, k: jax.Array, v: jax.Array,
                     mask: Optional[jax.Array]) -> jax.Array:
     """attend_gqa, switching to the chunked flash path when the score
-    tensor would be HBM-hostile (long-context prefill at batch)."""
+    tensor would be HBM-hostile (long-context prefill at batch). The KV
+    length must also divide the chunk — SERVE_MAX_SEQ is user-set and
+    need not be a power of two; an indivisible length stays on the dense
+    path rather than tripping the flash kernel's layout assert."""
     B, Sq, Hq, D = q.shape
-    if B * Hq * Sq * k.shape[1] > _FLASH_SCORE_ELEMS and k.shape[1] >= 1024:
+    Skv = k.shape[1]
+    if (B * Hq * Sq * Skv > _FLASH_SCORE_ELEMS and Skv >= 1024
+            and Skv % 512 == 0):
         return flash_attend_gqa(q, k, v, mask)
     return attend_gqa(q, k, v, mask)
 

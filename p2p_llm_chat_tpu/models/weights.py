@@ -238,7 +238,7 @@ def load_checkpoint_streaming(ckpt_dir: str,
                     if f.endswith(".safetensors"))
     if not shards:
         raise FileNotFoundError(f"no .safetensors files in {ckpt_dir}")
-    seen = 0
+    missing = set(name_map)
     for shard in shards:
         with safe_open(os.path.join(ckpt_dir, shard), framework="numpy") as f:
             for name in f.keys():
@@ -260,8 +260,16 @@ def load_checkpoint_streaming(ckpt_dir: str,
                                        jnp.int32))
                     set_leaf(path, splice(leaf, jnp.asarray(t, dtype),
                                           idx, expert is not None))
-                seen += 1
-        log.info("streamed shard %s (%d tensors placed)", shard, seen)
+                missing.discard(name)
+        log.info("streamed shard %s (%d tensors placed)", shard,
+                 len(name_map) - len(missing))
+    if missing:
+        # Zeros where weights should be = garbage logits with no error
+        # (the batch loader KeyErrors on the same input). Fail loudly.
+        raise KeyError(
+            f"checkpoint {ckpt_dir} is missing {len(missing)} expected "
+            f"tensor(s), e.g. {sorted(missing)[:3]} — truncated download "
+            "or wrong config?")
     log.info("loaded %s (streaming): %.2fB params", config.name,
              sum(x.size for x in jax.tree.leaves(params)) / 1e9)
     return params, config

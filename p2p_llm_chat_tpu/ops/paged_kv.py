@@ -255,8 +255,14 @@ def write_decode_multi(cache: PagedKVCache, layer: jax.Array, k: jax.Array,
     B, S = k.shape[:2]
     ps = cache.page_size
     pos = cache.lengths[:, None] + jnp.arange(S)[None, :]      # [B,S]
-    logical = jnp.minimum(pos // ps, cache.max_pages_per_row - 1)
-    phys = jnp.take_along_axis(cache.page_table, logical, axis=1)  # [B,S]
+    logical = pos // ps
+    # Positions past the table's width go to garbage page 0 — clamping
+    # them onto the last real page would wrap their slot index into
+    # TRUSTED kv (observed: a fully-allocated row near its budget had
+    # early slots of its last page overwritten by draft positions).
+    safe = jnp.minimum(logical, cache.max_pages_per_row - 1)
+    phys = jnp.take_along_axis(cache.page_table, safe, axis=1)     # [B,S]
+    phys = jnp.where(logical < cache.max_pages_per_row, phys, 0)
     slot = pos % ps
     new_k = cache.k.at[layer, phys, :, slot].set(k, mode="drop")
     new_v = cache.v.at[layer, phys, :, slot].set(v, mode="drop")

@@ -40,6 +40,7 @@ from jax.experimental.shard_map import shard_map
 from ..models.configs import ModelConfig
 from ..models.layers import NEG_INF, rms_norm, rope_frequencies
 from ..models.llama import KVCache, _attn_qkv, _post_attn
+from ..models.quant import mm
 
 
 def _chunk_scores(q: jax.Array, k: jax.Array) -> jax.Array:
@@ -156,7 +157,7 @@ def ring_prefill(params: dict, config: ModelConfig, tokens: jax.Array,
         h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
         lm_head = (params["embed"].T if config.tie_embeddings
                    else params["lm_head"])
-        logits = (h @ lm_head).astype(jnp.float32)
+        logits = mm(h, lm_head).astype(jnp.float32)
         return logits, ck, cv
 
     mapped = shard_map(
@@ -241,7 +242,7 @@ def sp_decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
         h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
         lm_head = (params["embed"].T if config.tie_embeddings
                    else params["lm_head"])
-        logits = (h @ lm_head).astype(jnp.float32)
+        logits = mm(h, lm_head).astype(jnp.float32)
         return logits, ck_all, cv_all
 
     mapped = shard_map(

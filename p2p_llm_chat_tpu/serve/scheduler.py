@@ -572,7 +572,11 @@ class BatchScheduler:
                         self._process_tick(*pending)
                         pending = None
                     continue
-                if self.spec_k:
+                # Flush the pipeline for a speculative tick only when one
+                # can actually run this tick (drafting needs current ids)
+                # — while the acceptance throttle has speculation backed
+                # off, plain ticks keep their pipelining.
+                if self.spec_k and not self._spec_throttled():
                     if pending is not None:
                         self._process_tick(*pending)
                         pending = None
@@ -911,6 +915,17 @@ class BatchScheduler:
             if not self._append_token(slot, row, int(toks[row])):
                 self._release(row)
 
+    def _spec_throttled(self) -> bool:
+        """Acceptance-collapse throttle: when the accepted-drafts EMA is
+        below the floor, speculate only every Nth tick (a successful
+        probe lifts the EMA and re-enables per-tick speculation). Checked
+        in _loop BEFORE the pipeline flush, so throttled plain ticks keep
+        their one-tick pipelining."""
+        if self._spec_ema >= _SPEC_EMA_FLOOR:
+            return False
+        self._spec_cooldown += 1
+        return bool(self._spec_cooldown % _SPEC_PROBE_EVERY)
+
     def _spec_tick(self) -> bool:
         """Speculative decode tick. Returns False (caller falls back to
         the plain tick) when no active row has a usable draft — the
@@ -927,13 +942,6 @@ class BatchScheduler:
         trusted slots never pass their budget."""
         K = self.spec_k
         B = self.num_slots
-        if self._spec_ema < _SPEC_EMA_FLOOR:
-            # Acceptance collapsed: probe only every Nth tick; plain
-            # ticks run in between. A successful probe lifts the EMA and
-            # re-enables per-tick speculation.
-            self._spec_cooldown += 1
-            if self._spec_cooldown % _SPEC_PROBE_EVERY:
-                return False
         tokens = np.zeros((B, K + 1), np.int32)
         drafts = np.zeros((B, K), np.int32)
         max_acc = np.zeros((B,), np.int32)

@@ -235,3 +235,29 @@ def test_kernel_ignores_garbage_table_entries_past_length():
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
     assert np.all(np.abs(np.asarray(got)) < 1e3)
+
+
+def test_write_decode_multi_out_of_table_goes_to_garbage():
+    """Speculative positions past a fully-allocated row's table must land
+    in garbage page 0 — clamping onto the last real page would wrap the
+    slot index into TRUSTED kv (regression: confirmed corruption at
+    lengths near budget with S >= 2)."""
+    B, mppr = 1, 2
+    cache = PagedKVCache.create(CFG, B, 8, PS, max_pages_per_row=mppr,
+                                dtype=jnp.float32)
+    table = np.zeros((mppr,), np.int32)
+    table[:] = [3, 5]                           # fully allocated row
+    cache = paged_kv.set_row_table(cache, 0, jnp.asarray(table))
+    cache = cache._replace(lengths=jnp.asarray([2 * PS - 2], jnp.int32))
+    snap_k = np.asarray(cache.k[0, 5])          # last real page, layer 0
+
+    S = 4                                       # 2 in-range + 2 past-table
+    k = jnp.full((B, S, CFG.num_kv_heads, CFG.head_dim), 7.0, jnp.float32)
+    out = paged_kv.write_decode_multi(cache, jnp.asarray(0), k, k)
+    got = np.asarray(out.k[0, 5])
+    # Slots 0..PS-3 of the last real page are untouched; only the two
+    # in-range positions (slots PS-2, PS-1) changed.
+    np.testing.assert_array_equal(got[:, : PS - 2], snap_k[:, : PS - 2])
+    assert np.all(got[:, PS - 2:] == 7.0)
+    # The overflow went to the garbage page.
+    assert np.any(np.asarray(out.k[0, 0]) == 7.0)

@@ -122,3 +122,29 @@ def test_ring_prefill_rejects_mixed_mesh():
     params = _params()
     with pytest.raises(AssertionError):
         ring_prefill(params, CFG, _tokens(2, 16), jnp.array([16, 16]), mesh)
+
+
+def test_ring_composes_with_int8_weights():
+    """int8 QTensor params must ride the sp/ring path like every other
+    path (regression: lm_head projection bypassed quant.mm here)."""
+    from p2p_llm_chat_tpu.models.quant import quantize_params
+
+    config = get_config("tiny")
+    params = quantize_params(
+        llama.init_params(config, jax.random.PRNGKey(0), dtype=jnp.float32))
+    sp = 4
+    B, S = 2, 8 * sp
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, config.vocab_size, (B, S)),
+                         jnp.int32)
+    lens = jnp.full((B,), S - 2, jnp.int32)
+
+    cache = KVCache.create(config, B, S, dtype=jnp.float32)
+    ref, _ = llama.prefill(params, config, tokens[:, : S - 2], lens, cache)
+    mesh = make_mesh(MeshConfig(sp=sp))
+    got, got_cache = ring_prefill(params, config, tokens, lens, mesh)
+    np.testing.assert_allclose(np.asarray(got)[:, : S - 2], np.asarray(ref),
+                               atol=2e-4, rtol=2e-3)
+    nxt = jnp.argmax(np.asarray(ref)[:, S - 3], -1).astype(jnp.int32)[:, None]
+    lg, _ = sp_decode_step(params, config, nxt, got_cache, mesh)
+    assert lg.shape == (B, 1, config.vocab_size)
