@@ -21,6 +21,8 @@ for what the reference delegated to Ollama.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -67,6 +69,23 @@ def sample(logits: jax.Array, key: jax.Array, temperature: float = 0.0,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def apply_repeat_penalty(logits: jax.Array, ring: jax.Array,
+                         rp: jax.Array) -> jax.Array:
+    """Ollama-style repetition penalty over a recent-token ring.
+
+    logits: [B,V]; ring: [B,R] recent token ids (entries >= V are empty
+    slots and drop out of the scatter); rp: [B] penalty (1.0 = identity).
+    Tokens present in the ring have positive logits divided by rp and
+    negative logits multiplied by rp — Ollama/CTRL semantics. Must run
+    BEFORE top-k/top-p: the penalty reorders candidates."""
+    B, V = logits.shape
+    mask = jnp.zeros((B, V), bool).at[
+        jnp.arange(B)[:, None], ring].set(True, mode="drop")
+    rp = rp[:, None]
+    pen = jnp.where(logits > 0, logits / rp, logits * rp)
+    return jnp.where(mask, pen, logits)
+
+
 def _warp(sorted_logits: jax.Array, temperature: jax.Array,
           top_k: jax.Array, top_p: jax.Array) -> jax.Array:
     """Shared per-row warping over a descending top-c candidate axis:
@@ -97,7 +116,9 @@ def _warp(sorted_logits: jax.Array, temperature: jax.Array,
 
 def sample_batched(logits: jax.Array, keys: jax.Array, temperature: jax.Array,
                    top_k: jax.Array, top_p: jax.Array,
-                   top_c: int = 64) -> tuple[jax.Array, jax.Array]:
+                   top_c: int = 64, ring: Optional[jax.Array] = None,
+                   rp: Optional[jax.Array] = None
+                   ) -> tuple[jax.Array, jax.Array]:
     """Per-row sampling: logits [B,V] f32, keys [B,2] (one PRNG key per
     row), temperature/top_k/top_p [B]. Returns (tokens [B] int32,
     advanced keys [B,2]).
@@ -117,6 +138,8 @@ def sample_batched(logits: jax.Array, keys: jax.Array, temperature: jax.Array,
     by sort order), and sampling never leaves the top-``top_c`` set.
     """
     B, V = logits.shape
+    if ring is not None:
+        logits = apply_repeat_penalty(logits, ring, rp)
     C = min(top_c, V)
     sorted_logits, order = jax.lax.top_k(logits, C)        # [B,C] descending
     wprobs = _warp(sorted_logits, temperature, top_k, top_p)
@@ -135,8 +158,9 @@ def spec_verify_batched(logits: jax.Array, drafts: jax.Array,
                         keys: jax.Array, temperature: jax.Array,
                         top_k: jax.Array, top_p: jax.Array,
                         max_accept: jax.Array,
-                        top_c: int = 64) -> tuple[jax.Array, jax.Array,
-                                                  jax.Array]:
+                        top_c: int = 64, ring: Optional[jax.Array] = None,
+                        rp: Optional[jax.Array] = None
+                        ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Speculative-decoding acceptance over one verify pass.
 
     logits: [B,S,V] f32 from models.llama.verify_step (position j is the
@@ -162,6 +186,27 @@ def spec_verify_batched(logits: jax.Array, drafts: jax.Array,
     """
     B, S, V = logits.shape
     K = S - 1
+    if ring is not None:
+        # Per-position recent window: the shared ring (tokens emitted in
+        # earlier ticks, including this tick's input token) UNION the
+        # drafts hypothetically emitted before each position — position
+        # j's window sees drafts 1..j, matching what sequential sampling
+        # would have penalised at that point. Membership is computed
+        # first and the penalty applied once (a token in both sets must
+        # not be penalised twice).
+        in_ring = jnp.zeros((B, V), bool).at[
+            jnp.arange(B)[:, None], ring].set(True, mode="drop")
+        member = jnp.broadcast_to(in_ring[:, None], (B, S, V))
+        if K > 0:
+            draft_hot = jax.nn.one_hot(drafts, V, dtype=jnp.float32)  # [B,K,V]
+            prefix = jnp.cumsum(draft_hot, axis=1) > 0                # [B,K,V]
+            # Position j (0-based) sees drafts[:, :j] -> shift right.
+            seen = jnp.concatenate(
+                [jnp.zeros((B, 1, V), bool), prefix], axis=1)         # [B,S,V]
+            member = member | seen
+        rp_b = rp[:, None, None]
+        pen = jnp.where(logits > 0, logits / rp_b, logits * rp_b)
+        logits = jnp.where(member, pen, logits)
     C = min(top_c, V)
     flat = logits.reshape(B * S, V)
     sorted_logits, order = jax.lax.top_k(flat, C)          # [B*S,C]
@@ -218,17 +263,24 @@ def spec_verify_batched(logits: jax.Array, drafts: jax.Array,
 
 def sample_np(logits: np.ndarray, rng: np.random.Generator,
               temperature: float = 0.0, top_k: int = 0,
-              top_p: float = 1.0) -> int:
+              top_p: float = 1.0, recent=None,
+              repeat_penalty: float = 1.0) -> int:
     """Numpy twin of :func:`sample` for one row of logits [vocab].
 
     Same filtering semantics: temperature<=0 is greedy; top-k keeps the k
     highest logits (ties at the k-th value survive, like lax.top_k's
     threshold compare); top-p keeps the smallest probability-sorted prefix
     whose cumulative mass reaches top_p (always at least one token).
+    ``recent``/``repeat_penalty`` mirror :func:`apply_repeat_penalty`.
     """
     # float64 throughout: Generator.choice checks sum(p)==1 to float64
     # tolerance, which float32 softmax fails at real vocab sizes (~128k).
     logits = np.asarray(logits, np.float64)
+    if recent is not None and repeat_penalty != 1.0:
+        for t in set(int(x) for x in recent):
+            if 0 <= t < logits.shape[-1]:
+                logits[t] = (logits[t] / repeat_penalty if logits[t] > 0
+                             else logits[t] * repeat_penalty)
     if temperature <= 0.0:
         return int(np.argmax(logits))
     logits = logits / max(temperature, 1e-6)

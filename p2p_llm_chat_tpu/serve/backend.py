@@ -26,6 +26,12 @@ class GenerateOptions:
     temperature: float = 0.0        # 0 => greedy
     top_p: float = 1.0
     top_k: int = 0                  # 0 => disabled
+    # Ollama repeat_penalty: logits of tokens in the recent window are
+    # divided (positive) / multiplied (negative) by this. 1.0 = off (our
+    # default — deterministic parity with the samplers' oracles; Ollama's
+    # own default is 1.1, which clients send explicitly to get it). The
+    # window is the last 64 tokens (Ollama's repeat_last_n default).
+    repeat_penalty: float = 1.0
     seed: Optional[int] = None
     stop: tuple[str, ...] = ()
 
@@ -40,6 +46,7 @@ class GenerateOptions:
             temperature=float(o.get("temperature", 0.0)),
             top_p=float(o.get("top_p", 1.0)),
             top_k=int(o.get("top_k", 0)),
+            repeat_penalty=float(o.get("repeat_penalty", 1.0)),
             seed=o.get("seed"),
             stop=tuple(stop),
         )

@@ -64,6 +64,8 @@ log = get_logger("serve.scheduler")
 
 _MIN_BUCKET = 16
 _MAX_ADMIT_CHUNK = 8
+# Repeat-penalty recent-token window (Ollama repeat_last_n default).
+_RING = 64
 # Adaptive speculation: below this EMA of accepted-drafts-per-tick the
 # verify pass costs more than it saves; probe intermittently instead.
 _SPEC_EMA_FLOOR = 0.5
@@ -208,7 +210,8 @@ class BatchScheduler:
         # bucketed, so the compile cache stays small.
         def _make_decode(kv_window: int):
             def _decode(params, tokens, cache, active, temps, top_ks, top_ps,
-                        keys):
+                        keys, ring, rps):
+                ring_pos = cache.lengths % _RING     # pre-advance position
                 if self.kv_mode == "paged":
                     pages = -(-kv_window // self.page_size)
                     logits, cache = model.decode_step_paged(
@@ -219,13 +222,18 @@ class BatchScheduler:
                         params, config, tokens, cache, mesh, active=active,
                         kv_window=kv_window)
                 toks, keys = sample_batched(logits[:, 0, :], keys, temps,
-                                            top_ks, top_ps)
+                                            top_ks, top_ps, ring=ring, rp=rps)
+                # The emitted token enters the penalty ring at its context
+                # position (parked rows' writes drop via the idx sentinel).
+                B = toks.shape[0]
+                idx = jnp.where(active, ring_pos, _RING)
+                ring = ring.at[jnp.arange(B), idx].set(toks, mode="drop")
                 # Parked rows keep their previous input token so their
                 # (ignored) next step stays stable regardless of their
                 # garbage sample.
                 next_tokens = jnp.where(active[:, None], toks[:, None], tokens)
-                return toks, next_tokens, cache, keys
-            return jax.jit(_decode, donate_argnums=(1, 2, 7))
+                return toks, next_tokens, cache, keys, ring
+            return jax.jit(_decode, donate_argnums=(1, 2, 7, 8))
 
         self._make_decode = _make_decode
         self._decode_programs: dict[int, object] = {}
@@ -237,7 +245,9 @@ class BatchScheduler:
             from ..models.sampling import spec_verify_batched
 
             def _spec(params, tokens, drafts, max_acc, cache, active,
-                      temps, top_ks, top_ps, keys):
+                      temps, top_ks, top_ps, keys, ring, rps):
+                K = tokens.shape[1] - 1
+                lengths_pre = cache.lengths
                 if self.kv_mode == "paged":
                     S = tokens.shape[1]
                     pages = min(-(-(kv_window + S) // self.page_size),
@@ -250,26 +260,41 @@ class BatchScheduler:
                         kv_window=kv_window)
                 accepted, correction, keys = spec_verify_batched(
                     logits.astype(jnp.float32), drafts, keys, temps,
-                    top_ks, top_ps, max_acc)
+                    top_ks, top_ps, max_acc, ring=ring, rp=rps)
                 inc = jnp.where(active, accepted + 1, 0)
                 cache = cache._replace(
                     lengths=cache.lengths + inc.astype(cache.lengths.dtype))
+                # Emitted tokens (accepted drafts + correction) enter the
+                # penalty ring at their context positions; the rest drop.
+                B = accepted.shape[0]
+                pos = (lengths_pre[:, None] + jnp.arange(K + 1)) % _RING
+                emit_ok = ((jnp.arange(K + 1)[None, :] <= accepted[:, None])
+                           & active[:, None])
+                idx = jnp.where(emit_ok, pos, _RING)
+                emitted = jnp.where(
+                    jnp.arange(K + 1)[None, :] < accepted[:, None],
+                    jnp.concatenate([drafts,
+                                     jnp.zeros((B, 1), jnp.int32)], axis=1),
+                    correction[:, None])
+                ring = ring.at[jnp.arange(B)[:, None], idx].set(
+                    emitted, mode="drop")
                 next_tokens = jnp.where(active[:, None],
                                         correction[:, None], tokens[:, :1])
-                return accepted, correction, next_tokens, cache, keys
-            return jax.jit(_spec, donate_argnums=(4, 9))
+                return accepted, correction, next_tokens, cache, keys, ring
+            return jax.jit(_spec, donate_argnums=(4, 9, 10))
 
         self._make_spec = _make_spec
         self._spec_programs: dict[int, object] = {}
 
-        def _prefill_first_token(params, tokens, ints, floats):
+        def _prefill_first_token(params, tokens, ints, floats, rings):
             """Shared admission prologue (dense and paged): batched prefill
             of R prompts + each row's first sampled token.
 
             Host scalars arrive packed (``ints`` [4,R] = lens/rows/seeds/
-            top_k, ``floats`` [2,R] = temperature/top_p): every separate
-            H2D upload costs a tunnel round-trip, so the dispatch carries
-            three arrays, not eight."""
+            top_k, ``floats`` [3,R] = temperature/top_p/repeat_penalty,
+            ``rings`` [R,_RING] = prompt-tail penalty windows): every
+            separate H2D upload costs a tunnel round-trip, so the dispatch
+            carries four arrays, not nine."""
             R, S = tokens.shape
             lens, seeds = ints[0], ints[2]
             chunk_temps, chunk_tps = floats[0], floats[1]
@@ -280,11 +305,15 @@ class BatchScheduler:
                 logits, (lens - 1)[:, None, None], axis=1)[:, 0, :]   # [R,V]
             row_keys = jax.vmap(jax.random.PRNGKey)(seeds)
             toks, row_keys = sample_batched(last, row_keys, chunk_temps,
-                                            ints[3], chunk_tps)
-            return small, toks, row_keys
+                                            ints[3], chunk_tps,
+                                            ring=rings, rp=floats[2])
+            # The first token joins each row's penalty window at its
+            # context position.
+            rings = rings.at[jnp.arange(R), lens % _RING].set(toks)
+            return small, toks, row_keys, rings
 
-        def _install_rows(rows, row_keys, toks, ints, floats, keys,
-                          next_tokens, temps, top_ks, top_ps):
+        def _install_rows(rows, row_keys, toks, ints, floats, rings, keys,
+                          next_tokens, temps, top_ks, top_ps, ring, rps):
             """Vectorized per-row sampling-state installs. Padding entries
             carry an out-of-range row sentinel (num_slots) and are dropped;
             real rows are unique, so the scatters are order-independent."""
@@ -293,10 +322,12 @@ class BatchScheduler:
             temps = temps.at[rows].set(floats[0], mode="drop")
             top_ks = top_ks.at[rows].set(ints[3], mode="drop")
             top_ps = top_ps.at[rows].set(floats[1], mode="drop")
-            return keys, next_tokens, temps, top_ks, top_ps
+            ring = ring.at[rows].set(rings, mode="drop")
+            rps = rps.at[rows].set(floats[2], mode="drop")
+            return keys, next_tokens, temps, top_ks, top_ps, ring, rps
 
-        def _admit_batch(params, tokens, ints, floats, cache, keys,
-                         next_tokens, temps, top_ks, top_ps):
+        def _admit_batch(params, tokens, ints, floats, rings, cache, keys,
+                         next_tokens, temps, top_ks, top_ps, ring, rps):
             """Prefill R prompts together, splice each row's kv into the big
             cache, and sample each row's first token. R comes from a
             two-size ladder (short chunks carry padding entries whose row
@@ -305,20 +336,23 @@ class BatchScheduler:
             bucket. One vector scatter installs the whole chunk."""
             S = tokens.shape[1]
             lens, rows = ints[0], ints[1]
-            small, toks, row_keys = _prefill_first_token(params, tokens,
-                                                         ints, floats)
+            small, toks, row_keys, rings = _prefill_first_token(
+                params, tokens, ints, floats, rings)
             k = cache.k.at[:, rows, :S].set(small.k, mode="drop")
             v = cache.v.at[:, rows, :S].set(small.v, mode="drop")
             lengths = cache.lengths.at[rows].set(
                 lens.astype(cache.lengths.dtype), mode="drop")
             cache = KVCache(k, v, lengths)
-            keys, next_tokens, temps, top_ks, top_ps = _install_rows(
-                rows, row_keys, toks, ints, floats, keys, next_tokens,
-                temps, top_ks, top_ps)
-            return toks, cache, keys, next_tokens, temps, top_ks, top_ps
+            (keys, next_tokens, temps, top_ks, top_ps, ring,
+             rps) = _install_rows(rows, row_keys, toks, ints, floats, rings,
+                                  keys, next_tokens, temps, top_ks, top_ps,
+                                  ring, rps)
+            return (toks, cache, keys, next_tokens, temps, top_ks, top_ps,
+                    ring, rps)
 
-        def _admit_batch_paged(params, tokens, ints, floats, tables, cache,
-                               keys, next_tokens, temps, top_ks, top_ps):
+        def _admit_batch_paged(params, tokens, ints, floats, rings, tables,
+                               cache, keys, next_tokens, temps, top_ks,
+                               top_ps, ring, rps):
             """Paged-mode admission: same fused prefill/sample as
             _admit_batch, but the chunk's kv splices into the page pool
             through the rows' page maps in ONE scatter
@@ -327,19 +361,22 @@ class BatchScheduler:
             all-zero table (writes land in garbage page 0) and the
             out-of-range row sentinel (installs dropped)."""
             lens, rows = ints[0], ints[1]
-            small, toks, row_keys = _prefill_first_token(params, tokens,
-                                                         ints, floats)
+            small, toks, row_keys, rings = _prefill_first_token(
+                params, tokens, ints, floats, rings)
             from ..ops.paged_kv import write_prefill_batch
             cache = write_prefill_batch(cache, small.k, small.v, rows, lens,
                                         tables)
-            keys, next_tokens, temps, top_ks, top_ps = _install_rows(
-                rows, row_keys, toks, ints, floats, keys, next_tokens,
-                temps, top_ks, top_ps)
-            return toks, cache, keys, next_tokens, temps, top_ks, top_ps
+            (keys, next_tokens, temps, top_ks, top_ps, ring,
+             rps) = _install_rows(rows, row_keys, toks, ints, floats, rings,
+                                  keys, next_tokens, temps, top_ks, top_ps,
+                                  ring, rps)
+            return (toks, cache, keys, next_tokens, temps, top_ks, top_ps,
+                    ring, rps)
 
         if self.kv_mode == "paged":
             self._admit_j = jax.jit(_admit_batch_paged,
-                                    donate_argnums=(5, 6, 7, 8, 9, 10))
+                                    donate_argnums=(6, 7, 8, 9, 10, 11, 12,
+                                                    13))
             from ..ops.paged_kv import set_row_table
 
             def _zero_row(cache, row):
@@ -353,7 +390,8 @@ class BatchScheduler:
             self._zero_row_j = jax.jit(_zero_row, donate_argnums=(0,))
         else:
             self._admit_j = jax.jit(_admit_batch,
-                                    donate_argnums=(4, 5, 6, 7, 8, 9))
+                                    donate_argnums=(5, 6, 7, 8, 9, 10, 11,
+                                                    12))
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="batch-scheduler")
@@ -429,7 +467,9 @@ class BatchScheduler:
                 cache = throwaway_cache()
                 ints = np.ones((4, R), np.int32)
                 args = [self._params, jnp.zeros((R, S), jnp.int32),
-                        jnp.asarray(ints), jnp.ones((2, R), jnp.float32)]
+                        jnp.asarray(ints), jnp.ones((3, R), jnp.float32),
+                        jnp.full((R, _RING), self.config.vocab_size,
+                                 jnp.int32)]
                 if self.kv_mode == "paged":
                     args.append(jnp.zeros(
                         (R, cache.max_pages_per_row), jnp.int32))
@@ -437,6 +477,9 @@ class BatchScheduler:
                          jnp.zeros((B, 1), jnp.int32),
                          jnp.zeros((B,), jnp.float32),
                          jnp.zeros((B,), jnp.int32),
+                         jnp.ones((B,), jnp.float32),
+                         jnp.full((B, _RING), self.config.vocab_size,
+                                  jnp.int32),
                          jnp.ones((B,), jnp.float32)]
                 self._admit_j(*args)
         toks = None
@@ -446,7 +489,9 @@ class BatchScheduler:
                 self._params, jnp.zeros((B, 1), jnp.int32), cache,
                 jnp.zeros((B,), bool), jnp.zeros((B,), jnp.float32),
                 jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
-                jnp.zeros((B, 2), jnp.uint32))
+                jnp.zeros((B, 2), jnp.uint32),
+                jnp.full((B, _RING), self.config.vocab_size, jnp.int32),
+                jnp.ones((B,), jnp.float32))
             if self.spec_k:
                 K = self.spec_k
                 toks, *_ = self._spec_for(w)(
@@ -455,7 +500,9 @@ class BatchScheduler:
                     throwaway_cache(), jnp.zeros((B,), bool),
                     jnp.zeros((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
                     jnp.ones((B,), jnp.float32),
-                    jnp.zeros((B, 2), jnp.uint32))
+                    jnp.zeros((B, 2), jnp.uint32),
+                    jnp.full((B, _RING), self.config.vocab_size, jnp.int32),
+                    jnp.ones((B,), jnp.float32))
         if self.kv_mode == "paged":
             # The row-release program (_zero_row_j) otherwise compiles on
             # the first request's release — inside a later request's TTFT.
@@ -494,6 +541,11 @@ class BatchScheduler:
         self._temps_dev = jnp.zeros((B,), jnp.float32)
         self._top_ks_dev = jnp.zeros((B,), jnp.int32)
         self._top_ps_dev = jnp.ones((B,), jnp.float32)
+        # Repeat-penalty state: per-row recent-token ring (sentinel
+        # vocab_size = empty slot) + penalty factor (1.0 = off).
+        self._ring_dev = jnp.full((B, _RING), self.config.vocab_size,
+                                  jnp.int32)
+        self._rps_dev = jnp.ones((B,), jnp.float32)
         self._active_host: tuple = ()
         self._active_dev = jnp.zeros((B,), bool)
 
@@ -823,16 +875,23 @@ class BatchScheduler:
         pad = R - len(chunk)
         tokens = np.zeros((R, S), np.int32)
         ints = np.zeros((4, R), np.int32)           # lens/rows/seeds/top_k
-        floats = np.zeros((2, R), np.float32)       # temperature/top_p
+        floats = np.zeros((3, R), np.float32)       # temp/top_p/repeat_pen
+        rings = np.full((R, _RING), self.config.vocab_size, np.int32)
         ints[0] = 1                                 # padding: 1-token prompt
         ints[1] = self.num_slots                    # padding: dropped rows
         floats[1] = 1.0
+        floats[2] = 1.0
         for i, (slot, row) in enumerate(zip(chunk, rows)):
             r = pad + i
             tokens[r, : len(slot.prompt_ids)] = slot.prompt_ids
             o = slot.req.options
             ints[:, r] = (len(slot.prompt_ids), row, slot.seed, o.top_k)
-            floats[:, r] = (o.temperature, o.top_p)
+            floats[:, r] = (o.temperature, o.top_p, o.repeat_penalty)
+            # Penalty window: prompt tokens at their context position mod
+            # _RING (later positions overwrite earlier — last-64 window).
+            if o.repeat_penalty != 1.0:
+                for p_i, t in enumerate(slot.prompt_ids):
+                    rings[r, p_i % _RING] = t
 
         if self.kv_mode == "paged":
             # Padding entries keep an all-zero table: their prefill writes
@@ -842,20 +901,25 @@ class BatchScheduler:
             for i, slot in enumerate(chunk):
                 tables[pad + i, : len(slot.pages)] = slot.pages
             (toks_dev, self._cache, self._keys, self._next_dev,
-             self._temps_dev, self._top_ks_dev, self._top_ps_dev) = \
+             self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+             self._ring_dev, self._rps_dev) = \
                 self._admit_j(
                     self._params, jnp.asarray(tokens), jnp.asarray(ints),
-                    jnp.asarray(floats), jnp.asarray(tables), self._cache,
+                    jnp.asarray(floats), jnp.asarray(rings),
+                    jnp.asarray(tables), self._cache,
                     self._keys, self._next_dev, self._temps_dev,
-                    self._top_ks_dev, self._top_ps_dev)
+                    self._top_ks_dev, self._top_ps_dev, self._ring_dev,
+                    self._rps_dev)
         else:
             (toks_dev, self._cache, self._keys, self._next_dev,
-             self._temps_dev, self._top_ks_dev, self._top_ps_dev) = \
+             self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+             self._ring_dev, self._rps_dev) = \
                 self._admit_j(
                     self._params, jnp.asarray(tokens), jnp.asarray(ints),
-                    jnp.asarray(floats), self._cache, self._keys,
-                    self._next_dev, self._temps_dev, self._top_ks_dev,
-                    self._top_ps_dev)
+                    jnp.asarray(floats), jnp.asarray(rings), self._cache,
+                    self._keys, self._next_dev, self._temps_dev,
+                    self._top_ks_dev, self._top_ps_dev, self._ring_dev,
+                    self._rps_dev)
         first_toks = np.asarray(toks_dev)        # tiny sync readback
 
         now = time.monotonic()
@@ -888,9 +952,11 @@ class BatchScheduler:
         # ahead of the host's ctx_len (its previous token is still
         # unprocessed), so the window budget covers it.
         decode_j = self._decode_for(self._window(extra=1))
-        toks_dev, self._next_dev, self._cache, self._keys = decode_j(
+        (toks_dev, self._next_dev, self._cache, self._keys,
+         self._ring_dev) = decode_j(
             self._params, self._next_dev, self._cache, self._active_dev,
-            self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys)
+            self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys,
+            self._ring_dev, self._rps_dev)
         return toks_dev, list(self._slots)
 
     def _process_tick(self, toks_dev, snapshot: list) -> None:
@@ -970,10 +1036,11 @@ class BatchScheduler:
             self._active_dev = jnp.asarray(np.array(active, bool))
         spec_j = self._spec_for(self._window(extra=K))
         (accepted, correction, self._next_dev, self._cache,
-         self._keys) = spec_j(
+         self._keys, self._ring_dev) = spec_j(
             self._params, jnp.asarray(tokens), jnp.asarray(drafts),
             jnp.asarray(max_acc), self._cache, self._active_dev,
-            self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys)
+            self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys,
+            self._ring_dev, self._rps_dev)
         acc = np.asarray(accepted)               # [B] int32 — tiny sync
         corr = np.asarray(correction)
         n_active = sum(s is not None for s in self._slots)

@@ -167,3 +167,23 @@ def test_batched_keys_advance_and_reproduce():
     t2, _ = sample_batched(lg, k1, *args)
     seq = [int(x) for x in np.asarray(jnp.concatenate([t1, t2]))]
     assert len(set(seq)) > 1     # stream advances across key updates
+
+
+def test_apply_repeat_penalty_matches_numpy_twin():
+    from p2p_llm_chat_tpu.models.sampling import apply_repeat_penalty
+
+    rng = np.random.default_rng(0)
+    B, V, R = 3, 32, 8
+    logits = rng.normal(size=(B, V)).astype(np.float32)
+    ring = np.full((B, R), V, np.int32)          # sentinel = empty
+    ring[0, :3] = [1, 5, 1]                      # dup entry: penalise once
+    ring[1, :2] = [0, 31]
+    rp = np.asarray([1.3, 2.0, 1.0], np.float32) # row 2: identity
+    got = np.asarray(apply_repeat_penalty(
+        jnp.asarray(logits), jnp.asarray(ring), jnp.asarray(rp)))
+    for b in range(B):
+        want = logits[b].astype(np.float64).copy()
+        for t in set(int(x) for x in ring[b] if x < V):
+            want[t] = want[t] / rp[b] if want[t] > 0 else want[t] * rp[b]
+        np.testing.assert_allclose(got[b], want.astype(np.float32),
+                                   rtol=1e-6, atol=1e-6)
