@@ -20,8 +20,10 @@ Subpackages
                   batching scheduler, inference engine (replaces reference L4)
 - ``models``    — JAX model definitions (llama family, Mixtral MoE)
 - ``ops``       — Pallas TPU kernels (paged attention, flash attention)
-- ``parallel``  — device mesh / sharding rules / collectives (TP, EP, DP, SP)
-- ``utils``     — config, logging, metrics, tiny HTTP framework
+- ``parallel``  — device mesh / sharding rules / collectives (DP, PP, EP,
+                  SP/ring, TP; multi-host DCN entry)
+- ``utils``     — config, logging, metrics, tiny HTTP framework, n-gram
+                  drafting, native-library loader
 """
 
 __version__ = "0.1.0"

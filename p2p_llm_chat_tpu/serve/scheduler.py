@@ -189,6 +189,7 @@ class BatchScheduler:
         self._reset_device_state()
 
         self._admit_q: "queue.Queue[Optional[_Slot]]" = queue.Queue()
+        self._admit_carry: list[_Slot] = []   # prepared chunks awaiting rows
         self._closed = threading.Event()
         # Serving-plane counters (SURVEY.md §5 metrics plan: queue depth,
         # batch occupancy, decode ticks). Plain ints written only by the
@@ -734,7 +735,8 @@ class BatchScheduler:
         out = {
             "serve_batch_occupancy": sum(s is not None for s in self._slots),
             "serve_batch_slots": self.num_slots,
-            "serve_queue_depth": self._admit_q.qsize() + len(self._waiting),
+            "serve_queue_depth": (self._admit_q.qsize() + len(self._waiting)
+                                  + len(self._admit_carry)),
             "serve_admitted_total": self._n_admitted,
             "serve_decode_ticks_total": self._n_decode_ticks,
             "serve_queue_expired_total": self._n_expired,
@@ -776,11 +778,25 @@ class BatchScheduler:
         """Admit pending requests into free rows: group by prompt bucket,
         prefill each group in power-of-two chunks (one fused dispatch per
         chunk). Paged mode first retries page-starved waiters (FIFO), then
-        pulls fresh requests while pages and rows last."""
+        pulls fresh requests while pages and rows last.
+
+        While decode is active, at most ONE chunk is admitted per call
+        (the rest carries to the next loop iteration), so a multi-chunk
+        burst cannot stall every live stream behind back-to-back
+        prefills — chunked-prefill interleaving."""
         free = self._free_rows()
         if not free:
             return
+        had_active = len(free) < self.num_slots   # live streams to protect
         pending: list[_Slot] = []
+        for s in self._admit_carry:           # prepared last round
+            if s.cancelled.is_set() or s.done or self._expired(s):
+                if s.pages:                   # never installed in a table
+                    self._alloc.free(s.pages)
+                    s.pages = None
+                continue
+            pending.append(s)
+        self._admit_carry = []
         if self.kv_mode == "paged" and self._waiting:
             still: list[_Slot] = []
             for s in self._waiting:
@@ -840,6 +856,13 @@ class BatchScheduler:
                 rows = [free.pop(0) for _ in range(len(chunk))]
                 try:
                     self._admit_chunk(chunk, rows, S, R)
+                    if had_active and (group or gi + 1 < len(groups)):
+                        # Live streams existed before this round and more
+                        # chunks remain: carry them so decode ticks run
+                        # in between (bounded stalls per burst).
+                        self._admit_carry = group + [
+                            x for _, g in groups[gi + 1:] for x in g]
+                        return
                 except Exception:   # noqa: BLE001
                     log.exception("admission failed for %d request(s)",
                                   len(chunk))
