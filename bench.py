@@ -21,6 +21,20 @@ Output: one JSON line on stdout:
 The reference publishes no numbers (SURVEY.md §6; BASELINE.json
 ``published: {}``), so ``vs_baseline`` is measured against the stated
 north-star target: ``150 ms / p50_ttft_ms`` (> 1.0 beats the target).
+
+Env knobs (all optional):
+- ``BENCH_CONFIG``      model config (default bench-1b)
+- ``BENCH_SLOTS``       concurrent peers / batch rows (default 32)
+- ``BENCH_MAX_SEQ``     per-slot sequence budget (default 1024)
+- ``BENCH_NEW_TOKENS``  completion length per request (default 32)
+- ``BENCH_DECODE_STEPS``raw-decode timing steps (default 64)
+- ``BENCH_KV``          dense | paged (default dense)
+- ``BENCH_PAGE_SIZE``   tokens per KV page in paged mode (default 64)
+- ``BENCH_QUANT``       int8 = weight-only quantization
+- ``BENCH_SPEC``        K>0 = speculative decoding with K drafts/tick
+- ``BENCH_ADMIT_CHUNK`` fixed burst-admission width
+- ``BENCH_PROFILE``     directory for a jax.profiler trace of the
+                        concurrent section
 """
 
 from __future__ import annotations

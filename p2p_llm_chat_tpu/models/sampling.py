@@ -159,7 +159,8 @@ def spec_verify_batched(logits: jax.Array, drafts: jax.Array,
                         top_k: jax.Array, top_p: jax.Array,
                         max_accept: jax.Array,
                         top_c: int = 64, ring: Optional[jax.Array] = None,
-                        rp: Optional[jax.Array] = None
+                        rp: Optional[jax.Array] = None,
+                        ctx_len: Optional[jax.Array] = None
                         ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Speculative-decoding acceptance over one verify pass.
 
@@ -187,23 +188,34 @@ def spec_verify_batched(logits: jax.Array, drafts: jax.Array,
     B, S, V = logits.shape
     K = S - 1
     if ring is not None:
-        # Per-position recent window: the shared ring (tokens emitted in
-        # earlier ticks, including this tick's input token) UNION the
-        # drafts hypothetically emitted before each position — position
-        # j's window sees drafts 1..j, matching what sequential sampling
-        # would have penalised at that point. Membership is computed
-        # first and the penalty applied once (a token in both sets must
-        # not be penalised twice).
-        in_ring = jnp.zeros((B, V), bool).at[
-            jnp.arange(B)[:, None], ring].set(True, mode="drop")
-        member = jnp.broadcast_to(in_ring[:, None], (B, S, V))
+        # Per-position recent window with exact SLIDING semantics:
+        # sequential sampling at stream position j penalises the last
+        # ``Rw`` tokens of (context + drafts[:j]) — each hypothetical
+        # draft both ENTERS the window and EVICTS the oldest ring token
+        # (the one at ring slot (ctx_len + i) % Rw, which holds context
+        # position ctx_len + i - Rw). Occurrence COUNTS (not set union)
+        # make eviction correct when a token also occurs elsewhere in
+        # the window. ``ctx_len`` [B]: context length before this tick's
+        # input token's position (the scheduler's pre-advance lengths).
+        Rw = ring.shape[1]
+        in_cnt = jnp.zeros((B, V), jnp.float32).at[
+            jnp.arange(B)[:, None], ring].add(1.0, mode="drop")
+        cnt = jnp.broadcast_to(in_cnt[:, None], (B, S, V))
         if K > 0:
-            draft_hot = jax.nn.one_hot(drafts, V, dtype=jnp.float32)  # [B,K,V]
-            prefix = jnp.cumsum(draft_hot, axis=1) > 0                # [B,K,V]
-            # Position j (0-based) sees drafts[:, :j] -> shift right.
-            seen = jnp.concatenate(
-                [jnp.zeros((B, 1, V), bool), prefix], axis=1)         # [B,S,V]
-            member = member | seen
+            shifts = jnp.arange(1, K + 1)[None, :]              # [1,K]
+            ev_slots = (ctx_len[:, None] + shifts) % Rw         # [B,K]
+            ev = jnp.take_along_axis(ring, ev_slots, axis=1)    # [B,K]
+            zero = jnp.zeros((B, 1, V), jnp.float32)
+            # one_hot of the empty-slot sentinel (>= V) is all-zero, so
+            # not-yet-full rings evict nothing.
+            ev_pref = jnp.concatenate(
+                [zero, jnp.cumsum(jax.nn.one_hot(ev, V,
+                                                 dtype=jnp.float32), 1)], 1)
+            dr_pref = jnp.concatenate(
+                [zero, jnp.cumsum(jax.nn.one_hot(drafts, V,
+                                                 dtype=jnp.float32), 1)], 1)
+            cnt = cnt - ev_pref + dr_pref                       # [B,S,V]
+        member = cnt > 0.5
         rp_b = rp[:, None, None]
         pen = jnp.where(logits > 0, logits / rp_b, logits * rp_b)
         logits = jnp.where(member, pen, logits)

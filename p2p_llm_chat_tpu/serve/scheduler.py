@@ -212,7 +212,10 @@ class BatchScheduler:
         def _make_decode(kv_window: int):
             def _decode(params, tokens, cache, active, temps, top_ks, top_ps,
                         keys, ring, rps):
-                ring_pos = cache.lengths % _RING     # pre-advance position
+                # The emitted token's context position is lengths+1 (the
+                # INPUT token occupies lengths) — writing at lengths would
+                # clobber the previous tick's emission in the ring.
+                ring_pos = (cache.lengths + 1) % _RING
                 if self.kv_mode == "paged":
                     pages = -(-kv_window // self.page_size)
                     logits, cache = model.decode_step_paged(
@@ -261,14 +264,17 @@ class BatchScheduler:
                         kv_window=kv_window)
                 accepted, correction, keys = spec_verify_batched(
                     logits.astype(jnp.float32), drafts, keys, temps,
-                    top_ks, top_ps, max_acc, ring=ring, rp=rps)
+                    top_ks, top_ps, max_acc, ring=ring, rp=rps,
+                    ctx_len=lengths_pre)
                 inc = jnp.where(active, accepted + 1, 0)
                 cache = cache._replace(
                     lengths=cache.lengths + inc.astype(cache.lengths.dtype))
                 # Emitted tokens (accepted drafts + correction) enter the
                 # penalty ring at their context positions; the rest drop.
                 B = accepted.shape[0]
-                pos = (lengths_pre[:, None] + jnp.arange(K + 1)) % _RING
+                # emitted[i] is the token AFTER input i -> context
+                # position lengths_pre + i + 1.
+                pos = (lengths_pre[:, None] + 1 + jnp.arange(K + 1)) % _RING
                 emit_ok = ((jnp.arange(K + 1)[None, :] <= accepted[:, None])
                            & active[:, None])
                 idx = jnp.where(emit_ok, pos, _RING)
@@ -592,6 +598,9 @@ class BatchScheduler:
         for s in self._waiting:
             s.finish()
         self._waiting = []
+        for s in self._admit_carry:
+            s.finish()
+        self._admit_carry = []
         while True:
             try:
                 s = self._admit_q.get_nowait()
@@ -913,8 +922,9 @@ class BatchScheduler:
             # Penalty window: prompt tokens at their context position mod
             # _RING (later positions overwrite earlier — last-64 window).
             if o.repeat_penalty != 1.0:
-                for p_i, t in enumerate(slot.prompt_ids):
-                    rings[r, p_i % _RING] = t
+                start = max(0, len(slot.prompt_ids) - _RING)
+                for p_i in range(start, len(slot.prompt_ids)):
+                    rings[r, p_i % _RING] = slot.prompt_ids[p_i]
 
         if self.kv_mode == "paged":
             # Padding entries keep an all-zero table: their prefill writes
@@ -1186,6 +1196,12 @@ class BatchScheduler:
             if s is not None:
                 s.fail("internal error: serving state was reset")
                 self._slots[i] = None
+        for s in self._admit_carry:
+            # Their reserved pages came from the allocator being rebuilt —
+            # freeing them into the NEW allocator would duplicate ids.
+            s.pages = None
+            s.fail("internal error: serving state was reset")
+        self._admit_carry = []
         self._reset_device_state()
 
     def _release(self, row: int) -> None:

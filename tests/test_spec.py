@@ -364,3 +364,22 @@ def test_repeat_penalty_changes_output():
         assert run(1.0) != run(2.0)
     finally:
         eng.stop()
+
+
+@pytest.mark.parametrize("spec_k", [0, 4])
+def test_repeat_penalty_across_full_window(spec_k):
+    """Context crosses the 64-token penalty window mid-generation: the
+    sliding eviction (drafts push the oldest window tokens out) must
+    keep speculative greedy output bit-exact with the sequential
+    oracle."""
+    prompt = "the quick brown fox jumps over the lazy dog " * 3   # ~130 toks
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=256,
+                    spec_k=spec_k)
+    try:
+        req = GenerateRequest(
+            prompt=prompt,
+            options=GenerateOptions(max_tokens=24, repeat_penalty=1.3))
+        got = "".join(eng.generate_stream(req, RequestStats()))
+        assert got == _penalty_oracle(prompt, 24, 1.3, max_seq=256), spec_k
+    finally:
+        eng.stop()
