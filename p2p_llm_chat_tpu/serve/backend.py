@@ -32,6 +32,7 @@ class GenerateOptions:
     # own default is 1.1, which clients send explicitly to get it). The
     # window is the last 64 tokens (Ollama's repeat_last_n default).
     repeat_penalty: float = 1.0
+    num_ctx: int = 0                # per-request context cap (0 = server max)
     seed: Optional[int] = None
     stop: tuple[str, ...] = ()
 
@@ -47,6 +48,7 @@ class GenerateOptions:
             top_p=float(o.get("top_p", 1.0)),
             top_k=int(o.get("top_k", 0)),
             repeat_penalty=float(o.get("repeat_penalty", 1.0)),
+            num_ctx=int(o.get("num_ctx", 0)),
             seed=o.get("seed"),
             stop=tuple(stop),
         )

@@ -690,17 +690,20 @@ class BatchScheduler:
             ids = self.tokenizer.encode(slot.req.prompt, add_bos=True)
             # Context budget: keep the prompt tail (recent context wins, the
             # same truncation direction Ollama applies), leave room to
-            # generate.
-            max_prompt = self.max_seq - 2
+            # generate. Ollama num_ctx caps a request below the server max.
+            limit = self.max_seq
+            if opts.num_ctx > 0:
+                limit = max(_MIN_BUCKET, min(limit, opts.num_ctx))
+            max_prompt = limit - 2
             if len(ids) > max_prompt:
                 ids = ids[-max_prompt:]
-            budget = self.max_seq - 1 - len(ids)
+            budget = limit - 1 - len(ids)
             # Ollama semantics: num_predict <= 0 means "until EOS / context
             # full", not "almost nothing".
             want = opts.max_tokens if opts.max_tokens > 0 else budget
             slot.max_new = max(1, min(want, budget))
             slot.prompt_ids = ids
-            slot.ctx_budget = self.max_seq
+            slot.ctx_budget = limit
             if slot.stats is not None:
                 slot.stats.prompt_tokens = len(ids)
             out.append(slot)

@@ -408,3 +408,22 @@ def test_serving_bucket_rounds_up_to_warmed():
         assert sched._serving_bucket(200) == 256        # beyond warmed: lazy
     finally:
         eng.stop()
+
+
+def test_num_ctx_caps_request_context():
+    """Ollama num_ctx: a request-level context cap below the server max
+    truncates the prompt tail-first and bounds generation."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128)
+    try:
+        long_prompt = "x" * 100
+        req = GenerateRequest(
+            prompt=long_prompt,
+            options=GenerateOptions(max_tokens=64, num_ctx=32))
+        stats = RequestStats()
+        text = "".join(eng.generate_stream(req, stats))
+        # Prompt truncated to num_ctx-2 and completion bounded by the cap.
+        assert stats.prompt_tokens <= 30
+        assert stats.prompt_tokens + stats.completion_tokens <= 32
+        assert isinstance(text, str)
+    finally:
+        eng.stop()
