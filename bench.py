@@ -32,6 +32,8 @@ Env knobs (all optional):
 - ``BENCH_PAGE_SIZE``   tokens per KV page in paged mode (default 64)
 - ``BENCH_QUANT``       int8 = weight-only quantization
 - ``BENCH_SPEC``        K>0 = speculative decoding with K drafts/tick
+- ``BENCH_PREFIX``      1 = shared-prefix KV cache (suggestion-template
+                        head registered; admission prefills suffix only)
 - ``BENCH_ADMIT_CHUNK`` fixed burst-admission width
 - ``BENCH_PROFILE``     directory for a jax.profiler trace of the
                         concurrent section
@@ -143,11 +145,12 @@ def main() -> None:
     # -- end-to-end serving: p50 TTFT at `slots` concurrent peers ------------
     admit_chunk = int(os.environ.get("BENCH_ADMIT_CHUNK", "0")) or None
     spec_k = int(os.environ.get("BENCH_SPEC", "0"))
+    use_prefix = os.environ.get("BENCH_PREFIX", "") not in ("", "0", "false")
     tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
     sched = BatchScheduler(params, config, tokenizer, num_slots=slots,
                            max_seq=max_seq, kv_mode=kv_mode,
                            page_size=page_size, admit_chunk=admit_chunk,
-                           spec_k=spec_k)
+                           spec_k=spec_k, prefix_cache=use_prefix)
     prompt = ("Draft a concise, friendly reply to the following message:\n\n"
               "Hey, are we still meeting tomorrow at 10?\n\nReply:")
     opts = GenerateOptions(max_tokens=new_tokens, temperature=0.7, top_p=0.9,
@@ -163,7 +166,8 @@ def main() -> None:
     # one real request to exercise the full host path.
     # Bench contexts stay under 256 slots; restrict the window ladder so
     # warmup compiles 2 decode programs, not the full ladder to max_seq.
-    sched.warmup(prompt_buckets=(128, 256), windows=(128, 256))
+    sched.warmup(prompt_buckets=(128, 256), windows=(128, 256),
+                 prefix_texts=(prompt,) if use_prefix else ())
     run_one(RequestStats())
     # Single-request TTFT (the config-2 "drop-in OLLAMA_URL" number).
     s1 = RequestStats()
@@ -187,8 +191,8 @@ def main() -> None:
         for th in threads:
             th.join()
     wall = time.monotonic() - t
-    spec_stats = ({k: v for k, v in sched.metrics_snapshot().items()
-                   if "spec" in k} if spec_k else {})
+    spec_stats = {k: v for k, v in sched.metrics_snapshot().items()
+                  if ("spec" in k and spec_k) or ("prefix" in k and use_prefix)}
     ttfts = sorted(s.ttft_s * 1e3 for s in all_stats if s.ttft_s is not None)
     done_tokens = sum(s.completion_tokens for s in all_stats)
     p50 = statistics.median(ttfts)
@@ -210,6 +214,7 @@ def main() -> None:
             "kv_mode": kv_mode,
             "quant": quant or None,
             "spec_k": spec_k or None,
+            "prefix_cache": use_prefix or None,
             **spec_stats,
             "page_size": page_size if kv_mode == "paged" else None,
             "config": cfg_name,

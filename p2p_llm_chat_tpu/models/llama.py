@@ -195,19 +195,15 @@ def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
         cache_k, cache_v
 
 
-def forward(params: dict, config: ModelConfig, tokens: jax.Array,
-            positions: jax.Array, cache: KVCache, mask: jax.Array,
-            mesh: Optional[Mesh] = None,
-            rules: LogicalRules = DEFAULT_RULES,
-            kv_window: Optional[int] = None,
-            mlp_fn=None) -> tuple[jax.Array, KVCache]:
-    """Shared forward: embed -> scan(blocks) -> norm -> logits.
-
-    tokens/positions: [B,S]; mask: [B or 1,1,S,W] (True = attend) where W
-    is ``kv_window`` (or max_seq when unset — the static attention-read
-    window; see _block); k/v for this step are written at ``positions`` in
-    every layer's cache. Returns (logits [B,S,vocab] f32, updated cache).
-    """
+def hidden_states(params: dict, config: ModelConfig, tokens: jax.Array,
+                  positions: jax.Array, cache: KVCache, mask: jax.Array,
+                  mesh: Optional[Mesh] = None,
+                  rules: LogicalRules = DEFAULT_RULES,
+                  kv_window: Optional[int] = None,
+                  mlp_fn=None) -> tuple[jax.Array, KVCache]:
+    """embed -> scan(blocks) -> final norm. Returns (h [B,S,H], cache) —
+    the shared trunk of :func:`forward`; also the embedding feature
+    extractor (:func:`embed_pooled` / the serve /api/embed path)."""
     # Compute dtype follows the params' dtype (bf16 in production; the HF
     # parity tests load f32 weights and get f32 compute for tight tolerances).
     h = params["embed"][tokens]
@@ -226,11 +222,55 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
         body, (h, cache.k, cache.v),
         (params["layers"], jnp.arange(config.num_layers)))
     h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    return h, KVCache(new_k, new_v, cache.lengths)
+
+
+def forward(params: dict, config: ModelConfig, tokens: jax.Array,
+            positions: jax.Array, cache: KVCache, mask: jax.Array,
+            mesh: Optional[Mesh] = None,
+            rules: LogicalRules = DEFAULT_RULES,
+            kv_window: Optional[int] = None,
+            mlp_fn=None) -> tuple[jax.Array, KVCache]:
+    """Shared forward: embed -> scan(blocks) -> norm -> logits.
+
+    tokens/positions: [B,S]; mask: [B or 1,1,S,W] (True = attend) where W
+    is ``kv_window`` (or max_seq when unset — the static attention-read
+    window; see _block); k/v for this step are written at ``positions`` in
+    every layer's cache. Returns (logits [B,S,vocab] f32, updated cache).
+    """
+    h, cache = hidden_states(params, config, tokens, positions, cache, mask,
+                             mesh, rules, kv_window, mlp_fn)
     lm_head = (params["embed"].T if config.tie_embeddings
                else params["lm_head"])
     logits = mm(h, lm_head).astype(jnp.float32)
     logits = constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
-    return logits, KVCache(new_k, new_v, cache.lengths)
+    return logits, cache
+
+
+def embed_pooled(params: dict, config: ModelConfig, tokens: jax.Array,
+                 lens: jax.Array, mesh: Optional[Mesh] = None,
+                 rules: LogicalRules = DEFAULT_RULES,
+                 mlp_fn=None) -> jax.Array:
+    """Sequence embeddings: length-masked mean pool of the final-norm
+    hidden states, L2-normalized — the in-tree backend for Ollama's
+    ``POST /api/embed`` (the reference delegates all LLM capability to
+    Ollama, whose API includes embeddings; serve/api.py).
+
+    tokens: [B,S] right-padded; lens: [B]. Returns [B,H] float32 unit
+    vectors; pad positions contribute nothing (masked before pooling).
+    """
+    B, S = tokens.shape
+    cache = KVCache.create(config, B, S, dtype=params["embed"].dtype)
+    positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    mask = causal_mask(S, S, 0)
+    h, _ = hidden_states(params, config, tokens, positions, cache, mask,
+                         mesh, rules, mlp_fn=mlp_fn)
+    h = h.astype(jnp.float32)
+    valid = (jnp.arange(S)[None, :] < lens[:, None]).astype(jnp.float32)
+    pooled = (h * valid[:, :, None]).sum(axis=1) / jnp.maximum(
+        lens[:, None].astype(jnp.float32), 1.0)
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-9)
 
 
 def prefill(params: dict, config: ModelConfig, tokens: jax.Array,

@@ -262,3 +262,15 @@ def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     return llama.verify_step_paged(params, config, tokens, cache, mesh,
                                    rules, pages=pages, interpret=interpret,
                                    mlp_fn=_mlp_fn(config, None))
+
+
+def embed_pooled(params: dict, config: ModelConfig, tokens: jax.Array,
+                 lens: jax.Array, mesh: Optional[Mesh] = None,
+                 rules: LogicalRules = DEFAULT_RULES,
+                 capacity=_AUTO) -> jax.Array:
+    """llama.embed_pooled with the MoE MLP (length-masked mean pool of
+    final-norm hidden states, L2-normalized; the /api/embed backend)."""
+    cap = _capacity_for(config, int(tokens.shape[0] * tokens.shape[1]),
+                        capacity)
+    return llama.embed_pooled(params, config, tokens, lens, mesh, rules,
+                              mlp_fn=_mlp_fn(config, cap))

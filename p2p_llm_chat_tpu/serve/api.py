@@ -11,6 +11,8 @@ SURVEY.md §1 L4 note):
   omitted) sends NDJSON chunks ``{"response": <delta>, "done": false}`` and
   a final ``done: true`` record with stats.
 - ``POST /api/chat``      same shapes with ``messages`` / ``message``.
+- ``POST /api/embed``     sequence embeddings (``input``: str | [str]);
+  ``POST /api/embeddings`` is the legacy single-prompt form.
 - ``GET  /api/tags``      model listing.
 - ``GET  /api/version``, ``GET /`` ("Ollama is running") — client health
   checks.
@@ -69,6 +71,16 @@ class OllamaServer:
         self.router.add("GET", "/api/tags", self._tags)
         self.router.add("POST", "/api/show", self._show)
         self.router.add("GET", "/api/ps", self._ps)
+        self.router.add("POST", "/api/embed", self._embed)
+        self.router.add("POST", "/api/embeddings", self._embeddings_legacy)
+        # Model-management endpoints (pull/push/create/copy/delete) exist
+        # in Ollama to mutate its local model store; here models are
+        # provisioned from checkpoints at startup (CKPT_DIR), so these
+        # answer with an explicit 501 instead of a confusing 404 — Ollama
+        # clients get a clear, parseable error record.
+        for ep in ("/api/pull", "/api/push", "/api/create", "/api/copy"):
+            self.router.add("POST", ep, self._unsupported)
+        self.router.add("DELETE", "/api/delete", self._unsupported)
         self.router.add("GET", "/api/version", lambda r: Response(200, {
             "version": "0.1.0-p2p-llm-chat-tpu"}))
         self.router.add("GET", "/", lambda r: Response(
@@ -222,6 +234,70 @@ class OllamaServer:
         return Response(200, {"modelfile": "", "parameters": "",
                               "template": "", "details": details,
                               "model_info": info})
+
+    def _embed(self, req: Request) -> Response:
+        """Ollama `POST /api/embed`: ``input`` is one string or a list;
+        responds ``{"embeddings": [[...], ...]}`` plus timing/count fields.
+        Backed by models/llama.embed_pooled (mean-pooled final hidden
+        states) on the TPU engine, or FakeLLM's hash vectors."""
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        fn = getattr(self.backend, "embed", None)
+        if fn is None:
+            # Ollama's own wording for non-embedding models.
+            return Response(400, {"error": "this model does not support embeddings"})
+        inp = body.get("input")
+        if inp is None:
+            inp = body.get("prompt")        # tolerated, like Ollama
+        if inp is not None and not isinstance(inp, (str, list)):
+            return Response(400, {"error": "input must be a string or list of strings"})
+        texts = [inp] if isinstance(inp, str) else list(inp or [])
+        if not all(isinstance(t, str) for t in texts):
+            return Response(400, {"error": "input must be a string or list of strings"})
+        model = str(body.get("model") or self.backend.name)
+        started = time.monotonic()
+        try:
+            vecs, n_tokens = fn(texts)
+        except Exception as e:  # noqa: BLE001
+            self._m_errors.inc()
+            log.exception("embed failed")
+            return Response(500, {"error": str(e)})
+        return Response(200, {
+            "model": model,
+            "embeddings": vecs,
+            "total_duration": int((time.monotonic() - started) * 1e9),
+            "load_duration": 0,
+            "prompt_eval_count": n_tokens,
+        })
+
+    def _embeddings_legacy(self, req: Request) -> Response:
+        """Ollama's legacy `POST /api/embeddings` ({"prompt": ...} ->
+        {"embedding": [...]}) — kept because older clients still call it."""
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        fn = getattr(self.backend, "embed", None)
+        if fn is None:
+            return Response(400, {"error": "this model does not support embeddings"})
+        prompt = body.get("prompt")
+        if not isinstance(prompt, str):
+            return Response(400, {"error": "prompt must be a string"})
+        try:
+            vecs, _ = fn([prompt])
+        except Exception as e:  # noqa: BLE001
+            self._m_errors.inc()
+            log.exception("embed failed")
+            return Response(500, {"error": str(e)})
+        return Response(200, {"embedding": vecs[0]})
+
+    def _unsupported(self, req: Request) -> Response:
+        return Response(501, {
+            "error": "model management is not supported: models are "
+                     "provisioned from checkpoints at startup (CKPT_DIR; "
+                     "see README serve section)"})
 
     def _ps(self, req: Request) -> Response:
         """Ollama `GET /api/ps`: loaded models. Everything we serve is

@@ -154,3 +154,18 @@ class FakeLLM:
 
     def models(self) -> list[str]:
         return [self.name]
+
+    def embed(self, texts: list[str]) -> tuple[list[list[float]], int]:
+        """Deterministic unit vectors from a content hash — the /api/embed
+        contract without a model, mirroring FakeLLM's role for /api/generate.
+        Equal texts embed equal; different texts (almost surely) differ."""
+        import hashlib
+        import math
+
+        out = []
+        for t in texts:
+            h = hashlib.sha256(t.encode()).digest()
+            raw = [(b - 127.5) / 127.5 for b in (h * 2)]     # 64 dims
+            norm = math.sqrt(sum(x * x for x in raw)) or 1.0
+            out.append([x / norm for x in raw])
+        return out, sum(len(t.split()) for t in texts)

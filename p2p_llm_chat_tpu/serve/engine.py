@@ -25,7 +25,10 @@ Env surface (reference-style env-first config, utils/env.py):
 ``SERVE_KV`` (dense|paged), ``SERVE_PAGE_SIZE``, ``SERVE_PAGES``,
 ``SERVE_ADMIT_CHUNK``, ``SERVE_QUEUE_TIMEOUT`` (seconds, 0 disables),
 ``SERVE_QUANT`` (int8 = weight-only quantization, models/quant.py),
-``SERVE_SPEC`` (K>0 = speculative decoding with prompt-lookup drafts).
+``SERVE_SPEC`` (K>0 = speculative decoding with prompt-lookup drafts),
+``SERVE_PREFIX`` (shared-prefix KV caching, serve/prefix.py; default on),
+``SERVE_PREFIX_TEXTS`` (extra templates to pre-register, ``||``-separated;
+the reference co-pilot template is always registered).
 """
 
 from __future__ import annotations
@@ -39,12 +42,19 @@ from ..models.configs import get_config
 from ..models import family_for
 from ..models.weights import load_checkpoint
 from ..tokenizer import ByteTokenizer, load_tokenizer
-from ..utils.env import env_int, env_or
+from ..utils.env import env_bool, env_int, env_or
 from ..utils.log import get_logger
 from .backend import Backend, GenerateRequest, RequestStats
 from .scheduler import BatchScheduler
 
 log = get_logger("serve.engine")
+
+# The head of the reference co-pilot's fixed prompt template
+# (web/streamlit_app.py:93, reproduced byte-identically in ui.py
+# SUGGEST_TEMPLATE) — every suggestion request starts with these bytes,
+# so its KV is registered in the prefix cache up front.
+SUGGEST_PREFIX = ("You are a helpful assistant. Draft a concise, friendly "
+                  "reply to the following message:\n\n")
 
 
 class TPUEngine:
@@ -57,9 +67,14 @@ class TPUEngine:
                  num_pages: Optional[int] = None,
                  admit_chunk: Optional[int] = None,
                  queue_timeout_s: Optional[float] = 60.0,
-                 spec_k: int = 0) -> None:
+                 spec_k: int = 0,
+                 prefix_cache: bool = True,
+                 prefix_texts: tuple[str, ...] = (SUGGEST_PREFIX,)) -> None:
         self.name = name or config.name
         self.config = config
+        self.prefix_texts = tuple(prefix_texts) if prefix_cache else ()
+        self._embed_j = None
+        self._embed_lock = threading.Lock()
         self.scheduler = BatchScheduler(params, config, tokenizer,
                                         num_slots=num_slots, max_seq=max_seq,
                                         mesh=mesh, kv_mode=kv_mode,
@@ -67,21 +82,66 @@ class TPUEngine:
                                         num_pages=num_pages,
                                         admit_chunk=admit_chunk,
                                         queue_timeout_s=queue_timeout_s,
-                                        spec_k=spec_k)
+                                        spec_k=spec_k,
+                                        prefix_cache=prefix_cache)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
         return self.scheduler.submit(req, stats)
+
+    def embed(self, texts: list[str]) -> tuple[list[list[float]], int]:
+        """Sequence embeddings for Ollama's /api/embed[dings]: length-
+        masked mean pool of final-norm hidden states, unit-normalized
+        (models/llama.embed_pooled; the MoE family routes through its own
+        expert MLP). Returns (vectors, total prompt tokens).
+
+        Runs outside the scheduler loop on purpose: it reads only the
+        (immutable) params — none of the scheduler-owned KV/sampling
+        state — so it cannot race the decode loop; the lock bounds
+        concurrent embed dispatches to one. Shapes are bucketed
+        (power-of-two rows and length) so repeat calls hit the jit cache."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        sched = self.scheduler
+        model = sched._model
+        ids = [sched.tokenizer.encode(t, add_bos=True)[: sched.max_seq]
+               for t in texts]
+        n_tokens = sum(len(i) for i in ids)
+        out: list[list[float]] = []
+        from .scheduler import _bucket
+        with self._embed_lock:
+            if self._embed_j is None:     # under the lock: one wrapper,
+                import functools          # one compile cache
+
+                self._embed_j = jax.jit(functools.partial(
+                    model.embed_pooled, config=self.config, mesh=sched.mesh))
+            for start in range(0, len(ids), 16):    # bounded batch rows
+                chunk = ids[start: start + 16]
+                R = max(2, 1 << (len(chunk) - 1).bit_length())
+                S = _bucket(max(len(i) for i in chunk), sched.max_seq)
+                toks = np.zeros((R, S), np.int32)
+                lens = np.ones((R,), np.int32)
+                for r, seq in enumerate(chunk):
+                    toks[r, : len(seq)] = seq
+                    lens[r] = max(1, len(seq))
+                vecs = np.asarray(self._embed_j(
+                    sched._params, tokens=jnp.asarray(toks),
+                    lens=jnp.asarray(lens)))
+                out.extend(vecs[r].tolist() for r in range(len(chunk)))
+        return out, n_tokens
 
     def warmup(self, buckets: tuple[int, ...] = (128, 256),
                background: bool = False) -> None:
         """Compile the serving programs (admit per chunk-size x prompt
         bucket, decode per attention window) before real traffic arrives —
         first-compile on TPU is tens of seconds, which would otherwise land
-        on the first users' TTFT."""
+        on the first users' TTFT. Also registers the known prompt-template
+        prefixes so their KV and admission programs are ready."""
         def _run() -> None:
             try:
-                self.scheduler.warmup(prompt_buckets=buckets)
+                self.scheduler.warmup(prompt_buckets=buckets,
+                                      prefix_texts=self.prefix_texts)
             except Exception:   # noqa: BLE001 — warmup is best-effort
                 log.exception("warmup failed")
 
@@ -118,6 +178,9 @@ def build_engine_from_env() -> Backend:
     qt = float(env_or("SERVE_QUEUE_TIMEOUT", "60"))
     queue_timeout_s = qt if qt > 0 else None
     spec_k = env_int("SERVE_SPEC", 0)
+    prefix_cache = env_bool("SERVE_PREFIX", True)
+    prefix_texts = (SUGGEST_PREFIX,) + tuple(
+        t for t in env_or("SERVE_PREFIX_TEXTS", "").split("||") if t)
     # SERVE_PROFILE_PORT=N starts jax.profiler's collection server:
     # attach TensorBoard/xprof to capture live device traces of the
     # serving loop (SURVEY.md §5 tracing plan; BENCH_PROFILE covers the
@@ -168,6 +231,7 @@ def build_engine_from_env() -> Backend:
                        page_size=page_size, num_pages=num_pages,
                        admit_chunk=admit_chunk,
                        queue_timeout_s=queue_timeout_s, spec_k=spec_k,
+                       prefix_cache=prefix_cache, prefix_texts=prefix_texts,
                        name=env_or("LLM_MODEL", config.name))
     warmup = env_or("SERVE_WARMUP", "128,256")
     if warmup and warmup != "0":

@@ -1,0 +1,153 @@
+"""Shared-prefix KV cache for admission (vLLM-style prefix caching).
+
+The reference's co-pilot wraps every suggestion in one fixed template
+(web/streamlit_app.py:93) — every request the north-star workload serves
+begins with the same token prefix. Chat requests with history share even
+longer prefixes (all turns but the last). Recomputing that prefix's KV on
+every admission is pure waste: this module prefills a prefix ONCE, keeps
+its per-layer K/V on device, and admission then prefills only each
+request's suffix, attending over the cached prefix (a continuation
+forward at position offset P — the same masking shape the speculative
+verify path uses).
+
+Host-side policy lives here; the device-side admission programs live in
+serve/scheduler.py (`_admit_batch_prefix[_paged]`). Two ways an entry is
+born:
+
+- **registered**: the serve front knows its template(s) up front
+  (SERVE_PREFIX_TEXTS; the co-pilot template is registered by default) —
+  built during warmup, so the programs compile before traffic.
+- **promoted**: `observe()` counts repeated prompt heads at power-of-two
+  grain; a head seen ``promote_after`` times is promoted and its KV built
+  on the spot (one prefill dispatch; on TPU the first promotion of a new
+  (P, S) shape pays a compile, which is logged).
+
+Prefix lengths are snapped DOWN to the grain ladder so the compiled
+admission-program shapes stay bounded: P in {64, 128, 256, 512} and the
+suffix reuses the existing prompt-bucket ladder.
+
+Correctness: the cached K/V is produced by the same prefill math on the
+same weights, so a prefix-cached admission is oracle-equal to the full
+prefill (pinned by tests/test_prefix.py against the uncached scheduler).
+Entries are only read between admission dispatches on the scheduler
+thread; `register` may run on the warmup thread, hence the lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+DEFAULT_GRAIN_LADDER = (64, 128, 256, 512)
+
+
+@dataclass
+class PrefixEntry:
+    """One cached prefix: ``ids`` (exactly P tokens, a ladder length) and
+    its prefilled K/V, shaped [L, P, Hkv, D] on device."""
+
+    ids: tuple[int, ...]
+    k: object                    # jax.Array [L, P, Hkv, D]
+    v: object                    # jax.Array [L, P, Hkv, D]
+    hits: int = 0
+    last_used: float = field(default_factory=time.monotonic)
+
+    @property
+    def length(self) -> int:
+        return len(self.ids)
+
+
+class PrefixStore:
+    """Keyed by the exact token tuple; `match` finds the longest cached
+    prefix of a prompt, `observe` drives auto-promotion."""
+
+    def __init__(self, grain_ladder: tuple[int, ...] = DEFAULT_GRAIN_LADDER,
+                 max_entries: int = 8, promote_after: int = 2,
+                 max_tracked: int = 256) -> None:
+        self.grain_ladder = tuple(sorted(grain_ladder))
+        self.max_entries = max_entries
+        self.promote_after = promote_after
+        self.max_tracked = max_tracked
+        self._lock = threading.Lock()
+        self._entries: dict[tuple[int, ...], PrefixEntry] = {}
+        # head tuple -> times seen (insertion-ordered; trimmed FIFO).
+        self._seen: dict[tuple[int, ...], int] = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def hits(self) -> int:
+        with self._lock:
+            return sum(e.hits for e in self._entries.values())
+
+    def snap(self, n: int) -> int:
+        """Largest ladder length <= n (0 when n is below the ladder)."""
+        best = 0
+        for g in self.grain_ladder:
+            if g <= n:
+                best = g
+        return best
+
+    def match(self, ids: list[int]) -> Optional[PrefixEntry]:
+        """Longest entry that is a proper prefix of ``ids`` (at least one
+        suffix token must remain to prefill — its logits seed sampling)."""
+        with self._lock:
+            best: Optional[PrefixEntry] = None
+            for key, entry in self._entries.items():
+                P = len(key)
+                if P < len(ids) and tuple(ids[:P]) == key:
+                    if best is None or P > best.length:
+                        best = entry
+            if best is not None:
+                best.hits += 1
+                best.last_used = time.monotonic()
+            return best
+
+    def observe(self, ids: list[int]) -> Optional[tuple[int, ...]]:
+        """Count this prompt's heads at every ladder grain; return a head
+        that just crossed ``promote_after`` sightings (longest first) and
+        should be promoted to a cached entry, else None. The caller builds
+        the KV and calls :meth:`put`."""
+        candidate: Optional[tuple[int, ...]] = None
+        with self._lock:
+            for g in self.grain_ladder:
+                if g >= len(ids):       # need >= 1 suffix token
+                    break
+                head = tuple(ids[:g])
+                if head in self._entries:
+                    continue
+                n = self._seen.get(head, 0) + 1
+                self._seen[head] = n
+                if n >= self.promote_after:
+                    candidate = head    # longest qualifying grain wins
+            while len(self._seen) > self.max_tracked:
+                self._seen.pop(next(iter(self._seen)))
+            if candidate is not None:
+                del self._seen[candidate]
+        return candidate
+
+    def put(self, entry: PrefixEntry) -> None:
+        """Insert (idempotent), evicting the least-recently-used entry
+        past ``max_entries``. Safe between admission dispatches: evicted
+        device arrays are freed by refcount after their last use."""
+        if entry.length not in self.grain_ladder:
+            raise ValueError(
+                f"prefix length {entry.length} not on the grain ladder "
+                f"{self.grain_ladder}")
+        with self._lock:
+            self._entries[entry.ids] = entry
+            while len(self._entries) > self.max_entries:
+                lru = min(self._entries.values(), key=lambda e: e.last_used)
+                del self._entries[lru.ids]
+
+    def lengths(self) -> list[int]:
+        """Distinct cached prefix lengths (for warmup compilation)."""
+        with self._lock:
+            return sorted({len(k) for k in self._entries})
+
+    def snapshot(self) -> list[PrefixEntry]:
+        with self._lock:
+            return list(self._entries.values())

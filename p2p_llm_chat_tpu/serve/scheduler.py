@@ -54,11 +54,13 @@ import numpy as np
 
 from ..models import family_for
 from ..models.configs import ModelConfig
+from ..models.layers import causal_mask
 from ..models.llama import KVCache
 from ..models.sampling import sample_batched
 from ..tokenizer import Tokenizer
 from ..utils.log import get_logger
 from .backend import GenerateRequest, RequestStats
+from .prefix import PrefixEntry, PrefixStore
 
 log = get_logger("serve.scheduler")
 
@@ -102,6 +104,8 @@ class _Slot:
     cancelled: threading.Event = field(default_factory=threading.Event)
     error: Optional[str] = None                        # surfaced by submit()
     drafter: Optional[object] = None                   # spec-decode NGramDrafter
+    prefix: Optional[PrefixEntry] = None               # cached-prefix admission
+    prefix_checked: bool = False                       # match() ran for this slot
 
     def push(self, delta: str) -> None:
         if delta:
@@ -134,7 +138,9 @@ class BatchScheduler:
                  num_pages: Optional[int] = None,
                  admit_chunk: Optional[int] = None,
                  queue_timeout_s: Optional[float] = 60.0,
-                 spec_k: int = 0) -> None:
+                 spec_k: int = 0,
+                 prefix_cache: bool = False,
+                 prefix_promote_after: int = 2) -> None:
         """``admit_chunk``: burst-admission width. None (default) admits a
         backlog burst through one full-width prefill (minimal dispatches —
         best p95/throughput); a fixed power-of-two (e.g. 8) staggers the
@@ -151,7 +157,15 @@ class BatchScheduler:
         ``spec_k``: speculative decoding (prompt-lookup drafting,
         utils/draft.py): each tick verifies up to K drafted tokens per
         row in one forward (models/llama.verify_step[_paged] + exact
-        acceptance sampling), so ticks emit 1..K+1 tokens. 0 disables."""
+        acceptance sampling), so ticks emit 1..K+1 tokens. 0 disables.
+
+        ``prefix_cache``: shared-prefix KV caching (serve/prefix.py).
+        Prompts that begin with a cached prefix (the co-pilot template,
+        a chat history head) prefill only their suffix, attending over
+        the prefix KV computed once — admission compute drops from
+        O(full prompt) to O(suffix). Register known templates via
+        :meth:`register_prefix` / warmup ``prefix_texts``; repeated
+        heads auto-promote after ``prefix_promote_after`` sightings."""
         if kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {kv_mode!r}")
         if admit_chunk is not None and admit_chunk < 1:
@@ -198,6 +212,23 @@ class BatchScheduler:
         self._n_decode_ticks = 0
         self._n_expired = 0
         self._n_spec_accepted = 0     # draft tokens accepted by verify
+        # Shared-prefix KV cache (serve/prefix.py): prompt-head matches
+        # skip recomputing the prefix at admission. Ladder grains that
+        # could never pass the admission budget guard (P + smallest
+        # suffix bucket > max_seq) are excluded up front — otherwise
+        # snap/observe would build entries (HBM + an LRU slot each) that
+        # every match rejects.
+        if prefix_cache:
+            from .prefix import DEFAULT_GRAIN_LADDER
+            ladder = tuple(g for g in DEFAULT_GRAIN_LADDER
+                           if g + _MIN_BUCKET <= self.max_seq)
+            self._prefix = (PrefixStore(grain_ladder=ladder,
+                                        promote_after=prefix_promote_after)
+                            if ladder else None)
+        else:
+            self._prefix = None
+        self._n_prefix_admits = 0     # requests admitted via a cached prefix
+        self._n_prefix_tokens = 0     # prompt tokens NOT recomputed
         # Adaptive speculation: EMA of accepted drafts per spec tick.
         # The verify forward computes K+1 positions for every row, so
         # when drafts stop landing (non-repetitive output), paying it
@@ -380,10 +411,92 @@ class BatchScheduler:
             return (toks, cache, keys, next_tokens, temps, top_ks, top_ps,
                     ring, rps)
 
+        def _prefill_first_token_prefix(params, pk, pv, tokens, ints, floats,
+                                        rings):
+            """Continuation-prefill admission prologue for prefix-cached
+            prompts: the cached prefix KV ([L,P,Hkv,D], computed once by
+            register_prefix) is broadcast into every chunk row's small
+            cache, then ONLY the suffix tokens run the forward — at
+            positions P..P+S with a P-offset causal mask (the same
+            continuation shape the speculative verify path uses), so
+            admission compute scales with the suffix, not the prompt.
+
+            ``ints`` gains a 5th row vs the plain prologue: [0]=suffix
+            lens, [4]=total lens (prefix + suffix — the context length
+            installed in the big cache and the penalty-ring position of
+            the first sampled token)."""
+            R, S = tokens.shape
+            P = pk.shape[1]
+            suf_lens, seeds, total_lens = ints[0], ints[2], ints[4]
+            small = KVCache.create(config, R, P + S, dtype=self._dtype)
+            k0 = jnp.broadcast_to(pk[:, None], (pk.shape[0], R) + pk.shape[1:])
+            v0 = jnp.broadcast_to(pv[:, None], (pv.shape[0], R) + pv.shape[1:])
+            small = small._replace(k=small.k.at[:, :, :P].set(k0),
+                                   v=small.v.at[:, :, :P].set(v0))
+            positions = jnp.broadcast_to(P + jnp.arange(S)[None, :], (R, S))
+            mask = causal_mask(S, P + S, P)
+            logits, small = model.forward(params, config, tokens, positions,
+                                          small, mask, mesh)
+            last = jnp.take_along_axis(
+                logits, (suf_lens - 1)[:, None, None], axis=1)[:, 0, :]
+            row_keys = jax.vmap(jax.random.PRNGKey)(seeds)
+            toks, row_keys = sample_batched(last, row_keys, floats[0],
+                                            ints[3], floats[1],
+                                            ring=rings, rp=floats[2])
+            rings = rings.at[jnp.arange(R), total_lens % _RING].set(toks)
+            return small, toks, row_keys, rings
+
+        def _admit_batch_prefix(params, pk, pv, tokens, ints, floats, rings,
+                                cache, keys, next_tokens, temps, top_ks,
+                                top_ps, ring, rps):
+            """_admit_batch for a chunk sharing one cached prefix: splice
+            [prefix KV + suffix KV] (the small cache, P+S wide) into the
+            big cache and install lengths = total (prefix + suffix)."""
+            S = tokens.shape[1]
+            P = pk.shape[1]
+            rows, total_lens = ints[1], ints[4]
+            small, toks, row_keys, rings = _prefill_first_token_prefix(
+                params, pk, pv, tokens, ints, floats, rings)
+            k = cache.k.at[:, rows, : P + S].set(small.k, mode="drop")
+            v = cache.v.at[:, rows, : P + S].set(small.v, mode="drop")
+            lengths = cache.lengths.at[rows].set(
+                total_lens.astype(cache.lengths.dtype), mode="drop")
+            cache = KVCache(k, v, lengths)
+            (keys, next_tokens, temps, top_ks, top_ps, ring,
+             rps) = _install_rows(rows, row_keys, toks, ints, floats, rings,
+                                  keys, next_tokens, temps, top_ks, top_ps,
+                                  ring, rps)
+            return (toks, cache, keys, next_tokens, temps, top_ks, top_ps,
+                    ring, rps)
+
+        def _admit_batch_paged_prefix(params, pk, pv, tokens, ints, floats,
+                                      rings, tables, cache, keys,
+                                      next_tokens, temps, top_ks, top_ps,
+                                      ring, rps):
+            """Paged-mode prefix admission: the combined [prefix + suffix]
+            KV splices into each row's own pages through the one-scatter
+            batch path (copy-based sharing — rows own their prefix copy,
+            so release/containment invariants are untouched)."""
+            rows, total_lens = ints[1], ints[4]
+            small, toks, row_keys, rings = _prefill_first_token_prefix(
+                params, pk, pv, tokens, ints, floats, rings)
+            from ..ops.paged_kv import write_prefill_batch
+            cache = write_prefill_batch(cache, small.k, small.v, rows,
+                                        total_lens, tables)
+            (keys, next_tokens, temps, top_ks, top_ps, ring,
+             rps) = _install_rows(rows, row_keys, toks, ints, floats, rings,
+                                  keys, next_tokens, temps, top_ks, top_ps,
+                                  ring, rps)
+            return (toks, cache, keys, next_tokens, temps, top_ks, top_ps,
+                    ring, rps)
+
         if self.kv_mode == "paged":
             self._admit_j = jax.jit(_admit_batch_paged,
                                     donate_argnums=(6, 7, 8, 9, 10, 11, 12,
                                                     13))
+            self._admit_prefix_j = jax.jit(
+                _admit_batch_paged_prefix,
+                donate_argnums=(8, 9, 10, 11, 12, 13, 14, 15))
             from ..ops.paged_kv import set_row_table
 
             def _zero_row(cache, row):
@@ -399,10 +512,49 @@ class BatchScheduler:
             self._admit_j = jax.jit(_admit_batch,
                                     donate_argnums=(5, 6, 7, 8, 9, 10, 11,
                                                     12))
+            self._admit_prefix_j = jax.jit(
+                _admit_batch_prefix,
+                donate_argnums=(7, 8, 9, 10, 11, 12, 13, 14))
+
+        def _build_prefix(params, toks):
+            """Prefill one prefix ([1,P]) and strip the batch axis —
+            the register_prefix / promotion builder."""
+            P = toks.shape[1]
+            cache = KVCache.create(config, 1, P, dtype=self._dtype)
+            _, cache = model.prefill(params, config, toks,
+                                     jnp.full((1,), P, jnp.int32), cache,
+                                     mesh)
+            return cache.k[:, 0], cache.v[:, 0]
+
+        self._build_prefix_j = jax.jit(_build_prefix)
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="batch-scheduler")
         self._thread.start()
+
+    # -- shared-prefix KV cache ----------------------------------------------
+
+    def register_prefix(self, text: str) -> int:
+        """Cache the KV of ``text``'s token head (snapped DOWN to the
+        grain ladder so compiled admission shapes stay bounded). Returns
+        the cached prefix length in tokens (0 = too short to cache).
+        Called from warmup (before traffic) or the scheduler thread
+        (promotion); the store itself is thread-safe."""
+        if self._prefix is None:
+            return 0
+        ids = self.tokenizer.encode(text, add_bos=True)
+        P = self._prefix.snap(len(ids))
+        if P <= 0:
+            return 0
+        return self._register_prefix_ids(ids[:P])
+
+    def _register_prefix_ids(self, ids: list[int]) -> int:
+        k, v = self._build_prefix_j(
+            self._params, jnp.asarray(np.asarray(ids, np.int32)[None, :]))
+        self._prefix.put(PrefixEntry(ids=tuple(ids), k=k, v=v))
+        log.info("cached prefix KV: %d tokens (%d entr%s)", len(ids),
+                 len(self._prefix), "y" if len(self._prefix) == 1 else "ies")
+        return len(ids)
 
     def _decode_for(self, window: int):
         """Jitted decode program for a static attention-read window
@@ -432,7 +584,8 @@ class BatchScheduler:
 
     def warmup(self, prompt_buckets: tuple[int, ...] = (128, 256),
                chunk_sizes: Optional[tuple[int, ...]] = None,
-               windows: Optional[tuple[int, ...]] = None) -> None:
+               windows: Optional[tuple[int, ...]] = None,
+               prefix_texts: tuple[str, ...] = ()) -> None:
         """Pre-compile the serving programs on synthetic throwaway buffers
         (first compile is tens of seconds on TPU — it must not land on real
         requests' TTFT). Compiles one admit program per (chunk size, prompt
@@ -469,26 +622,48 @@ class BatchScheduler:
                     dtype=self._dtype)
             return KVCache.create(self.config, B, self.max_seq, self._dtype)
 
+        def admit_args(R: int, S: int, cache, prefix=None) -> list:
+            """Synthetic-arg list matching the admission program signature
+            — ONE place to mirror signature changes (the prefix variant
+            prepends the entry's KV and widens ints to 5 rows)."""
+            args = [self._params]
+            if prefix is not None:
+                args += [prefix.k, prefix.v]
+            args += [jnp.zeros((R, S), jnp.int32),
+                     jnp.ones((5 if prefix is not None else 4, R), jnp.int32),
+                     jnp.ones((3, R), jnp.float32),
+                     jnp.full((R, _RING), self.config.vocab_size, jnp.int32)]
+            if self.kv_mode == "paged":
+                args.append(jnp.zeros((R, cache.max_pages_per_row),
+                                      jnp.int32))
+            args += [cache, jnp.zeros((B, 2), jnp.uint32),
+                     jnp.zeros((B, 1), jnp.int32),
+                     jnp.zeros((B,), jnp.float32),
+                     jnp.zeros((B,), jnp.int32),
+                     jnp.ones((B,), jnp.float32),
+                     jnp.full((B, _RING), self.config.vocab_size, jnp.int32),
+                     jnp.ones((B,), jnp.float32)]
+            return args
+
         for R in chunk_sizes:
             for S in buckets:
-                cache = throwaway_cache()
-                ints = np.ones((4, R), np.int32)
-                args = [self._params, jnp.zeros((R, S), jnp.int32),
-                        jnp.asarray(ints), jnp.ones((3, R), jnp.float32),
-                        jnp.full((R, _RING), self.config.vocab_size,
-                                 jnp.int32)]
-                if self.kv_mode == "paged":
-                    args.append(jnp.zeros(
-                        (R, cache.max_pages_per_row), jnp.int32))
-                args += [cache, jnp.zeros((B, 2), jnp.uint32),
-                         jnp.zeros((B, 1), jnp.int32),
-                         jnp.zeros((B,), jnp.float32),
-                         jnp.zeros((B,), jnp.int32),
-                         jnp.ones((B,), jnp.float32),
-                         jnp.full((B, _RING), self.config.vocab_size,
-                                  jnp.int32),
-                         jnp.ones((B,), jnp.float32)]
-                self._admit_j(*args)
+                self._admit_j(*admit_args(R, S, throwaway_cache()))
+        # Shared-prefix programs: register the known templates (builds
+        # their KV — one prefill compile per distinct P), then compile the
+        # prefix-admission program for every (chunk, suffix bucket, P)
+        # combination so a template hit never compiles mid-serving.
+        for text in prefix_texts:
+            self.register_prefix(text)
+        if self._prefix is not None:
+            by_len: dict[int, PrefixEntry] = {
+                e.length: e for e in self._prefix.snapshot()}
+            for P, entry in sorted(by_len.items()):
+                for R in chunk_sizes:
+                    for S in buckets:
+                        if P + S > self.max_seq:
+                            continue
+                        self._admit_prefix_j(*admit_args(
+                            R, S, throwaway_cache(), prefix=entry))
         toks = None
         for w in windows:
             cache = throwaway_cache()
@@ -624,11 +799,15 @@ class BatchScheduler:
         ids — and flush the pipeline first."""
         pending: Optional[tuple] = None      # (toks_dev, slots snapshot)
         while not self._closed.is_set():
-            self._admit_pending(block=not self._any_active()
-                                and pending is None)
-            if self._closed.is_set():
-                return
             try:
+                # Admission inside the same recovery envelope as decode: an
+                # unexpected admission-path error must fail requests and
+                # reset, never kill the scheduler thread (which would leave
+                # every future submit() hanging on a dead queue).
+                self._admit_pending(block=not self._any_active()
+                                    and pending is None)
+                if self._closed.is_set():
+                    return
                 if not self._any_active():
                     if pending is not None:
                         self._process_tick(*pending)
@@ -691,21 +870,35 @@ class BatchScheduler:
             # Context budget: keep the prompt tail (recent context wins, the
             # same truncation direction Ollama applies), leave room to
             # generate. Ollama num_ctx caps a request below the server max.
-            limit = self.max_seq
+            # (NB: must not shadow ``limit`` — doing so once made a >limit
+            # burst over-collect past the free rows and crash admission.)
+            ctx_limit = self.max_seq
             if opts.num_ctx > 0:
-                limit = max(_MIN_BUCKET, min(limit, opts.num_ctx))
-            max_prompt = limit - 2
+                ctx_limit = max(_MIN_BUCKET, min(ctx_limit, opts.num_ctx))
+            max_prompt = ctx_limit - 2
             if len(ids) > max_prompt:
                 ids = ids[-max_prompt:]
-            budget = limit - 1 - len(ids)
+            budget = ctx_limit - 1 - len(ids)
             # Ollama semantics: num_predict <= 0 means "until EOS / context
             # full", not "almost nothing".
             want = opts.max_tokens if opts.max_tokens > 0 else budget
             slot.max_new = max(1, min(want, budget))
             slot.prompt_ids = ids
-            slot.ctx_budget = limit
+            slot.ctx_budget = ctx_limit
             if slot.stats is not None:
                 slot.stats.prompt_tokens = len(ids)
+            if self._prefix is not None:
+                # Auto-promotion: a prompt head seen promote_after times
+                # becomes a cached prefix. Building it costs one prefill
+                # dispatch now (plus, on TPU, a one-off compile for a new
+                # (P, suffix-bucket) admission shape — register templates
+                # up front via warmup prefix_texts to avoid that).
+                head = self._prefix.observe(ids)
+                if head is not None:
+                    try:
+                        self._register_prefix_ids(list(head))
+                    except Exception:   # noqa: BLE001 — cache is optional
+                        log.exception("prefix promotion failed")
             out.append(slot)
         return out
 
@@ -756,6 +949,10 @@ class BatchScheduler:
         if self.spec_k:
             out["serve_spec_accepted_total"] = self._n_spec_accepted
             out["serve_spec_accept_ema"] = round(self._spec_ema, 4)
+        if self._prefix is not None:
+            out["serve_prefix_entries"] = len(self._prefix)
+            out["serve_prefix_admits_total"] = self._n_prefix_admits
+            out["serve_prefix_tokens_saved_total"] = self._n_prefix_tokens
         if self.kv_mode == "paged":
             out["serve_kv_free_pages"] = self._alloc.free_pages
             out["serve_kv_total_pages"] = self.num_pages - 1
@@ -847,12 +1044,29 @@ class BatchScheduler:
                 pending.extend(fresh)
         if not pending:
             return
-        by_bucket: dict[int, list[_Slot]] = {}
+        # Group by (cached prefix, prompt bucket): a chunk's rows must
+        # share one prefill program — and, with prefix caching, one prefix
+        # entry (its KV is one broadcast operand). The bucket covers only
+        # the suffix for prefix-matched slots.
+        by_bucket: dict[tuple, list[_Slot]] = {}
         for s in pending:
-            by_bucket.setdefault(self._serving_bucket(len(s.prompt_ids)),
-                                 []).append(s)
+            if self._prefix is not None and not s.prefix_checked:
+                s.prefix = self._prefix.match(s.prompt_ids)
+                s.prefix_checked = True
+                if s.prefix is not None:
+                    # The spliced admission cache is P + suffix-bucket
+                    # wide; a near-max_seq prompt whose suffix bucket
+                    # rounds past the budget must take the plain path.
+                    sb = self._serving_bucket(
+                        len(s.prompt_ids) - s.prefix.length)
+                    if s.prefix.length + sb > self.max_seq:
+                        s.prefix = None
+            plen = s.prefix.length if s.prefix is not None else 0
+            key = (s.prefix.ids if s.prefix is not None else (),
+                   self._serving_bucket(len(s.prompt_ids) - plen))
+            by_bucket.setdefault(key, []).append(s)
         groups = sorted(by_bucket.items())
-        for gi, (S, group) in enumerate(groups):
+        for gi, ((_, S), group) in enumerate(groups):
             while group:
                 # A backlog burst is admitted through the full-width program
                 # (one prefill for up to num_slots requests) instead of
@@ -906,30 +1120,75 @@ class BatchScheduler:
         chunks are padded with dummy entries whose row index is the
         out-of-range sentinel ``num_slots`` — every install of theirs is
         scatter-dropped — so only two programs per prompt bucket are ever
-        compiled."""
+        compiled.
+
+        A prefix-cached chunk (every slot carries the same
+        ``slot.prefix``; _admit_pending groups by entry) uploads only the
+        suffix tokens: S is the *suffix* bucket, ``ints`` grows a 5th row
+        with total (prefix+suffix) lengths, and the prefix-variant
+        program broadcasts the cached KV instead of recomputing it."""
+        prefix = chunk[0].prefix
+        P = prefix.length if prefix is not None else 0
         pad = R - len(chunk)
         tokens = np.zeros((R, S), np.int32)
-        ints = np.zeros((4, R), np.int32)           # lens/rows/seeds/top_k
+        # lens/rows/seeds/top_k (+ total lens for prefix chunks)
+        ints = np.zeros((5 if prefix is not None else 4, R), np.int32)
         floats = np.zeros((3, R), np.float32)       # temp/top_p/repeat_pen
         rings = np.full((R, _RING), self.config.vocab_size, np.int32)
         ints[0] = 1                                 # padding: 1-token prompt
         ints[1] = self.num_slots                    # padding: dropped rows
+        if prefix is not None:
+            ints[4] = P + 1
         floats[1] = 1.0
         floats[2] = 1.0
         for i, (slot, row) in enumerate(zip(chunk, rows)):
             r = pad + i
-            tokens[r, : len(slot.prompt_ids)] = slot.prompt_ids
+            suffix = slot.prompt_ids[P:]
+            tokens[r, : len(suffix)] = suffix
             o = slot.req.options
-            ints[:, r] = (len(slot.prompt_ids), row, slot.seed, o.top_k)
+            ints[:4, r] = (len(suffix), row, slot.seed, o.top_k)
+            if prefix is not None:
+                ints[4, r] = len(slot.prompt_ids)
             floats[:, r] = (o.temperature, o.top_p, o.repeat_penalty)
             # Penalty window: prompt tokens at their context position mod
             # _RING (later positions overwrite earlier — last-64 window).
+            # Prefix-cached rows still seed from the FULL prompt: the ring
+            # is host-built state, independent of which KV was recomputed.
             if o.repeat_penalty != 1.0:
                 start = max(0, len(slot.prompt_ids) - _RING)
                 for p_i in range(start, len(slot.prompt_ids)):
                     rings[r, p_i % _RING] = slot.prompt_ids[p_i]
 
-        if self.kv_mode == "paged":
+        if prefix is not None:
+            self._n_prefix_admits += len(chunk)
+            self._n_prefix_tokens += P * len(chunk)
+            if self.kv_mode == "paged":
+                tables = np.zeros((R, self._cache.max_pages_per_row),
+                                  np.int32)
+                for i, slot in enumerate(chunk):
+                    tables[pad + i, : len(slot.pages)] = slot.pages
+                (toks_dev, self._cache, self._keys, self._next_dev,
+                 self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                 self._ring_dev, self._rps_dev) = \
+                    self._admit_prefix_j(
+                        self._params, prefix.k, prefix.v,
+                        jnp.asarray(tokens), jnp.asarray(ints),
+                        jnp.asarray(floats), jnp.asarray(rings),
+                        jnp.asarray(tables), self._cache, self._keys,
+                        self._next_dev, self._temps_dev, self._top_ks_dev,
+                        self._top_ps_dev, self._ring_dev, self._rps_dev)
+            else:
+                (toks_dev, self._cache, self._keys, self._next_dev,
+                 self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                 self._ring_dev, self._rps_dev) = \
+                    self._admit_prefix_j(
+                        self._params, prefix.k, prefix.v,
+                        jnp.asarray(tokens), jnp.asarray(ints),
+                        jnp.asarray(floats), jnp.asarray(rings),
+                        self._cache, self._keys, self._next_dev,
+                        self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                        self._ring_dev, self._rps_dev)
+        elif self.kv_mode == "paged":
             # Padding entries keep an all-zero table: their prefill writes
             # land in garbage page 0 (their table/length installs are
             # dropped via the row sentinel).

@@ -1,0 +1,82 @@
+"""Engine-side embedding tests (models/llama.embed_pooled behind
+/api/embed — the in-tree replacement for Ollama's embedding capability).
+
+Key property: padding/batching invariance — a text's vector must not
+depend on which other texts share its batch (length masking before the
+pool), and must be a unit vector.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_llm_chat_tpu.models import llama, mixtral
+from p2p_llm_chat_tpu.models.configs import get_config
+from p2p_llm_chat_tpu.serve.engine import TPUEngine
+from p2p_llm_chat_tpu.tokenizer import ByteTokenizer
+
+CFG = get_config("tiny")
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+TOK = ByteTokenizer(vocab_size=CFG.vocab_size)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128)
+    yield eng
+    eng.stop()
+
+
+def test_embed_unit_vectors_and_shape(engine):
+    vecs, n_tokens = engine.embed(["hello world", "a much longer text here"])
+    assert len(vecs) == 2
+    for v in vecs:
+        assert len(v) == CFG.hidden_size
+        assert abs(np.linalg.norm(v) - 1.0) < 1e-5
+    assert n_tokens == sum(
+        len(TOK.encode(t, add_bos=True))
+        for t in ["hello world", "a much longer text here"])
+
+
+def test_embed_batch_invariance(engine):
+    """The same text embeds identically alone, batched with short
+    neighbours, and batched with long neighbours (mask correctness)."""
+    solo, _ = engine.embed(["the quick brown fox"])
+    with_short, _ = engine.embed(["the quick brown fox", "x"])
+    with_long, _ = engine.embed(
+        ["padding buddy " * 6, "the quick brown fox"])
+    np.testing.assert_allclose(solo[0], with_short[0], atol=1e-5)
+    np.testing.assert_allclose(solo[0], with_long[1], atol=1e-5)
+
+
+def test_embed_distinguishes_texts(engine):
+    vecs, _ = engine.embed(["completely unrelated words",
+                            "totally different content"])
+    sim = float(np.dot(vecs[0], vecs[1]))
+    assert sim < 0.999
+
+
+def test_embed_matches_direct_model_call(engine):
+    ids = TOK.encode("direct call parity", add_bos=True)
+    toks = np.zeros((2, 32), np.int32)       # engine buckets to (2, 32)
+    toks[0, : len(ids)] = ids
+    want = np.asarray(llama.embed_pooled(
+        PARAMS, CFG, jnp.asarray(toks),
+        jnp.asarray([len(ids), 1], jnp.int32)))[0]
+    got, _ = engine.embed(["direct call parity"])
+    np.testing.assert_allclose(got[0], want, atol=1e-5)
+
+
+def test_moe_family_embeds():
+    mcfg = get_config("tiny-moe")
+    mparams = mixtral.init_params(mcfg, jax.random.PRNGKey(1),
+                                  dtype=jnp.float32)
+    eng = TPUEngine(mparams, mcfg, TOK, num_slots=2, max_seq=128)
+    try:
+        vecs, _ = eng.embed(["moe embedding test"])
+        assert len(vecs[0]) == mcfg.hidden_size
+        assert abs(np.linalg.norm(vecs[0]) - 1.0) < 1e-5
+    finally:
+        eng.stop()

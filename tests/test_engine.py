@@ -427,3 +427,45 @@ def test_num_ctx_caps_request_context():
         assert isinstance(text, str)
     finally:
         eng.stop()
+
+
+def test_collect_pending_respects_row_limit():
+    """Regression: _collect_pending's row limit was shadowed by the
+    context-budget variable, so a burst larger than the free rows
+    over-collected and crashed admission (free.pop from empty) — killing
+    the scheduler thread. The limit must bound the returned batch."""
+    import queue as _queue
+
+    from p2p_llm_chat_tpu.serve.scheduler import _Slot
+
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128)
+    try:
+        sched = eng.scheduler
+        # Occupy every row with live streams: with no free rows the loop's
+        # _admit_pending returns before touching the queue, so the direct
+        # _collect_pending calls below cannot race the scheduler thread.
+        holders = []
+        for name in ("hold a", "hold b"):
+            it = eng.generate_stream(
+                GenerateRequest(prompt=name,
+                                options=GenerateOptions(max_tokens=100)),
+                RequestStats())
+            next(it)                      # admitted and streaming
+            holders.append(it)
+        slots = []
+        for i in range(5):
+            s = _Slot(req=GenerateRequest(prompt=f"burst {i}",
+                                          options=GenerateOptions(max_tokens=4)),
+                      stats=None, out_q=_queue.Queue(), seed=i)
+            slots.append(s)
+            sched._admit_q.put(s)
+        got = sched._collect_pending(2, block=False)
+        assert len(got) == 2
+        got2 = sched._collect_pending(3, block=False)
+        assert len(got2) == 3
+        for s in slots:                   # never admitted for real
+            s.cancelled.set()
+        for it in holders:
+            it.close()
+    finally:
+        eng.stop()

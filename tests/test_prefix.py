@@ -1,0 +1,224 @@
+"""Shared-prefix KV cache tests (serve/prefix.py + scheduler admission).
+
+Correctness oracle: a prompt admitted through a cached prefix (suffix-only
+continuation prefill attending over KV computed once) must produce exactly
+the tokens the uncached solo prefill+decode loop produces — the prefix
+cache is a pure compute-reuse optimization, invisible in outputs.
+
+The workload this exists for is the reference co-pilot: every suggestion
+request starts with the same fixed template (web/streamlit_app.py:93).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_llm_chat_tpu.models import llama
+from p2p_llm_chat_tpu.models.configs import get_config
+from p2p_llm_chat_tpu.models.llama import KVCache
+from p2p_llm_chat_tpu.serve.backend import (GenerateOptions, GenerateRequest,
+                                            RequestStats)
+from p2p_llm_chat_tpu.serve.engine import SUGGEST_PREFIX, TPUEngine
+from p2p_llm_chat_tpu.serve.prefix import PrefixEntry, PrefixStore
+from p2p_llm_chat_tpu.tokenizer import ByteTokenizer
+
+CFG = get_config("tiny")
+PARAMS = llama.init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+TOK = ByteTokenizer(vocab_size=CFG.vocab_size)
+STOP_IDS = set(CFG.eos_token_ids) | {TOK.eos_id}
+
+
+def oracle(prompt: str, max_new: int, max_seq: int = 256) -> str:
+    """Solo batch=1 greedy loop — no prefix cache anywhere."""
+    ids = TOK.encode(prompt, add_bos=True)
+    cache = KVCache.create(CFG, 1, max_seq, jnp.float32)
+    logits, cache = llama.prefill(PARAMS, CFG, jnp.asarray([ids]),
+                                  jnp.asarray([len(ids)]), cache)
+    last = np.asarray(logits[0, len(ids) - 1])
+    out = []
+    for _ in range(max_new):
+        t = int(last.argmax())
+        if t in STOP_IDS:
+            break
+        out.append(t)
+        lg, cache = llama.decode_step(PARAMS, CFG, jnp.asarray([[t]]), cache)
+        last = np.asarray(lg[0, 0])
+    return TOK.decode(out)
+
+
+def run(engine, prompt, max_tokens=10, **opts):
+    stats = RequestStats()
+    req = GenerateRequest(prompt=prompt, options=GenerateOptions(
+        max_tokens=max_tokens, **opts))
+    return "".join(engine.generate_stream(req, stats)), stats
+
+
+# -- host-side store policy ---------------------------------------------------
+
+def _entry(ids):
+    return PrefixEntry(ids=tuple(ids), k=None, v=None)
+
+
+def test_store_snap_to_grain_ladder():
+    st = PrefixStore()
+    assert st.snap(63) == 0
+    assert st.snap(64) == 64
+    assert st.snap(200) == 128
+    assert st.snap(4096) == 512
+
+
+def test_store_match_returns_longest_proper_prefix():
+    st = PrefixStore()
+    st.put(_entry(range(64)))
+    st.put(_entry(range(128)))
+    ids = list(range(200))
+    got = st.match(ids)
+    assert got is not None and got.length == 128
+    # Prompt == the 128 entry: it can't match itself (no suffix token
+    # left), but the shorter entry still can.
+    got = st.match(list(range(128)))
+    assert got is not None and got.length == 64
+    # No entry leaves a suffix: no match.
+    assert st.match(list(range(64))) is None
+    # Diverging head: no match.
+    assert st.match([999] + list(range(199))) is None
+    assert st.hits == 2
+
+
+def test_store_observe_promotes_after_threshold():
+    st = PrefixStore(promote_after=2)
+    ids = list(range(100))
+    assert st.observe(ids) is None               # first sighting
+    head = st.observe(ids)                       # second: promote
+    assert head == tuple(range(64))              # longest qualifying grain
+    st.put(_entry(head))
+    # Cached heads are not re-proposed.
+    assert st.observe(ids) is None
+    assert st.observe(ids) is None
+
+
+def test_store_lru_eviction_bounds_entries():
+    st = PrefixStore(max_entries=2)
+    a, b, c = (_entry([i] * 64) for i in (1, 2, 3))
+    st.put(a)
+    st.put(b)
+    st.match([1] * 64 + [0])                     # refresh a
+    st.put(c)                                    # evicts b (LRU)
+    assert len(st) == 2
+    assert st.match([2] * 64 + [0]) is None
+    assert st.match([3] * 64 + [0]) is c
+
+
+# -- admission parity against the uncached oracle -----------------------------
+
+@pytest.mark.parametrize("kv", ["dense", "paged"])
+def test_registered_template_admission_matches_oracle(kv):
+    """Concurrent template-prefixed requests through a warmed prefix cache
+    must be oracle-exact, and must actually take the prefix path."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=3, max_seq=256,
+                    kv_mode=kv, page_size=16,
+                    prefix_texts=(SUGGEST_PREFIX,))
+    try:
+        eng.warmup(buckets=(64, 128))
+        store = eng.scheduler._prefix
+        assert store is not None and len(store) == 1
+        P = store.lengths()[0]
+        assert P == 64      # byte tokenizer: 90-char template snaps to 64
+
+        prompts = [SUGGEST_PREFIX + f"message {i}: see you at ten?\n\nReply:"
+                   for i in range(5)]
+        want = {p: oracle(p, 10) for p in prompts}
+        got, errs = {}, []
+
+        def worker(p):
+            try:
+                got[p] = run(eng, p)[0]
+            except Exception as e:   # noqa: BLE001
+                errs.append((p, e))
+
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs
+        assert got == want
+        m = eng.scheduler.metrics_snapshot()
+        assert m["serve_prefix_admits_total"] == len(prompts)
+        assert m["serve_prefix_tokens_saved_total"] == P * len(prompts)
+    finally:
+        eng.stop()
+
+
+def test_auto_promotion_then_prefix_admission():
+    """An unregistered head seen promote_after times is promoted; later
+    prompts with the same head admit through it, oracle-exact."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=256,
+                    prefix_texts=())
+    try:
+        head = "z y x w v u t s r q " * 5        # 100 chars -> grain 64
+        prompts = [head + tail for tail in ("alpha", "beta", "gamma")]
+        for p in prompts:                         # sequential, so counts land
+            text, _ = run(eng, p, max_tokens=8)
+            assert text == oracle(p, 8)
+        store = eng.scheduler._prefix
+        assert len(store) == 1                    # promoted on 2nd sighting
+        m = eng.scheduler.metrics_snapshot()
+        assert m["serve_prefix_admits_total"] >= 1   # 3rd went through it
+    finally:
+        eng.stop()
+
+
+def test_prefix_skipped_when_budget_would_overflow():
+    """A near-max_seq prompt whose (prefix + suffix bucket) would overrun
+    the cache must take the plain path — correct output, no prefix admit."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=160,
+                    prefix_texts=("q" * 100,))
+    try:
+        eng.warmup(buckets=(64, 128))
+        assert len(eng.scheduler._prefix) == 1
+        prompt = "q" * 100 + "r" * 40             # 141 ids; suffix 77 -> 128
+        text, _ = run(eng, prompt, max_tokens=6)
+        assert text == oracle(prompt, 6)
+        m = eng.scheduler.metrics_snapshot()
+        assert m["serve_prefix_admits_total"] == 0
+    finally:
+        eng.stop()
+
+
+def test_prefix_composes_with_speculative_decoding():
+    """Prefix admission + spec decode together stay oracle-exact (the
+    prefix only changes how admission computed the KV; verify ticks read
+    the same cache either way)."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=256,
+                    spec_k=3, prefix_texts=(SUGGEST_PREFIX,))
+    try:
+        eng.warmup(buckets=(64, 128))
+        prompts = [SUGGEST_PREFIX + "lunch tomorrow? lunch tomorrow?",
+                   SUGGEST_PREFIX + "did you get the docs I sent?"]
+        want = {p: oracle(p, 12) for p in prompts}
+        got, errs = {}, []
+
+        def worker(p):
+            try:
+                got[p] = run(eng, p, max_tokens=12)[0]
+            except Exception as e:   # noqa: BLE001
+                errs.append((p, e))
+
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errs
+        assert got == want
+        assert eng.scheduler.metrics_snapshot()[
+            "serve_prefix_admits_total"] == len(prompts)
+    finally:
+        eng.stop()

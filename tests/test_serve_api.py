@@ -137,3 +137,76 @@ def test_show_and_ps_endpoints(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(req, timeout=5)
     assert e.value.code == 404
+
+
+def test_embed_endpoint_contract(server):
+    """Ollama `POST /api/embed`: single string and list inputs, unit
+    vectors, deterministic for equal inputs."""
+    status, body = http_json("POST", f"{server.url}/api/embed", {
+        "model": "m", "input": "hello world"})
+    assert status == 200
+    assert len(body["embeddings"]) == 1
+    v = body["embeddings"][0]
+    assert len(v) > 0 and abs(sum(x * x for x in v) - 1.0) < 1e-6
+    assert body["prompt_eval_count"] > 0
+
+    status, body2 = http_json("POST", f"{server.url}/api/embed", {
+        "model": "m", "input": ["hello world", "different text"]})
+    assert status == 200
+    assert len(body2["embeddings"]) == 2
+    assert body2["embeddings"][0] == v                 # deterministic
+    assert body2["embeddings"][1] != v
+
+
+def test_embeddings_legacy_endpoint(server):
+    """Legacy `POST /api/embeddings` ({"prompt"} -> {"embedding"})."""
+    status, body = http_json("POST", f"{server.url}/api/embeddings", {
+        "model": "m", "prompt": "hello world"})
+    assert status == 200
+    assert isinstance(body["embedding"], list) and body["embedding"]
+    # Same vector as the modern endpoint.
+    _, modern = http_json("POST", f"{server.url}/api/embed", {
+        "model": "m", "input": "hello world"})
+    assert body["embedding"] == modern["embeddings"][0]
+
+
+def test_embed_rejects_bad_input(server):
+    import urllib.error
+    req = urllib.request.Request(
+        f"{server.url}/api/embed",
+        data=json.dumps({"model": "m", "input": [1, 2]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 400
+
+
+def test_model_management_endpoints_answer_501(server):
+    """pull/push/create/copy/delete: explicit 501 with a parseable error
+    (models are provisioned via CKPT_DIR, not a mutable model store)."""
+    import urllib.error
+    for ep in ("/api/pull", "/api/push", "/api/create", "/api/copy"):
+        req = urllib.request.Request(
+            f"{server.url}{ep}", data=b'{"model": "x"}',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 501
+        assert "error" in json.loads(e.value.read())
+    req = urllib.request.Request(f"{server.url}/api/delete",
+                                 data=b'{"model": "x"}', method="DELETE",
+                                 headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 501
+
+
+def test_embed_rejects_non_string_scalar_input(server):
+    import urllib.error
+    req = urllib.request.Request(
+        f"{server.url}/api/embed",
+        data=json.dumps({"model": "m", "input": 5}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 400
