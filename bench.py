@@ -166,7 +166,10 @@ def main() -> None:
     # one real request to exercise the full host path.
     # Bench contexts stay under 256 slots; restrict the window ladder so
     # warmup compiles 2 decode programs, not the full ladder to max_seq.
-    sched.warmup(prompt_buckets=(128, 256), windows=(128, 256),
+    # With the prefix cache on, suffixes are short — warm a 64 bucket so
+    # prefix admissions splice [P+64], not a rounded-up [P+128].
+    sched.warmup(prompt_buckets=(64, 128, 256) if use_prefix else (128, 256),
+                 windows=(128, 256),
                  prefix_texts=(prompt,) if use_prefix else ())
     run_one(RequestStats())
     # Single-request TTFT (the config-2 "drop-in OLLAMA_URL" number).

@@ -13,11 +13,14 @@ TPU-first shape of the idea:
   so it is a pytree: it rides ``lax.scan`` over stacked layers, donation,
   and ``jax.sharding`` untouched (q inherits the weight's sharding spec;
   s is tiny and follows the out axis).
-- **compute**: ``mm(x, w) = (x @ w.q.astype(bf16)) * w.s`` — the int8->bf16
-  convert fuses into the matmul's HBM read (XLA), the MXU runs its native
-  bf16 pipeline, and the scale is one fused per-channel multiply on the
-  output. Activations stay bf16 end-to-end; no activation quantisation,
-  no calibration data needed.
+- **compute**: decode-shaped calls (few rows — the bandwidth-bound path)
+  run a Pallas w8a16 kernel (ops/quant_mm.py) that DMAs int8 tiles into
+  VMEM and converts there, so HBM sees int8 only. XLA does NOT do this on
+  its own: ``x @ q.astype(bf16)`` materialises a bf16 weight copy in HBM
+  first (measured slower than plain bf16 — see ops/quant_mm.py), which is
+  also why prefill-shaped calls (thousands of rows, compute-bound,
+  convert amortised) keep the plain XLA path. Activations stay bf16
+  end-to-end; no activation quantisation, no calibration data needed.
 - embeddings and norms stay bf16: the embed gather reads one row per
   token (bandwidth-irrelevant) and norms are numerically sensitive.
 
@@ -65,12 +68,54 @@ def dequantize(w: QTensor, dtype=jnp.bfloat16) -> jax.Array:
     return (w.q.astype(jnp.float32) * w.s).astype(dtype)
 
 
+# Row threshold for the Pallas w8a16 path: decode/verify ticks sit far
+# below it; prefill chunks far above (where XLA's matmul is the right
+# tool and the convert cost is amortised).
+_KERNEL_MAX_ROWS = 512
+_BACKEND_IS_TPU: bool | None = None
+_FORCE_XLA = False
+
+
+def set_mm_impl(impl: str) -> None:
+    """``xla`` forces the inline-dequant path everywhere; ``auto`` (the
+    default) lets decode-shaped calls use the Pallas kernel. The serve
+    engine forces ``xla`` under tensor parallelism: pallas_call cannot
+    consume mesh-sharded operands without a shard_map wrapper (the
+    kernel's TP integration is future work — the XLA path shards fine)."""
+    global _FORCE_XLA
+    if impl not in ("auto", "xla"):
+        raise ValueError(f"impl must be auto|xla, got {impl!r}")
+    _FORCE_XLA = impl == "xla"
+
+
+def _kernel_wanted() -> bool:
+    global _BACKEND_IS_TPU
+    if _FORCE_XLA:
+        return False
+    if _BACKEND_IS_TPU is None:
+        _BACKEND_IS_TPU = jax.devices()[0].platform == "tpu"
+    return _BACKEND_IS_TPU
+
+
 def mm(x: jax.Array, w) -> jax.Array:
     """``x @ w`` for a plain array or a :class:`QTensor`.
 
-    The quantized path scales after the matmul (one multiply per output
-    element) so the contraction itself reads int8 from HBM."""
+    Quantized weights: decode-shaped calls (<= _KERNEL_MAX_ROWS rows, 2D
+    weight, kernel-friendly dims, TPU backend) go through the Pallas
+    w8a16 kernel so HBM reads int8 only; everything else dequantizes
+    inline on the XLA path (correct anywhere, and the right choice for
+    compute-bound prefill). Both scale per output channel after the
+    contraction."""
     if isinstance(w, QTensor):
+        lead, H = x.shape[:-1], x.shape[-1]
+        rows = 1
+        for d in lead:
+            rows *= d
+        if w.q.ndim == 2 and rows <= _KERNEL_MAX_ROWS and _kernel_wanted():
+            from ..ops.quant_mm import pick_block, quant_matmul
+            if pick_block(H) and pick_block(w.q.shape[1]):
+                y = quant_matmul(x.reshape(rows, H), w.q, w.s)
+                return y.reshape(*lead, w.q.shape[1])
         return (x @ w.q.astype(x.dtype)) * jnp.squeeze(w.s, -2).astype(x.dtype)
     return x @ w
 
@@ -95,11 +140,17 @@ _QUANT_LEAVES = frozenset({
 })
 
 
-def quantize_params(params: dict) -> dict:
+def quantize_params(params: dict, mesh=None) -> dict:
     """Quantize every matmul weight leaf of a model param tree in place of
     its bf16 array (embed/norms/router stay as-is). Works on sharded
     params too — quantize *after* ``shard_params`` so q/s derive their
-    shardings from the weight's."""
+    shardings from the weight's, and pass that ``mesh`` here: the Pallas
+    decode-matmul kernel cannot consume mesh-sharded operands (no
+    shard_map wrapper yet), so a mesh forces the XLA path process-wide
+    rather than leaving the guard to each construction site."""
+    if mesh is not None:
+        set_mm_impl("xla")
+
     def walk(d: dict) -> dict:
         out = {}
         for k, v in d.items():

@@ -4,21 +4,27 @@ The north-star serving path (BASELINE.json; SURVEY.md §7 stage 4) replaces
 the dense ``[L, B, max_seq, Hkv, D]`` cache — whose HBM footprint reserves
 ``max_seq`` slots for every batch row — with a paged pool: fixed-size pages
 allocated per request for its *actual* context budget, addressed through a
-page table. Decode attention over the paged pool is a Pallas flash-decode
-kernel (ops/paged_attention.py) whose page fetches are driven by
-scalar-prefetched page-table indices, so HBM reads scale with live context,
-never with allocation.
+page table, laid out token-major so pages read/write as contiguous blocks.
+Decode attention over the pool has two equal-speed implementations
+(ops/paged_attention.py): a page-granular gather + fused dense attend
+(default) and a Pallas flash-decode kernel walking scalar-prefetched
+page-table indices — either way HBM reads scale with live context, never
+with allocation.
 
 Modules:
 - :mod:`.paged_kv` — PagedKVCache pytree, host-side page allocator, and the
   pure-JAX page write/gather ops.
-- :mod:`.paged_attention` — the Pallas decode-attention kernel (with a jnp
-  reference oracle and CPU ``interpret=True`` support for hardware-free
-  tests, per SURVEY.md §4).
+- :mod:`.paged_attention` — paged decode attention (gather + Pallas kernel,
+  with a jnp reference oracle and CPU ``interpret=True`` support for
+  hardware-free tests, per SURVEY.md §4).
+- :mod:`.quant_mm` — Pallas w8a16 matmul streaming int8 weights through
+  VMEM dequant (models/quant.py's decode path; XLA alone materialises a
+  bf16 weight copy, defeating the bandwidth win).
 """
 
 from .paged_kv import PagedKVCache, PageAllocator
 from .paged_attention import paged_attention, paged_attention_reference
+from .quant_mm import quant_matmul
 
 __all__ = ["PagedKVCache", "PageAllocator", "paged_attention",
-           "paged_attention_reference"]
+           "paged_attention_reference", "quant_matmul"]

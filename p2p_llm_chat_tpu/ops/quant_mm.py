@@ -1,0 +1,100 @@
+"""Pallas w8a16 matmul: int8 weights dequantized in VMEM, not HBM.
+
+Why this kernel exists: XLA on TPU does not stream int8 dot operands —
+``x @ q.astype(bf16)`` (and the mixed-dtype ``dot_general``) materialise
+a full bf16 copy of the weight in HBM before the matmul, so "int8"
+decode read MORE bytes than bf16 (measured on a v5e chip: 22-layer
+decode trunk 4.0 ms with the convert vs 2.9 ms plain bf16 — the int8
+read + bf16 write + bf16 read round trip). Here each program DMAs an
+int8 ``[block_h, block_o]`` weight tile straight into VMEM, converts it
+there (VPU, free next to the HBM stream), and feeds the MXU — HBM sees
+int8 only, which is the entire point of weight-only quantization for
+bandwidth-bound decode (models/quant.py).
+
+Grid ``(O/block_o, H/block_h)`` with the contraction (H) innermost: the
+f32 accumulator tile stays resident in VMEM scratch across the H walk
+and is scaled (per-output-channel ``s``) once on the last step.
+
+Used by models/quant.mm for small-row calls (decode/verify ticks — the
+bandwidth-bound shapes); prefill keeps the XLA path, where the convert
+cost is amortised over thousands of rows and the matmul is
+compute-bound. ``interpret=True`` runs on CPU for hardware-free parity
+tests (tests/test_quant.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Weight-tile candidates, first divisor wins. All lane-aligned (x128) and
+# int8-sublane-aligned (x32). Bigger tiles = fewer program invocations
+# (the per-program cost is what erodes the bandwidth win at decode);
+# 1024x1024 int8 = 1 MiB of VMEM per tile, comfortably resident.
+_BLOCK_CANDIDATES = (1024, 512, 256, 128)
+
+
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref):
+    j = pl.program_id(1)
+    num_h = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                                 # [rows, bh] bf16
+    q = q_ref[...].astype(x.dtype)                 # int8 -> bf16 in VMEM
+    acc_ref[:] += jax.lax.dot(x, q, preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_h - 1)
+    def _finalise():
+        s = s_ref[0].astype(jnp.float32)           # [bo]
+        o_ref[...] = (acc_ref[:] * s[None, :]).astype(o_ref.dtype)
+
+
+def pick_block(dim: int) -> int | None:
+    for b in _BLOCK_CANDIDATES:
+        if dim % b == 0:
+            return b
+    return None
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quant_matmul(x: jax.Array, q: jax.Array, s: jax.Array,
+                 *, interpret: bool = False) -> jax.Array:
+    """``(x @ dequant(q, s))`` with int8-only HBM weight traffic.
+
+    x: [rows, H] (rows padded to a multiple of 8 here if needed);
+    q: [H, O] int8; s: [1, O] f32 per-output-channel scales (the
+    models/quant.QTensor layout). Returns [rows, O] in x.dtype.
+    Caller guarantees H and O are divisible by a block candidate
+    (models/quant.mm falls back to the XLA path otherwise).
+    """
+    rows, H = x.shape
+    O = q.shape[1]
+    bh, bo = pick_block(H), pick_block(O)
+    if bh is None or bo is None:
+        raise ValueError(f"no block divides H={H} / O={O}; use the XLA path")
+    pad = (-rows) % 8
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    rp = rows + pad
+
+    out = pl.pallas_call(
+        _qmm_kernel,
+        grid=(O // bo, H // bh),
+        in_specs=[
+            pl.BlockSpec((rp, bh), lambda i, j: (0, j)),
+            pl.BlockSpec((bh, bo), lambda i, j: (j, i)),
+            pl.BlockSpec((1, bo), lambda i, j: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((rp, bo), lambda i, j: (0, i)),
+        scratch_shapes=[pltpu.VMEM((rp, bo), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct((rp, O), x.dtype),
+        interpret=interpret,
+    )(x, q, s)
+    return out[:rows] if pad else out

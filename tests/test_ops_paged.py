@@ -91,8 +91,8 @@ def test_write_prefill_pads_go_to_garbage_page():
     # Row 0's only real page holds its 3 slots; slots 3.. of that page are
     # untouched (zero), not clobbered by row padding.
     p0 = rows_pages[0][0]
-    page = np.asarray(cache.k[0, p0])                 # [Hkv, PS, D]
-    np.testing.assert_array_equal(page[:, 3:], np.zeros_like(page[:, 3:]))
+    page = np.asarray(cache.k[0, p0])                 # [PS, Hkv, D]
+    np.testing.assert_array_equal(page[3:], np.zeros_like(page[3:]))
 
 
 @pytest.mark.parametrize("S", [4, 8, 16, 12])   # <page, =page, multi, ragged
@@ -185,8 +185,12 @@ def test_parked_row_with_zero_table_writes_garbage_only():
     assert np.any(np.asarray(cache2.k[0, 0]) == 99.0)
 
 
+@pytest.mark.parametrize("impl", ["gather", "kernel"])
 @pytest.mark.parametrize("lengths", [[1, 9, 16], [8, 8, 8], [3, 27, 1]])
-def test_kernel_matches_reference_and_dense(lengths):
+def test_kernel_matches_reference_and_dense(lengths, impl):
+    """Both production implementations (gather default + Pallas kernel in
+    interpret mode) against the index-naive reference AND an independent
+    dense oracle."""
     rng = np.random.default_rng(7)
     cache, dense_k, dense_v, _, _ = random_filled_cache(
         rng, lengths, num_pages=32)
@@ -198,7 +202,8 @@ def test_kernel_matches_reference_and_dense(lengths):
 
     for layer in range(CFG.num_layers):
         got = paged_attention(q, cache.k, cache.v, cache.page_table, lens,
-                              jnp.asarray(layer), pages=pages, interpret=True)
+                              jnp.asarray(layer), pages=pages, interpret=True,
+                              impl=impl)
         ref = paged_attention_reference(q, cache.k, cache.v,
                                         cache.page_table, lens, layer,
                                         pages=pages)
@@ -216,7 +221,8 @@ def test_kernel_matches_reference_and_dense(lengths):
                                    atol=1e-5, rtol=1e-5)
 
 
-def test_kernel_ignores_garbage_table_entries_past_length():
+@pytest.mark.parametrize("impl", ["gather", "kernel"])
+def test_kernel_ignores_garbage_table_entries_past_length(impl):
     """Dead page-table entries (0) beyond a row's live pages must not
     affect the result even when the page walk covers them."""
     rng = np.random.default_rng(8)
@@ -229,7 +235,7 @@ def test_kernel_ignores_garbage_table_entries_past_length():
                     jnp.float32)
     lens = jnp.asarray([3, 20], jnp.int32)
     got = paged_attention(q, cache.k, cache.v, cache.page_table, lens,
-                          jnp.asarray(0), pages=3, interpret=True)
+                          jnp.asarray(0), pages=3, interpret=True, impl=impl)
     ref = paged_attention_reference(q, cache.k, cache.v, cache.page_table,
                                     lens, 0, pages=3)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
@@ -254,10 +260,10 @@ def test_write_decode_multi_out_of_table_goes_to_garbage():
     S = 4                                       # 2 in-range + 2 past-table
     k = jnp.full((B, S, CFG.num_kv_heads, CFG.head_dim), 7.0, jnp.float32)
     out = paged_kv.write_decode_multi(cache, jnp.asarray(0), k, k)
-    got = np.asarray(out.k[0, 5])
+    got = np.asarray(out.k[0, 5])               # [PS, Hkv, D]
     # Slots 0..PS-3 of the last real page are untouched; only the two
     # in-range positions (slots PS-2, PS-1) changed.
-    np.testing.assert_array_equal(got[:, : PS - 2], snap_k[:, : PS - 2])
-    assert np.all(got[:, PS - 2:] == 7.0)
+    np.testing.assert_array_equal(got[: PS - 2], snap_k[: PS - 2])
+    assert np.all(got[PS - 2:] == 7.0)
     # The overflow went to the garbage page.
     assert np.any(np.asarray(out.k[0, 0]) == 7.0)

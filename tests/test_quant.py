@@ -189,3 +189,33 @@ def test_quantize_after_shard_matches_unsharded():
                            mesh=mesh)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("rows", [3, 8, 32, 160])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_pallas_qmm_matches_xla_path(rows, dtype):
+    """The Pallas w8a16 kernel (ops/quant_mm.py — the decode weight
+    stream on TPU) must agree with the inline-dequant XLA path, including
+    non-multiple-of-8 row counts (padded internally)."""
+    from p2p_llm_chat_tpu.ops.quant_mm import quant_matmul
+
+    rng = np.random.default_rng(11)
+    H, O = 256, 384
+    w = jnp.asarray(rng.normal(size=(H, O)), jnp.float32)
+    qw = quantize(w)
+    x = jnp.asarray(rng.normal(size=(rows, H)), dtype)
+    want = (x @ qw.q.astype(dtype)) * jnp.squeeze(qw.s, -2).astype(dtype)
+    got = quant_matmul(x, qw.q, qw.s, interpret=True)
+    assert got.dtype == dtype and got.shape == (rows, O)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=5e-2, rtol=5e-2)
+
+
+def test_pallas_qmm_block_picker():
+    from p2p_llm_chat_tpu.ops.quant_mm import pick_block
+
+    assert pick_block(2048) == 1024
+    assert pick_block(512) == 512
+    assert pick_block(384) == 128
+    assert pick_block(100) is None        # mm falls back to the XLA path
