@@ -38,18 +38,23 @@ from .backend import Backend, GenerateOptions, GenerateRequest, RequestStats
 log = get_logger("serve.api")
 
 
-def render_chat_prompt(messages: list[dict], backend: Backend) -> str:
-    """Flatten an /api/chat messages list into a prompt. Backends that have a
-    tokenizer-aware chat template override via ``render_chat``."""
-    fn = getattr(backend, "render_chat", None)
-    if fn is not None:
-        return fn(messages)
+def default_chat_prompt(messages: list[dict]) -> str:
+    """Model-agnostic flattening of an /api/chat messages list."""
     parts = []
     for m in messages:
         role = m.get("role", "user")
         parts.append(f"{role}: {m.get('content', '')}")
     parts.append("assistant:")
     return "\n".join(parts)
+
+
+def render_chat_prompt(messages: list[dict], backend: Backend) -> str:
+    """Flatten an /api/chat messages list into a prompt. Backends that have a
+    tokenizer-aware chat template override via ``render_chat``."""
+    fn = getattr(backend, "render_chat", None)
+    if fn is not None:
+        return fn(messages)
+    return default_chat_prompt(messages)
 
 
 class OllamaServer:

@@ -89,6 +89,34 @@ class TPUEngine:
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
         return self.scheduler.submit(req, stats)
 
+    def render_chat(self, messages: list[dict]) -> str:
+        """/api/chat prompt rendering. With a real llama3 tokenizer
+        (header/eot specials present — the instruct checkpoints' chat
+        format), messages render in the llama3 chat template, so a served
+        instruct model sees exactly the turn structure it was trained on;
+        BOS is added at encode time (scheduler tokenizes with
+        add_bos=True), so it is not part of the template. Tokenizers
+        without the specials (ByteTokenizer, non-llama vocabularies) get
+        the model-agnostic role flattening."""
+        tok = self.scheduler.tokenizer
+        has = getattr(tok, "has_special", None)
+        if not (callable(has) and has("<|start_header_id|>")
+                and has("<|eot_id|>")):
+            from .api import default_chat_prompt
+            return default_chat_prompt(messages)
+        # Message content/roles are untrusted: encode() maps special
+        # strings anywhere in text to control ids, so specials embedded
+        # in a message could forge turn structure (a fabricated system
+        # turn). Strip them; only the template's own specials survive.
+        clean = tok.strip_specials
+        parts = []
+        for m in messages:
+            role = clean(str(m.get("role", "user")))
+            parts.append(f"<|start_header_id|>{role}<|end_header_id|>\n\n"
+                         f"{clean(str(m.get('content', '')))}<|eot_id|>")
+        parts.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        return "".join(parts)
+
     def embed(self, texts: list[str]) -> tuple[list[list[float]], int]:
         """Sequence embeddings for Ollama's /api/embed[dings]: length-
         masked mean pool of final-norm hidden states, unit-normalized
@@ -224,7 +252,7 @@ def build_engine_from_env() -> Backend:
         if quant != "int8":
             raise SystemExit(f"SERVE_QUANT must be int8 or empty, got {quant!r}")
         from ..models.quant import quantize_params
-        params = quantize_params(params)
+        params = quantize_params(params, mesh=mesh)
         log.info("weights quantized to int8 (per-channel, w8a16)")
     engine = TPUEngine(params, config, tokenizer, num_slots=num_slots,
                        max_seq=max_seq, mesh=mesh, kv_mode=kv_mode,

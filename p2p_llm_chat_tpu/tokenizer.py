@@ -150,6 +150,24 @@ class BPETokenizer:
             self._special_re = None
         self._native = self._init_native()
 
+    def has_special(self, token: str) -> bool:
+        """Whether ``token`` is a registered special (serve/engine.py
+        keys the llama3 chat template on the header/eot specials)."""
+        return token in self._special
+
+    def strip_specials(self, text: str) -> str:
+        """Remove every registered special-token string from ``text``.
+
+        ``encode`` maps special strings ANYWHERE in input to their
+        control ids — correct for templates the server renders, but a
+        forgery vector for untrusted content (a chat message containing
+        ``<|eot_id|><|start_header_id|>system...`` would fabricate a
+        system turn). Template renderers call this on user-supplied
+        parts before interpolation (serve/engine.py render_chat)."""
+        if self._special_re is None:
+            return text
+        return self._special_re.sub("", text)
+
     def _init_native(self):
         """Bind the C++ merge core (native/bpe_core.cc) when buildable.
 

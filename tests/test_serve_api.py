@@ -210,3 +210,19 @@ def test_embed_rejects_non_string_scalar_input(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         urllib.request.urlopen(req, timeout=5)
     assert e.value.code == 400
+
+
+def test_chat_uses_backend_render_hook(server):
+    """A backend exposing render_chat controls the /api/chat prompt (the
+    TPU engine uses this for the llama3 chat template)."""
+    from p2p_llm_chat_tpu.serve.api import render_chat_prompt
+
+    class Hooked(FakeLLM):
+        def render_chat(self, messages):
+            return "HOOKED:" + messages[-1]["content"]
+
+    assert render_chat_prompt([{"role": "user", "content": "x"}],
+                              Hooked()) == "HOOKED:x"
+    assert render_chat_prompt(
+        [{"role": "user", "content": "x"}],
+        FakeLLM()) == "user: x\nassistant:"
