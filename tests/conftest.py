@@ -28,6 +28,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+# Persistent compilation cache: the model suites compile hundreds of
+# small programs; caching them across test processes cuts wall time
+# dramatically on small hosts (first full run pays, reruns reuse).
+_cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "..", ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# XLA:CPU's async dispatch runs eager ops on a background thread; with
+# the serving suites' heavy buffer donation it has produced sporadic
+# heap-corruption segfaults in long multi-suite processes (three crash
+# dumps, each detonating at a different later XLA entry point).
+# Synchronous dispatch removes that class of races on the test platform;
+# TPU execution is unaffected.
+jax.config.update("jax_cpu_enable_async_dispatch", False)
 
 assert jax.devices()[0].platform == "cpu", (
     f"tests must run on CPU, got {jax.devices()}")
@@ -43,6 +57,61 @@ _MODEL_TEST_MODULES = {"test_llama_parity", "test_engine", "test_sampling",
                        "test_weights", "test_prefix", "test_embed"}
 
 import pytest  # noqa: E402
+
+
+def pytest_runtest_logreport(report):
+    if report.when == "call" and os.environ.get("DEBUG_MAPS"):
+        try:
+            with open("/proc/self/maps") as f:
+                n = sum(1 for _ in f)
+            import threading
+            print(f" [maps={n} threads={threading.active_count()}]",
+                  file=sys.stderr, flush=True)
+        except OSError:
+            pass
+
+
+# The model suites compile hundreds of XLA:CPU executables in one pytest
+# process; each loaded executable holds multiple mmap regions, and the
+# process was measured hitting vm.max_map_count (default 65530) —
+# at which point the NEXT executable load dies with SIGSEGV/SIGABRT
+# inside XLA (observed as "random" late-suite segfaults; DEBUG_MAPS=1
+# prints the per-test map count). Two defenses:
+#
+# 1. drop every cached executable between test modules — modules build
+#    their own engines/programs anyway, and the persistent compilation
+#    cache (above) makes re-loads cheap;
+# 2. where permitted (root), raise the kernel limit outright.
+
+def pytest_runtest_teardown(item, nextitem):
+    if nextitem is None or item.module is not nextitem.module:
+        import gc
+        import jax as _jax
+        _jax.clear_caches()
+        gc.collect()
+
+
+def _raise_map_count(target: int = 1_048_576) -> None:
+    """Opt-in (PYTEST_RAISE_MAP_COUNT=1): writing a machine-global
+    kernel tunable as a pytest side effect is too invasive to do
+    silently — defense 1 suffices on its own; this is the backstop for
+    operators who want headroom (e.g. running many suites in one
+    process) and are prepared to change host state."""
+    if os.environ.get("PYTEST_RAISE_MAP_COUNT") != "1":
+        return
+    try:
+        with open("/proc/sys/vm/max_map_count") as f:
+            current = int(f.read().strip())
+        if current < target:
+            with open("/proc/sys/vm/max_map_count", "w") as f:
+                f.write(str(target))
+            print(f"conftest: raised vm.max_map_count {current} -> {target}",
+                  file=sys.stderr)
+    except (OSError, ValueError):
+        pass    # not privileged: defense 1 still applies
+
+
+_raise_map_count()
 
 
 def pytest_collection_modifyitems(config, items):
