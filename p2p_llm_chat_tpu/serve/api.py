@@ -5,11 +5,13 @@ The drop-in replacement for the reference's external Ollama server: the UI's
 91-98 and BASELINE.json's north star — both endpoints implemented, see
 SURVEY.md §1 L4 note):
 
-- ``POST /api/generate``  body ``{"model", "prompt", "stream", "options"}``;
-  non-streaming response carries ``{"response": ..., "done": true}`` plus
-  Ollama's timing fields; streaming (Ollama's default when ``stream`` is
-  omitted) sends NDJSON chunks ``{"response": <delta>, "done": false}`` and
-  a final ``done: true`` record with stats.
+- ``POST /api/generate``  body ``{"model", "prompt", "stream", "options",
+  "context"}``; non-streaming response carries ``{"response": ...,
+  "done": true}`` plus Ollama's timing fields and the updated ``context``
+  ids (stateless continuation — send them back to continue the exchange);
+  streaming (Ollama's default when ``stream`` is omitted) sends NDJSON
+  chunks ``{"response": <delta>, "done": false}`` and a final
+  ``done: true`` record with stats.
 - ``POST /api/chat``      same shapes with ``messages`` / ``message``.
 - ``POST /api/embed``     sequence embeddings (``input``: str | [str]);
   ``POST /api/embeddings`` is the legacy single-prompt form.
@@ -136,14 +138,30 @@ class OllamaServer:
         self._m_tokens.inc(stats.completion_tokens)
 
     def _run(self, req_body: dict, prompt: str, key: str,
-             wrap) -> Response:
+             wrap, with_context: bool = False) -> Response:
         """Shared generate/chat execution. ``key``: response field holding
-        text ('response' or 'message'); ``wrap``: delta -> field value."""
+        text ('response' or 'message'); ``wrap``: delta -> field value;
+        ``with_context``: /api/generate's conversation-state round trip
+        (request ``context`` ids prepended, final record returns the
+        updated ids — Ollama's stateless continuation contract)."""
         model = str(req_body.get("model") or self.backend.name)
         opts = GenerateOptions.from_ollama(req_body.get("options"))
         stream = req_body.get("stream")
         stream = True if stream is None else bool(stream)  # Ollama defaults to streaming
-        greq = GenerateRequest(prompt=prompt, model=model, options=opts)
+        context: tuple = ()
+        if with_context:
+            raw_ctx = req_body.get("context") or ()
+            # type(t) is int: bools pass isinstance(int); the range bound
+            # keeps hostile ids from overflowing int32 device buffers
+            # (the backend re-validates against its actual vocab).
+            if not (isinstance(raw_ctx, (list, tuple))
+                    and all(type(t) is int and 0 <= t < 2 ** 31
+                            for t in raw_ctx)):
+                return Response(400, {"error": "context must be a list of "
+                                               "non-negative token ids"})
+            context = tuple(raw_ctx)
+        greq = GenerateRequest(prompt=prompt, model=model, options=opts,
+                               context=context)
         stats = RequestStats()
         self._m_requests.inc()
         self._m_inflight.add(1)
@@ -161,6 +179,8 @@ class OllamaServer:
             self._observe(stats)
             rec = self._finalize_record(model, stats, started)
             rec[key] = wrap(text)
+            if with_context and stats.context is not None:
+                rec["context"] = stats.context
             return Response(200, rec)
 
         def ndjson() -> Iterator[bytes]:
@@ -171,6 +191,8 @@ class OllamaServer:
                     yield (json.dumps(chunk) + "\n").encode()
                 rec = self._finalize_record(model, stats, started)
                 rec[key] = wrap("")
+                if with_context and stats.context is not None:
+                    rec["context"] = stats.context
                 yield (json.dumps(rec) + "\n").encode()
                 self._observe(stats)
             except Exception as e:  # noqa: BLE001
@@ -190,7 +212,8 @@ class OllamaServer:
         except ValueError:
             return Response(400, {"error": "invalid json"})
         prompt = str(body.get("prompt") or "")
-        return self._run(body, prompt, "response", lambda t: t)
+        return self._run(body, prompt, "response", lambda t: t,
+                         with_context=True)
 
     def _chat(self, req: Request) -> Response:
         try:

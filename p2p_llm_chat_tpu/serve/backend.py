@@ -59,6 +59,10 @@ class GenerateRequest:
     prompt: str
     model: str = ""
     options: GenerateOptions = field(default_factory=GenerateOptions)
+    # Ollama /api/generate "context": token ids of a prior exchange,
+    # prepended to this prompt (the final response record returns the
+    # updated ids). Tuple of ints; empty = fresh conversation.
+    context: tuple = ()
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex)
     arrival_time: float = field(default_factory=time.monotonic)
 
@@ -72,6 +76,10 @@ class RequestStats:
     total_s: Optional[float] = None
     prompt_tokens: int = 0
     completion_tokens: int = 0
+    # Ollama "context" for /api/generate responses: the full token ids
+    # (context + prompt + completion) a follow-up request can send back.
+    # None = backend doesn't track ids (FakeLLM).
+    context: Optional[list] = None
 
 
 @runtime_checkable
@@ -130,6 +138,10 @@ class FakeLLM:
         words = words[: max(1, req.options.max_tokens)]
         if stats is not None:
             stats.prompt_tokens = len(req.prompt.split())
+            # Fake context round trip: carry forward the request's ids
+            # plus one marker per prompt word (contract-shape only).
+            stats.context = list(req.context) + list(
+                range(stats.prompt_tokens))
         first = True
         emitted = ""
         for i, w in enumerate(words):

@@ -117,6 +117,10 @@ class _Slot:
         self.done = True
         if self.stats is not None and self.stats.total_s is None:
             self.stats.total_s = time.monotonic() - self.req.arrival_time
+        if self.stats is not None and self.stats.context is None:
+            # Ollama /api/generate "context": ids a follow-up request can
+            # send back to continue this exchange.
+            self.stats.context = list(self.prompt_ids) + list(self.ids)
         self.out_q.put(None)
 
     def fail(self, msg: str) -> None:
@@ -866,7 +870,20 @@ class BatchScheduler:
             if self._expired(slot):
                 continue
             opts = slot.req.options
-            ids = self.tokenizer.encode(slot.req.prompt, add_bos=True)
+            # Ollama "context": prior-exchange ids are prepended verbatim
+            # (they already carry their own BOS), the new prompt follows
+            # without a second BOS. Ids are untrusted client input: an
+            # out-of-vocab id must fail THIS request cleanly, not corrupt
+            # logits (XLA clamps silently) or blow up the whole admission
+            # chunk it gets batched into.
+            ctx = [int(t) for t in slot.req.context]
+            if ctx and not all(0 <= t < self.config.vocab_size
+                               for t in ctx):
+                slot.fail("context contains token ids outside the model's "
+                          f"vocabulary (size {self.config.vocab_size})")
+                continue
+            ids = ctx + self.tokenizer.encode(slot.req.prompt,
+                                              add_bos=not ctx)
             # Context budget: keep the prompt tail (recent context wins, the
             # same truncation direction Ollama applies), leave room to
             # generate. Ollama num_ctx caps a request below the server max.

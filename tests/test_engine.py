@@ -469,3 +469,75 @@ def test_collect_pending_respects_row_limit():
             it.close()
     finally:
         eng.stop()
+
+
+def test_context_round_trip_continues_conversation():
+    """Ollama /api/generate context semantics through the real engine:
+    generating with a returned context must reproduce the single-shot
+    oracle over the concatenated token stream."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128)
+    try:
+        s1 = RequestStats()
+        r1 = GenerateRequest(prompt="one two", options=GenerateOptions(
+            max_tokens=4))
+        t1 = "".join(eng.generate_stream(r1, s1))
+        ctx = s1.context
+        ids1 = TOK.encode("one two", add_bos=True)
+        assert ctx[: len(ids1)] == ids1
+        assert len(ctx) == len(ids1) + s1.completion_tokens
+
+        s2 = RequestStats()
+        r2 = GenerateRequest(prompt=" three", context=tuple(ctx),
+                             options=GenerateOptions(max_tokens=4))
+        t2 = "".join(eng.generate_stream(r2, s2))
+
+        # Oracle: one dense run over the full id stream.
+        full_ids = ctx + TOK.encode(" three")
+        cache = KVCache.create(CFG, 1, 128, jnp.float32)
+        logits, cache = llama.prefill(PARAMS, CFG, jnp.asarray([full_ids]),
+                                      jnp.asarray([len(full_ids)]), cache)
+        last = np.asarray(logits[0, len(full_ids) - 1])
+        out = []
+        for _ in range(4):
+            t = int(last.argmax())
+            if t in STOP_IDS:
+                break
+            out.append(t)
+            lg, cache = llama.decode_step(PARAMS, CFG, jnp.asarray([[t]]),
+                                          cache)
+            last = np.asarray(lg[0, 0])
+        assert t2 == TOK.decode(out)
+        assert s2.context[: len(full_ids)] == full_ids
+    finally:
+        eng.stop()
+
+
+def test_out_of_vocab_context_fails_cleanly():
+    """Hostile context ids (past the vocab) must fail only the offending
+    request; a co-batched innocent one still matches the oracle."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128)
+    try:
+        bad = GenerateRequest(prompt="x", context=(CFG.vocab_size + 7,),
+                              options=GenerateOptions(max_tokens=4))
+        results = {}
+
+        def bad_worker():
+            try:
+                results["bad"] = "".join(
+                    eng.generate_stream(bad, RequestStats()))
+            except RuntimeError as e:
+                results["bad_err"] = str(e)
+
+        def good_worker():
+            results["good"] = run(eng, "innocent", max_tokens=6)[0]
+
+        ts = [threading.Thread(target=bad_worker),
+              threading.Thread(target=good_worker)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert "vocabulary" in results.get("bad_err", "")
+        assert results["good"] == oracle("innocent", 6)
+    finally:
+        eng.stop()

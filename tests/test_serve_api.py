@@ -226,3 +226,47 @@ def test_chat_uses_backend_render_hook(server):
     assert render_chat_prompt(
         [{"role": "user", "content": "x"}],
         FakeLLM()) == "user: x\nassistant:"
+
+
+def test_generate_context_round_trip(server):
+    """Ollama stateless continuation: /api/generate returns `context` ids
+    and accepts them back on the next request."""
+    status, body = http_json("POST", f"{server.url}/api/generate", {
+        "model": "m", "prompt": "first turn here", "stream": False})
+    assert status == 200
+    ctx = body["context"]
+    assert isinstance(ctx, list) and all(isinstance(t, int) for t in ctx)
+    status, body2 = http_json("POST", f"{server.url}/api/generate", {
+        "model": "m", "prompt": "second", "stream": False, "context": ctx})
+    assert status == 200
+    assert body2["context"][: len(ctx)] == ctx       # grows monotonically
+    # /api/chat has no context field (Ollama parity).
+    _, chat = http_json("POST", f"{server.url}/api/chat", {
+        "model": "m", "stream": False,
+        "messages": [{"role": "user", "content": "x"}]})
+    assert "context" not in chat
+
+
+def test_generate_rejects_bad_context(server):
+    import urllib.error
+    req = urllib.request.Request(
+        f"{server.url}/api/generate",
+        data=json.dumps({"model": "m", "prompt": "x",
+                         "context": ["no"]}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 400
+
+
+def test_generate_rejects_bool_and_oversized_context(server):
+    import urllib.error
+    for bad in ([True, False], [2**40], [-1]):
+        req = urllib.request.Request(
+            f"{server.url}/api/generate",
+            data=json.dumps({"model": "m", "prompt": "x",
+                             "context": bad}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400, bad
