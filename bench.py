@@ -22,18 +22,26 @@ The reference publishes no numbers (SURVEY.md §6; BASELINE.json
 ``published: {}``), so ``vs_baseline`` is measured against the stated
 north-star target: ``150 ms / p50_ttft_ms`` (> 1.0 beats the target).
 
+The default configuration is the full serving stack — paged KV + int8
+weights + speculative decoding + shared-prefix cache — i.e. the
+framework's best composition (measured fastest on v5e: BASELINE.md's
+matrix; every feature is oracle-pinned by the test suite, so the speed
+is not traded against correctness). Set the env knobs to measure
+stripped-down variants, e.g. ``BENCH_KV=dense BENCH_QUANT= BENCH_SPEC=0
+BENCH_PREFIX=0`` for the plain bf16 dense baseline.
+
 Env knobs (all optional):
 - ``BENCH_CONFIG``      model config (default bench-1b)
 - ``BENCH_SLOTS``       concurrent peers / batch rows (default 32)
 - ``BENCH_MAX_SEQ``     per-slot sequence budget (default 1024)
 - ``BENCH_NEW_TOKENS``  completion length per request (default 32)
 - ``BENCH_DECODE_STEPS``raw-decode timing steps (default 64)
-- ``BENCH_KV``          dense | paged (default dense)
+- ``BENCH_KV``          dense | paged (default paged)
 - ``BENCH_PAGE_SIZE``   tokens per KV page in paged mode (default 64)
-- ``BENCH_QUANT``       int8 = weight-only quantization
+- ``BENCH_QUANT``       int8 (default) | empty = bf16 weights
 - ``BENCH_SPEC``        K>0 = speculative decoding with K drafts/tick
-- ``BENCH_PREFIX``      1 = shared-prefix KV cache (suggestion-template
-                        head registered; admission prefills suffix only)
+                        (default 4; 0 disables)
+- ``BENCH_PREFIX``      shared-prefix KV cache (default 1; 0 disables)
 - ``BENCH_ADMIT_CHUNK`` fixed burst-admission width
 - ``BENCH_PROFILE``     directory for a jax.profiler trace of the
                         concurrent section
@@ -73,7 +81,7 @@ def main() -> None:
     max_seq = int(os.environ.get("BENCH_MAX_SEQ", "1024"))
     new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "32"))
     decode_steps = int(os.environ.get("BENCH_DECODE_STEPS", "64"))
-    kv_mode = os.environ.get("BENCH_KV", "dense")   # dense | paged
+    kv_mode = os.environ.get("BENCH_KV", "paged")   # dense | paged
     page_size = int(os.environ.get("BENCH_PAGE_SIZE", "64"))
 
     platform = jax.devices()[0].platform
@@ -84,7 +92,7 @@ def main() -> None:
     dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
     params = llama.init_params(config, jax.random.PRNGKey(0), dtype=dtype)
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    quant = os.environ.get("BENCH_QUANT", "")    # "" | int8
+    quant = os.environ.get("BENCH_QUANT", "int8")    # "" | int8
     if quant == "int8":
         from p2p_llm_chat_tpu.models.quant import quantize_params
         params = quantize_params(params)
@@ -144,8 +152,8 @@ def main() -> None:
 
     # -- end-to-end serving: p50 TTFT at `slots` concurrent peers ------------
     admit_chunk = int(os.environ.get("BENCH_ADMIT_CHUNK", "0")) or None
-    spec_k = int(os.environ.get("BENCH_SPEC", "0"))
-    use_prefix = os.environ.get("BENCH_PREFIX", "") not in ("", "0", "false")
+    spec_k = int(os.environ.get("BENCH_SPEC", "4"))
+    use_prefix = os.environ.get("BENCH_PREFIX", "1") not in ("", "0", "false")
     tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
     sched = BatchScheduler(params, config, tokenizer, num_slots=slots,
                            max_seq=max_seq, kv_mode=kv_mode,

@@ -76,8 +76,24 @@ def multihost_mesh(cfg: MeshConfig) -> Mesh:
         raise ValueError(
             f"dp={cfg.dp} must be a multiple of process count {n_proc} "
             "(DCN carries dp; a replica cannot straddle a host boundary)")
-    from jax.experimental import mesh_utils
-    ici = (cfg.dp // n_proc, cfg.pp, cfg.ep, cfg.sp, cfg.tp)
-    dcn = (n_proc, 1, 1, 1, 1)
-    arr = mesh_utils.create_hybrid_device_mesh(ici, dcn)
+    if cfg.size != len(jax.devices()):
+        raise ValueError(f"mesh size {cfg.size} != global device count "
+                         f"{len(jax.devices())}")
+    try:
+        from jax.experimental import mesh_utils
+        ici = (cfg.dp // n_proc, cfg.pp, cfg.ep, cfg.sp, cfg.tp)
+        dcn = (n_proc, 1, 1, 1, 1)
+        arr = mesh_utils.create_hybrid_device_mesh(ici, dcn)
+    except ValueError:
+        # create_hybrid_device_mesh keys on per-device slice indices,
+        # which exist on real TPU pods but not on forced-host CPU
+        # devices (the no-hardware test path, SURVEY.md §4) or other
+        # single-slice-per-host setups. Group by process manually: dp
+        # outermost over sorted process blocks — each process's devices
+        # fill whole dp rows, so a replica never straddles a host.
+        import numpy as np
+        devs = sorted(jax.devices(),
+                      key=lambda d: (d.process_index, d.id))
+        arr = np.array(devs).reshape(cfg.dp, cfg.pp, cfg.ep, cfg.sp,
+                                     cfg.tp)
     return Mesh(arr, AXES)
