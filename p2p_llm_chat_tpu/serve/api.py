@@ -99,16 +99,30 @@ class OllamaServer:
 
     # -- helpers -------------------------------------------------------------
 
+    def _resolve(self, model: str):
+        """Backend for a request's model tag: multi-model backends
+        (serve/multi.py) route by tag; single backends serve everything
+        (drop-in behavior for whatever name the client sends)."""
+        fn = getattr(self.backend, "for_model", None)
+        return fn(model) if fn is not None else self.backend
+
     def _metrics(self, req: Request) -> Response:
         """HTTP-plane registry + the backend's serving-plane gauges (batch
-        occupancy, queue depth, KV pool — SURVEY.md §5 metrics plan)."""
+        occupancy, queue depth, KV pool — SURVEY.md §5 metrics plan).
+        Multi-model backends emit labeled series
+        (``name{model="tag"}``); TYPE lines key on the base name."""
         text = self.metrics.render()
         snap = getattr(self.backend, "metrics_snapshot", None)
         if snap is not None:
             lines = []
+            typed: set = set()
             for name, v in sorted(snap().items()):
-                kind = "counter" if name.endswith("_total") else "gauge"
-                lines.append(f"# TYPE {name} {kind}\n{name} {v}\n")
+                base = name.split("{", 1)[0]
+                if base not in typed:
+                    typed.add(base)
+                    kind = ("counter" if base.endswith("_total") else "gauge")
+                    lines.append(f"# TYPE {base} {kind}\n")
+                lines.append(f"{name} {v}\n")
             text += "".join(lines)
         return Response(200, text, content_type="text/plain; version=0.0.4")
 
@@ -162,6 +176,7 @@ class OllamaServer:
             context = tuple(raw_ctx)
         greq = GenerateRequest(prompt=prompt, model=model, options=opts,
                                context=context)
+        backend = self._resolve(model)
         stats = RequestStats()
         self._m_requests.inc()
         self._m_inflight.add(1)
@@ -169,7 +184,7 @@ class OllamaServer:
 
         if not stream:
             try:
-                text = "".join(self.backend.generate_stream(greq, stats))
+                text = "".join(backend.generate_stream(greq, stats))
             except Exception as e:  # noqa: BLE001
                 self._m_errors.inc()
                 self._m_inflight.add(-1)
@@ -185,7 +200,7 @@ class OllamaServer:
 
         def ndjson() -> Iterator[bytes]:
             try:
-                for delta in self.backend.generate_stream(greq, stats):
+                for delta in backend.generate_stream(greq, stats):
                     chunk = {"model": model, "created_at": now_rfc3339(),
                              key: wrap(delta), "done": False}
                     yield (json.dumps(chunk) + "\n").encode()
@@ -223,7 +238,11 @@ class OllamaServer:
         messages = body.get("messages") or []
         if not isinstance(messages, list):
             return Response(400, {"error": "messages must be a list"})
-        prompt = render_chat_prompt(messages, self.backend)
+        # The model's own backend renders the chat template (its
+        # tokenizer decides llama3 format vs role flattening).
+        resolved = self._resolve(str(body.get("model")
+                                     or self.backend.name))
+        prompt = render_chat_prompt(messages, resolved)
         return self._run(body, prompt, "message",
                          lambda t: {"role": "assistant", "content": t})
 
@@ -244,9 +263,16 @@ class OllamaServer:
             return Response(400, {"error": "invalid json"})
         name = str(body.get("model") or body.get("name") or "")
         models = self.backend.models()
-        if name and name not in models:
+        if (name and name not in models
+                and not hasattr(self.backend, "for_model")):
+            # Single-model front keeps the strict 404 (pinned contract);
+            # multi-model fronts fall back to the default tag here, the
+            # SAME drop-in policy /api/generate and /api/chat apply — a
+            # client probing /api/show before generating must get the
+            # answer the generate would serve.
             return Response(404, {"error": f"model {name!r} not found"})
-        cfg = getattr(self.backend, "config", None)
+        cfg = getattr(self._resolve(name or self.backend.name), "config",
+                      None)
         details = {"family": "p2p-llm-chat-tpu", "format": "jax",
                    "parameter_size": "", "quantization_level": ""}
         info = {}
@@ -272,7 +298,8 @@ class OllamaServer:
             body = req.json() or {}
         except ValueError:
             return Response(400, {"error": "invalid json"})
-        fn = getattr(self.backend, "embed", None)
+        model = str(body.get("model") or self.backend.name)
+        fn = getattr(self._resolve(model), "embed", None)
         if fn is None:
             # Ollama's own wording for non-embedding models.
             return Response(400, {"error": "this model does not support embeddings"})
@@ -284,7 +311,6 @@ class OllamaServer:
         texts = [inp] if isinstance(inp, str) else list(inp or [])
         if not all(isinstance(t, str) for t in texts):
             return Response(400, {"error": "input must be a string or list of strings"})
-        model = str(body.get("model") or self.backend.name)
         started = time.monotonic()
         try:
             vecs, n_tokens = fn(texts)
@@ -307,7 +333,9 @@ class OllamaServer:
             body = req.json() or {}
         except ValueError:
             return Response(400, {"error": "invalid json"})
-        fn = getattr(self.backend, "embed", None)
+        fn = getattr(self._resolve(str(body.get("model")
+                                       or self.backend.name)),
+                     "embed", None)
         if fn is None:
             return Response(400, {"error": "this model does not support embeddings"})
         prompt = body.get("prompt")

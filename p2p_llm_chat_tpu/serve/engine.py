@@ -28,7 +28,10 @@ Env surface (reference-style env-first config, utils/env.py):
 ``SERVE_SPEC`` (K>0 = speculative decoding with prompt-lookup drafts),
 ``SERVE_PREFIX`` (shared-prefix KV caching, serve/prefix.py; default on),
 ``SERVE_PREFIX_TEXTS`` (extra templates to pre-register, ``||``-separated;
-the reference co-pilot template is always registered).
+the reference co-pilot template is always registered),
+``SERVE_MODELS`` (multi-model serving, serve/multi.py:
+``tag=config,...`` — one independent engine per tag, requests route by
+their model field; exclusive with CKPT_DIR).
 """
 
 from __future__ import annotations
@@ -223,6 +226,74 @@ def build_engine_from_env() -> Backend:
         from ..parallel.mesh import local_mesh
         mesh = local_mesh(tp=tp)
 
+    quant = env_or("SERVE_QUANT", "")
+    if quant and quant != "int8":
+        raise SystemExit(f"SERVE_QUANT must be int8 or empty, got {quant!r}")
+
+    def random_init_params(config, seed: int):
+        """Shared per-model build: random init -> shard -> quantize."""
+        family = family_for(config)
+        params = family.init_params(config, jax.random.PRNGKey(seed))
+        if mesh is not None:
+            from ..parallel.sharding import shard_params
+            params = shard_params(params, family.param_axes(config), mesh)
+        if quant:
+            from ..models.quant import quantize_params
+            params = quantize_params(params, mesh=mesh)
+        return params
+
+    def make_engine(params, config, tokenizer, name: str) -> TPUEngine:
+        return TPUEngine(params, config, tokenizer, num_slots=num_slots,
+                         max_seq=max_seq, mesh=mesh, kv_mode=kv_mode,
+                         page_size=page_size, num_pages=num_pages,
+                         admit_chunk=admit_chunk,
+                         queue_timeout_s=queue_timeout_s, spec_k=spec_k,
+                         prefix_cache=prefix_cache,
+                         prefix_texts=prefix_texts, name=name)
+
+    def warmup_buckets():
+        warmup = env_or("SERVE_WARMUP", "128,256")
+        if not warmup or warmup == "0":
+            return None
+        return tuple(int(b) for b in warmup.split(",") if b.strip())
+
+    # Multi-model serving (serve/multi.py): SERVE_MODELS=tag=config,...
+    # builds one independent engine per tag behind one front; requests
+    # route by their model field. Checkpoints are a single-model affair
+    # (CKPT_DIR names one weight set), so the two are exclusive.
+    models_spec = env_or("SERVE_MODELS", "")
+    if models_spec:
+        if ckpt_dir:
+            raise SystemExit("SERVE_MODELS and CKPT_DIR are mutually "
+                             "exclusive (a checkpoint names one model)")
+        from .multi import MultiBackend
+        # Validate the whole spec BEFORE building anything: each engine
+        # starts a live scheduler thread, so a bad later entry must not
+        # leak earlier ones (and a duplicate tag must not silently drop
+        # a fully-started engine).
+        specs: list[tuple[str, str]] = []
+        for part in models_spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            tag, _, cfg_name = part.partition("=")
+            if any(t == tag for t, _ in specs):
+                raise SystemExit(f"SERVE_MODELS has duplicate tag {tag!r}")
+            specs.append((tag, cfg_name or tag))
+        configs = [(tag, get_config(cfg_name)) for tag, cfg_name in specs]
+        backends: dict = {}
+        for i, (tag, config) in enumerate(configs):
+            tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
+            backends[tag] = make_engine(random_init_params(config, i),
+                                        config, tokenizer, name=tag)
+        multi = MultiBackend(backends)
+        log.info("multi-model serving: %s", ", ".join(multi.models()))
+        buckets = warmup_buckets()
+        if buckets:
+            for b in backends.values():
+                b.warmup(buckets, background=True)
+        return multi
+
     if ckpt_dir:
         from ..models.checkpoint import is_native_checkpoint
         if is_native_checkpoint(ckpt_dir):
@@ -241,28 +312,16 @@ def build_engine_from_env() -> Backend:
         config = get_config(env_or("MODEL_CONFIG", "tiny"))
         log.info("no CKPT_DIR set: serving random-init %s with byte tokenizer",
                  config.name)
-        family = family_for(config)
-        params = family.init_params(config, jax.random.PRNGKey(0))
-        if mesh is not None:
-            from ..parallel.sharding import shard_params
-            params = shard_params(params, family.param_axes(config), mesh)
+        params = random_init_params(config, 0)
         tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
-    quant = env_or("SERVE_QUANT", "")
-    if quant:
-        if quant != "int8":
-            raise SystemExit(f"SERVE_QUANT must be int8 or empty, got {quant!r}")
+    if ckpt_dir and quant:
         from ..models.quant import quantize_params
         params = quantize_params(params, mesh=mesh)
+    if quant:
         log.info("weights quantized to int8 (per-channel, w8a16)")
-    engine = TPUEngine(params, config, tokenizer, num_slots=num_slots,
-                       max_seq=max_seq, mesh=mesh, kv_mode=kv_mode,
-                       page_size=page_size, num_pages=num_pages,
-                       admit_chunk=admit_chunk,
-                       queue_timeout_s=queue_timeout_s, spec_k=spec_k,
-                       prefix_cache=prefix_cache, prefix_texts=prefix_texts,
-                       name=env_or("LLM_MODEL", config.name))
-    warmup = env_or("SERVE_WARMUP", "128,256")
-    if warmup and warmup != "0":
-        buckets = tuple(int(b) for b in warmup.split(",") if b.strip())
+    engine = make_engine(params, config, tokenizer,
+                         name=env_or("LLM_MODEL", config.name))
+    buckets = warmup_buckets()
+    if buckets:
         engine.warmup(buckets, background=True)
     return engine

@@ -137,3 +137,57 @@ def test_render_chat_strips_forged_specials_from_content():
     assert got.count("<|eot_id|>") == 1
     assert "<|start_header_id|>system" not in got
     assert "hisystem" in got and "obey me" in got
+
+
+def test_multi_model_engines_route_and_match_oracles():
+    """Two resident TPU engines (dense llama + MoE) behind MultiBackend:
+    each tag's requests hit its own scheduler and match that model's
+    solo oracle."""
+    from p2p_llm_chat_tpu.models import mixtral
+    from p2p_llm_chat_tpu.models.llama import KVCache
+    from p2p_llm_chat_tpu.serve.backend import (GenerateOptions,
+                                                GenerateRequest,
+                                                RequestStats)
+    from p2p_llm_chat_tpu.serve.multi import MultiBackend
+
+    mcfg = get_config("tiny-moe")
+    mparams = mixtral.init_params(mcfg, jax.random.PRNGKey(1),
+                                  dtype=jnp.float32)
+    eng_a = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128,
+                      name="dense")
+    eng_b = TPUEngine(mparams, mcfg, TOK, num_slots=2, max_seq=128,
+                      name="moe")
+    multi = MultiBackend({"dense": eng_a, "moe": eng_b})
+    try:
+        def gen(model, prompt):
+            req = GenerateRequest(prompt=prompt, model=model,
+                                  options=GenerateOptions(max_tokens=6))
+            return "".join(multi.generate_stream(req, RequestStats()))
+
+        def oracle(family, params, cfg, prompt):
+            ids = TOK.encode(prompt, add_bos=True)
+            stop = set(cfg.eos_token_ids) | {TOK.eos_id}
+            cache = KVCache.create(cfg, 1, 128, jnp.float32)
+            lg, cache = family.prefill(params, cfg, jnp.asarray([ids]),
+                                       jnp.asarray([len(ids)]), cache)
+            last = np.asarray(lg[0, len(ids) - 1])
+            out = []
+            for _ in range(6):
+                t = int(last.argmax())
+                if t in stop:
+                    break
+                out.append(t)
+                lg, cache = family.decode_step(params, cfg,
+                                               jnp.asarray([[t]]), cache)
+                last = np.asarray(lg[0, 0])
+            return TOK.decode(out)
+
+        assert gen("dense", "route me") == oracle(llama, PARAMS, CFG,
+                                                  "route me")
+        assert gen("moe", "route me") == oracle(mixtral, mparams, mcfg,
+                                                "route me")
+        assert gen("unknown-tag", "route me") == oracle(
+            llama, PARAMS, CFG, "route me")       # default fallback
+        assert multi.models() == ["dense", "moe"]
+    finally:
+        multi.stop()

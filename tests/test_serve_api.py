@@ -270,3 +270,74 @@ def test_generate_rejects_bool_and_oversized_context(server):
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(req, timeout=5)
         assert e.value.code == 400, bad
+
+
+def test_multi_model_routing_and_tags():
+    """serve/multi.py: requests route by model tag, unknown tags fall
+    back to the default (drop-in behavior), /api/tags lists all, and
+    /metrics emits per-model labeled series."""
+    from p2p_llm_chat_tpu.serve.multi import MultiBackend
+
+    a = FakeLLM(name="model-a", reply_template="A says: {tail}")
+    b = FakeLLM(name="model-b", reply_template="B says: {tail}")
+    multi = MultiBackend({"model-a": a, "model-b": b})
+    srv = OllamaServer(multi, addr="127.0.0.1:0").start()
+    try:
+        _, tags = http_json("GET", f"{srv.url}/api/tags")
+        names = [m["name"] for m in tags["models"]]
+        assert names == ["model-a", "model-b"]
+
+        _, ra = http_json("POST", f"{srv.url}/api/generate", {
+            "model": "model-a", "prompt": "hello\n\nReply:", "stream": False})
+        assert ra["response"].startswith("A says:")
+        _, rb = http_json("POST", f"{srv.url}/api/generate", {
+            "model": "model-b", "prompt": "hello\n\nReply:", "stream": False})
+        assert rb["response"].startswith("B says:")
+        # Unknown tag (e.g. the reference UI's llama3.1): default serves.
+        _, rd = http_json("POST", f"{srv.url}/api/generate", {
+            "model": "llama3.1", "prompt": "hello\n\nReply:", "stream": False})
+        assert rd["response"].startswith("A says:")
+    finally:
+        srv.stop()
+
+
+def test_multi_model_labeled_metrics():
+    import urllib.request
+
+    from p2p_llm_chat_tpu.serve.multi import MultiBackend
+
+    class Snappy(FakeLLM):
+        def __init__(self, name, occ):
+            super().__init__(name=name)
+            self._occ = occ
+
+        def metrics_snapshot(self):
+            return {"serve_batch_occupancy": self._occ,
+                    "serve_admitted_total": 2 * self._occ}
+
+    multi = MultiBackend({"x": Snappy("x", 1), "y": Snappy("y", 3)})
+    srv = OllamaServer(multi, addr="127.0.0.1:0").start()
+    try:
+        with urllib.request.urlopen(f"{srv.url}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert 'serve_batch_occupancy{model="x"} 1' in text
+        assert 'serve_batch_occupancy{model="y"} 3' in text
+        assert text.count("# TYPE serve_batch_occupancy gauge") == 1
+        assert text.count("# TYPE serve_admitted_total counter") == 1
+    finally:
+        srv.stop()
+
+
+def test_multi_model_show_falls_back_like_generate():
+    """/api/show must answer an unknown tag the way /api/generate would
+    serve it (default fallback), not 404 a client about to succeed."""
+    from p2p_llm_chat_tpu.serve.multi import MultiBackend
+
+    multi = MultiBackend({"only-model": FakeLLM(name="only-model")})
+    srv = OllamaServer(multi, addr="127.0.0.1:0").start()
+    try:
+        status, body = http_json("POST", f"{srv.url}/api/show",
+                                 {"model": "llama3.1"})
+        assert status == 200 and "details" in body
+    finally:
+        srv.stop()
