@@ -277,6 +277,9 @@ def build_engine_from_env() -> Backend:
             if not part:
                 continue
             tag, _, cfg_name = part.partition("=")
+            if not tag:
+                raise SystemExit(f"SERVE_MODELS entry {part!r} has an "
+                                 "empty tag")
             if any(t == tag for t, _ in specs):
                 raise SystemExit(f"SERVE_MODELS has duplicate tag {tag!r}")
             specs.append((tag, cfg_name or tag))
@@ -290,8 +293,7 @@ def build_engine_from_env() -> Backend:
         log.info("multi-model serving: %s", ", ".join(multi.models()))
         buckets = warmup_buckets()
         if buckets:
-            for b in backends.values():
-                b.warmup(buckets, background=True)
+            multi.warmup(buckets, background=True)
         return multi
 
     if ckpt_dir:

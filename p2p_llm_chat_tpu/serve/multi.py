@@ -50,10 +50,7 @@ class MultiBackend:
         return self.for_model(req.model).generate_stream(req, stats)
 
     def models(self) -> list[str]:
-        out = []
-        for tag in self.backends:
-            out.append(tag)
-        return out
+        return list(self.backends)
 
     def metrics_snapshot(self) -> dict[str, float]:
         """Per-model gauges with Prometheus labels (the /metrics renderer
@@ -63,9 +60,11 @@ class MultiBackend:
             snap = getattr(b, "metrics_snapshot", None)
             if snap is None:
                 continue
-            # Prometheus label-value escaping: backslash and quote in a
-            # tag would otherwise break the whole exposition page.
-            esc = tag.replace("\\", "\\\\").replace('"', '\\"')
+            # Prometheus label-value escaping (backslash, quote, newline
+            # — the exposition format's required set): an unescaped tag
+            # would break the whole /metrics page for scrapers.
+            esc = (tag.replace("\\", "\\\\").replace('"', '\\"')
+                   .replace("\n", "\\n"))
             for k, v in snap().items():
                 out[f'{k}{{model="{esc}"}}'] = v
         return out

@@ -54,7 +54,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _MODEL_TEST_MODULES = {"test_llama_parity", "test_engine", "test_sampling",
                        "test_pipeline", "test_checkpoint", "test_quant", "test_spec", "test_stress",
                        "test_mixtral_parity", "test_sharding", "test_ops",
-                       "test_weights", "test_prefix", "test_embed"}
+                       "test_weights", "test_prefix", "test_embed",
+                       "test_serve_tp"}
 
 import pytest  # noqa: E402
 
