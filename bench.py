@@ -43,6 +43,10 @@ Env knobs (all optional):
                         (default 4; 0 disables)
 - ``BENCH_PREFIX``      shared-prefix KV cache (default 1; 0 disables)
 - ``BENCH_ADMIT_CHUNK`` fixed burst-admission width
+- ``BENCH_CTX``         long-context mode: approximate prompt length in
+                        tokens (0 = the short suggestion template).
+                        Exercises chunked-flash prefill and long-window
+                        paged decode; size BENCH_MAX_SEQ to fit it.
 - ``BENCH_PROFILE``     directory for a jax.profiler trace of the
                         concurrent section
 """
@@ -105,13 +109,15 @@ def main() -> None:
     if kv_mode == "paged":
         from p2p_llm_chat_tpu.ops.paged_kv import PagedKVCache
 
-        mppr = -(-max_seq // page_size)
-        num_pages = slots * mppr + 1
-
         # Attention window must cover the initial 64-token context plus
         # every decoded position, or the kernel walks a truncated page
-        # table and the paged tok/s is not comparable to dense.
-        window_pages = min(mppr, -(-(64 + decode_steps + 1) // page_size))
+        # table and the paged tok/s is not comparable to dense. The pool
+        # is sized to that actual context — NOT slots x max_seq, which at
+        # long BENCH_MAX_SEQ would reserve more HBM than the chip has
+        # (the exact failure paging exists to avoid).
+        window_pages = -(-(64 + decode_steps + 1) // page_size)
+        mppr = window_pages
+        num_pages = slots * mppr + 1
 
         def _step(params, tokens, cache, active):
             return llama.decode_step_paged(params, config, tokens, cache,
@@ -155,12 +161,32 @@ def main() -> None:
     spec_k = int(os.environ.get("BENCH_SPEC", "4"))
     use_prefix = os.environ.get("BENCH_PREFIX", "1") not in ("", "0", "false")
     tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
-    sched = BatchScheduler(params, config, tokenizer, num_slots=slots,
-                           max_seq=max_seq, kv_mode=kv_mode,
-                           page_size=page_size, admit_chunk=admit_chunk,
-                           spec_k=spec_k, prefix_cache=use_prefix)
     prompt = ("Draft a concise, friendly reply to the following message:\n\n"
               "Hey, are we still meeting tomorrow at 10?\n\nReply:")
+    bench_ctx = int(os.environ.get("BENCH_CTX", "0"))
+    if bench_ctx:
+        # Long-context suggestion: a big conversation history ahead of
+        # the same template tail (byte tokenizer: ~1 token per char).
+        history = ("Earlier in this thread we discussed the quarterly "
+                   "plans and the picnic schedule. ")
+        need = max(0, bench_ctx - len(prompt))
+        prompt = (history * (need // len(history) + 1))[:need] + prompt
+    # Pool sized to the bench workload's real per-request budget
+    # (prompt + completion + spec slack), not slots x max_seq — and
+    # never above the per-row cap the scheduler itself enforces (the
+    # prompt gets tail-truncated to the context budget anyway).
+    serve_pages = None
+    if kv_mode == "paged":
+        eff_max = min(max_seq, config.max_seq_len)
+        per_req = -(-(len(prompt) + 1 + new_tokens + spec_k + 2)
+                    // page_size) + 1
+        per_req = min(per_req, -(-eff_max // page_size))
+        serve_pages = slots * per_req + 1
+    sched = BatchScheduler(params, config, tokenizer, num_slots=slots,
+                           max_seq=max_seq, kv_mode=kv_mode,
+                           page_size=page_size, num_pages=serve_pages,
+                           admit_chunk=admit_chunk,
+                           spec_k=spec_k, prefix_cache=use_prefix)
     opts = GenerateOptions(max_tokens=new_tokens, temperature=0.7, top_p=0.9,
                            seed=0)
 
@@ -171,13 +197,25 @@ def main() -> None:
 
     # Warmup: compile admit programs (both chunk sizes x prompt buckets)
     # and decode programs (attention windows) on synthetic buffers, then
-    # one real request to exercise the full host path.
-    # Bench contexts stay under 256 slots; restrict the window ladder so
-    # warmup compiles 2 decode programs, not the full ladder to max_seq.
+    # one real request to exercise the full host path. Buckets/windows
+    # are sized to the actual bench prompt + completion (the full ladder
+    # to max_seq would compile programs the bench never runs).
     # With the prefix cache on, suffixes are short — warm a 64 bucket so
     # prefix admissions splice [P+64], not a rounded-up [P+128].
-    sched.warmup(prompt_buckets=(64, 128, 256) if use_prefix else (128, 256),
-                 windows=(128, 256),
+    from p2p_llm_chat_tpu.serve.scheduler import _bucket
+    eff_max = sched.max_seq        # BENCH_MAX_SEQ capped by the config
+    plen = len(tokenizer.encode(prompt, add_bos=True))
+    pbucket = _bucket(min(plen, eff_max - 2), eff_max)
+    buckets = tuple(sorted({64, 128, pbucket} if use_prefix
+                           else {128, pbucket}))
+    need = min(plen + new_tokens + spec_k + 2, eff_max)
+    ws, w = [], 128
+    while True:
+        ws.append(w)
+        if w >= need or w >= eff_max:
+            break
+        w *= 2
+    sched.warmup(prompt_buckets=buckets, windows=tuple(ws),
                  prefix_texts=(prompt,) if use_prefix else ())
     run_one(RequestStats())
     # Single-request TTFT (the config-2 "drop-in OLLAMA_URL" number).
@@ -229,6 +267,7 @@ def main() -> None:
             **spec_stats,
             "page_size": page_size if kv_mode == "paged" else None,
             "config": cfg_name,
+            "prompt_tokens": plen,
             "n_params_b": round(n_params / 1e9, 3),
             "slots": slots,
             "max_seq": max_seq,

@@ -110,12 +110,25 @@ class PrefixStore:
         """Count this prompt's heads at every ladder grain; return a head
         that just crossed ``promote_after`` sightings (longest first) and
         should be promoted to a cached entry, else None. The caller builds
-        the KV and calls :meth:`put`."""
+        the KV and calls :meth:`put`.
+
+        Grains already covered by a LONGER matching entry are not
+        tracked: match() always picks the longest prefix, so a shorter
+        entry for the same head would never be used — building it would
+        be pure compile/prefill cost (observed: a hot template triggered
+        one pointless promotion per ladder grain)."""
         candidate: Optional[tuple[int, ...]] = None
         with self._lock:
+            covered = 0
+            for key in self._entries:
+                lk = len(key)
+                if lk > covered and tuple(ids[:lk]) == key:
+                    covered = lk
             for g in self.grain_ladder:
                 if g >= len(ids):       # need >= 1 suffix token
                     break
+                if g <= covered:
+                    continue
                 head = tuple(ids[:g])
                 if head in self._entries:
                     continue

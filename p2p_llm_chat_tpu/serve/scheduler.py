@@ -66,6 +66,11 @@ log = get_logger("serve.scheduler")
 
 _MIN_BUCKET = 16
 _MAX_ADMIT_CHUNK = 8
+# Cap on one admission chunk's R x S footprint: the fused prefill
+# materialises a [L, R, S(+P), Hkv, D] small cache, so full-width chunks
+# at long prompt buckets would transiently eat gigabytes of HBM (32 x
+# 2048 at a 1B config is ~6 GB). Long prompts admit in narrower chunks.
+_ADMIT_TOKEN_BUDGET = 16384
 # Repeat-penalty recent-token window (Ollama repeat_last_n default).
 _RING = 64
 # Adaptive speculation: below this EMA of accepted-drafts-per-tick the
@@ -129,6 +134,28 @@ class _Slot:
         reference's "(LLM error)" string)."""
         self.error = msg
         self.finish()
+
+
+class _WarmupJob:
+    """A closure executed ON the scheduler thread (posted via the admit
+    queue). Warmup dispatches the real programs against the live device
+    buffers, and only the scheduler thread may touch those — running the
+    job anywhere else would race the decode loop."""
+
+    __slots__ = ("fn", "done", "err")
+
+    def __init__(self, fn) -> None:
+        self.fn = fn
+        self.done = threading.Event()
+        self.err: Optional[BaseException] = None
+
+    def run(self) -> None:
+        try:
+            self.fn()
+        except BaseException as e:   # noqa: BLE001 — re-raised by caller
+            self.err = e
+        finally:
+            self.done.set()
 
 
 class BatchScheduler:
@@ -233,6 +260,7 @@ class BatchScheduler:
             self._prefix = None
         self._n_prefix_admits = 0     # requests admitted via a cached prefix
         self._n_prefix_tokens = 0     # prompt tokens NOT recomputed
+        self._promote_q: list[tuple] = []   # heads awaiting an idle build
         # Adaptive speculation: EMA of accepted drafts per spec tick.
         # The verify forward computes K+1 positions for every row, so
         # when drafts stop landing (non-repetitive output), paying it
@@ -576,6 +604,14 @@ class BatchScheduler:
             self._spec_programs[window] = p
         return p
 
+    def _chunk_cap(self, S: int) -> int:
+        """Widest admission chunk (power of two) whose R x S footprint
+        stays inside _ADMIT_TOKEN_BUDGET; at least 1."""
+        cap, p = max(1, _ADMIT_TOKEN_BUDGET // S), 1
+        while p * 2 <= cap:
+            p *= 2
+        return p
+
     def _window(self, extra: int = 0) -> int:
         """Smallest power-of-two (>= 128, <= max_seq) attention window
         covering every active row's context + the slot(s) being written
@@ -589,13 +625,37 @@ class BatchScheduler:
     def warmup(self, prompt_buckets: tuple[int, ...] = (128, 256),
                chunk_sizes: Optional[tuple[int, ...]] = None,
                windows: Optional[tuple[int, ...]] = None,
-               prefix_texts: tuple[str, ...] = ()) -> None:
-        """Pre-compile the serving programs on synthetic throwaway buffers
-        (first compile is tens of seconds on TPU — it must not land on real
-        requests' TTFT). Compiles one admit program per (chunk size, prompt
-        bucket) and one decode program per attention window; the live
-        device state is untouched (synthetic buffers are donated and
-        discarded)."""
+               prefix_texts: tuple[str, ...] = (),
+               timeout_s: float = 1800.0) -> None:
+        """Pre-compile the serving programs (first compile is tens of
+        seconds on TPU — it must not land on real requests' TTFT): one
+        admit program per (chunk size, prompt bucket), one decode (and
+        spec) program per attention window.
+
+        Warmup dispatches the REAL programs on the LIVE device state with
+        all-padding inputs — a no-op by the same invariants serving rests
+        on (padding rows carry the out-of-range sentinel so installs
+        drop; inactive decode rows never advance and their writes land
+        beyond trusted lengths / in the garbage page). This matters for
+        memory: the earlier throwaway-buffer approach allocated a second
+        full KV pool during warmup, which at long max_seq was the
+        difference between fitting in HBM and OOMing before the first
+        request.
+
+        Because it touches live buffers, the work runs ON the scheduler
+        thread (posted as a job through the admit queue); this wrapper
+        blocks until it completes and re-raises its error, from any
+        thread."""
+        job = _WarmupJob(lambda: self._warmup_on_thread(
+            prompt_buckets, chunk_sizes, windows, prefix_texts))
+        self._admit_q.put(job)
+        if not job.done.wait(timeout=timeout_s):
+            raise TimeoutError(f"warmup did not finish within {timeout_s}s")
+        if job.err is not None:
+            raise job.err
+
+    def _warmup_on_thread(self, prompt_buckets, chunk_sizes, windows,
+                          prefix_texts) -> None:
         if chunk_sizes is None:
             if self.admit_chunk:
                 # A fixed admit width is the ONLY program admission uses.
@@ -615,43 +675,23 @@ class BatchScheduler:
                     break
                 w *= 2
             windows = tuple(sorted(ws))
+        else:
+            # Caller-supplied windows clamp to the serving budget (which
+            # is itself capped by the model's max_seq_len): a wider
+            # window would walk past the KV allocation.
+            windows = tuple(sorted({min(w, self.max_seq) for w in windows}))
         B = self.num_slots
 
-        def throwaway_cache():
-            if self.kv_mode == "paged":
-                from ..ops.paged_kv import PagedKVCache
-                return PagedKVCache.create(
-                    self.config, B, self.num_pages, self.page_size,
-                    max_pages_per_row=-(-self.max_seq // self.page_size),
-                    dtype=self._dtype)
-            return KVCache.create(self.config, B, self.max_seq, self._dtype)
+        def chunks_for(footprint: int) -> list[int]:
+            """Chunk widths for a per-row token footprint (the suffix
+            bucket plus any broadcast prefix — the small cache is
+            [L, R, P+S, ...], so the budget must count both)."""
+            cap = self._chunk_cap(footprint)
+            return sorted({min(R, cap) for R in chunk_sizes})
 
-        def admit_args(R: int, S: int, cache, prefix=None) -> list:
-            """Synthetic-arg list matching the admission program signature
-            — ONE place to mirror signature changes (the prefix variant
-            prepends the entry's KV and widens ints to 5 rows)."""
-            args = [self._params]
-            if prefix is not None:
-                args += [prefix.k, prefix.v]
-            args += [jnp.zeros((R, S), jnp.int32),
-                     jnp.ones((5 if prefix is not None else 4, R), jnp.int32),
-                     jnp.ones((3, R), jnp.float32),
-                     jnp.full((R, _RING), self.config.vocab_size, jnp.int32)]
-            if self.kv_mode == "paged":
-                args.append(jnp.zeros((R, cache.max_pages_per_row),
-                                      jnp.int32))
-            args += [cache, jnp.zeros((B, 2), jnp.uint32),
-                     jnp.zeros((B, 1), jnp.int32),
-                     jnp.zeros((B,), jnp.float32),
-                     jnp.zeros((B,), jnp.int32),
-                     jnp.ones((B,), jnp.float32),
-                     jnp.full((B, _RING), self.config.vocab_size, jnp.int32),
-                     jnp.ones((B,), jnp.float32)]
-            return args
-
-        for R in chunk_sizes:
-            for S in buckets:
-                self._admit_j(*admit_args(R, S, throwaway_cache()))
+        for S in buckets:
+            for R in chunks_for(S):
+                self._admit_chunk([], [], S, R)       # all-padding no-op
         # Shared-prefix programs: register the known templates (builds
         # their KV — one prefill compile per distinct P), then compile the
         # prefix-admission program for every (chunk, suffix bucket, P)
@@ -662,44 +702,47 @@ class BatchScheduler:
             by_len: dict[int, PrefixEntry] = {
                 e.length: e for e in self._prefix.snapshot()}
             for P, entry in sorted(by_len.items()):
-                for R in chunk_sizes:
-                    for S in buckets:
-                        if P + S > self.max_seq:
-                            continue
-                        self._admit_prefix_j(*admit_args(
-                            R, S, throwaway_cache(), prefix=entry))
+                for S in buckets:
+                    if P + S > self.max_seq:
+                        continue
+                    for R in chunks_for(P + S):
+                        self._admit_chunk([], [], S, R, warm_prefix=entry)
+        inactive = jnp.zeros((B,), bool)
         toks = None
         for w in windows:
-            cache = throwaway_cache()
-            toks, *_ = self._decode_for(w)(
-                self._params, jnp.zeros((B, 1), jnp.int32), cache,
-                jnp.zeros((B,), bool), jnp.zeros((B,), jnp.float32),
-                jnp.zeros((B,), jnp.int32), jnp.ones((B,), jnp.float32),
-                jnp.zeros((B, 2), jnp.uint32),
-                jnp.full((B, _RING), self.config.vocab_size, jnp.int32),
-                jnp.ones((B,), jnp.float32))
+            (toks, self._next_dev, self._cache, self._keys,
+             self._ring_dev) = self._decode_for(w)(
+                self._params, self._next_dev, self._cache, inactive,
+                self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                self._keys, self._ring_dev, self._rps_dev)
             if self.spec_k:
                 K = self.spec_k
-                toks, *_ = self._spec_for(w)(
+                (_, _, self._next_dev, self._cache, self._keys,
+                 self._ring_dev) = self._spec_for(w)(
                     self._params, jnp.zeros((B, K + 1), jnp.int32),
-                    jnp.zeros((B, K), jnp.int32), jnp.zeros((B,), jnp.int32),
-                    throwaway_cache(), jnp.zeros((B,), bool),
-                    jnp.zeros((B,), jnp.float32), jnp.zeros((B,), jnp.int32),
-                    jnp.ones((B,), jnp.float32),
-                    jnp.zeros((B, 2), jnp.uint32),
-                    jnp.full((B, _RING), self.config.vocab_size, jnp.int32),
-                    jnp.ones((B,), jnp.float32))
+                    jnp.zeros((B, K), jnp.int32),
+                    jnp.zeros((B,), jnp.int32), self._cache, inactive,
+                    self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                    self._keys, self._ring_dev, self._rps_dev)
         if self.kv_mode == "paged":
             # The row-release program (_zero_row_j) otherwise compiles on
             # the first request's release — inside a later request's TTFT.
-            cache = self._zero_row_j(throwaway_cache(),
-                                     jnp.asarray(0, jnp.int32))
-            np.asarray(cache.lengths[:1])
+            # Zero a FREE row only: warmup may run mid-traffic (background
+            # warmup after serving started), and zeroing a live row's
+            # table would reroute its context reads to the garbage page.
+            # A free row's table is already zero, so this is a no-op
+            # re-zero. All rows busy: skip (compiles lazily on first
+            # release — rare, bounded cost).
+            free_row = next((i for i, s in enumerate(self._slots)
+                             if s is None), None)
+            if free_row is not None:
+                self._cache = self._zero_row_j(
+                    self._cache, jnp.asarray(free_row, jnp.int32))
         if toks is not None:
             # Drain the dispatch queue: warmup executions (and the axon
             # tunnel's deferred per-program loads) are async — without a
             # readback the first real request queues behind all of them.
-            np.asarray(toks[:1])
+            np.asarray(self._cache.lengths[:1])
         # Admission rounds short prompts UP to the smallest warmed bucket
         # (_serving_bucket): a bucket-32 program warmup never compiled
         # would otherwise compile lazily inside someone's TTFT. Recorded
@@ -785,7 +828,13 @@ class BatchScheduler:
                 s = self._admit_q.get_nowait()
             except queue.Empty:
                 break
-            if s is not None:
+            if isinstance(s, _WarmupJob):
+                # Waiter unblocks AND sees the failure — returning
+                # success for a warmup that never ran would hide
+                # uncompiled serving programs.
+                s.err = RuntimeError("scheduler stopped before warmup ran")
+                s.done.set()
+            elif s is not None:
                 s.finish()
 
     # -- scheduler thread ----------------------------------------------------
@@ -816,6 +865,15 @@ class BatchScheduler:
                     if pending is not None:
                         self._process_tick(*pending)
                         pending = None
+                    elif self._promote_q:
+                        # Idle: build one deferred prefix promotion
+                        # (compile + prefill happen with no live streams
+                        # to stall).
+                        head = self._promote_q.pop(0)
+                        try:
+                            self._register_prefix_ids(list(head))
+                        except Exception:   # noqa: BLE001 — optional
+                            log.exception("prefix promotion failed")
                     continue
                 # Flush the pipeline for a speculative tick only when one
                 # can actually run this tick (drafting needs current ids)
@@ -859,6 +917,9 @@ class BatchScheduler:
                                          timeout=timeout)
             except queue.Empty:
                 break
+            if isinstance(slot, _WarmupJob):
+                slot.run()           # on the scheduler thread, between ticks
+                continue
             if slot is None or self._closed.is_set():
                 if slot is not None:
                     # Already dequeued: stop()'s drain can no longer see it,
@@ -906,16 +967,22 @@ class BatchScheduler:
                 slot.stats.prompt_tokens = len(ids)
             if self._prefix is not None:
                 # Auto-promotion: a prompt head seen promote_after times
-                # becomes a cached prefix. Building it costs one prefill
-                # dispatch now (plus, on TPU, a one-off compile for a new
-                # (P, suffix-bucket) admission shape — register templates
-                # up front via warmup prefix_texts to avoid that).
+                # becomes a cached prefix. Building one costs a prefill
+                # dispatch plus (on TPU) possible compiles — seconds that
+                # must NOT land inside this request's admission, so the
+                # build is deferred to an idle tick (_loop). Bounded,
+                # deduped queue: promotion is an optimization, dropping
+                # one under pressure is free.
                 head = self._prefix.observe(ids)
-                if head is not None:
-                    try:
-                        self._register_prefix_ids(list(head))
-                    except Exception:   # noqa: BLE001 — cache is optional
-                        log.exception("prefix promotion failed")
+                if (head is not None and len(self._promote_q) < 8
+                        # A QUEUED longer head covers this one the same
+                        # way a built entry would (match() takes the
+                        # longest) — building the shorter grain too
+                        # would be pure compile/prefill waste.
+                        and not any(len(q) >= len(head)
+                                    and q[: len(head)] == head
+                                    for q in self._promote_q)):
+                    self._promote_q.append(head)
             out.append(slot)
         return out
 
@@ -1083,7 +1150,7 @@ class BatchScheduler:
                    self._serving_bucket(len(s.prompt_ids) - plen))
             by_bucket.setdefault(key, []).append(s)
         groups = sorted(by_bucket.items())
-        for gi, ((_, S), group) in enumerate(groups):
+        for gi, ((pkey, S), group) in enumerate(groups):
             while group:
                 # A backlog burst is admitted through the full-width program
                 # (one prefill for up to num_slots requests) instead of
@@ -1094,6 +1161,11 @@ class BatchScheduler:
                 else:
                     R = (max(self.num_slots, _MAX_ADMIT_CHUNK)
                          if len(group) > _MAX_ADMIT_CHUNK else _MAX_ADMIT_CHUNK)
+                # Long buckets admit in narrower chunks: the fused
+                # prefill's [L, R, P+S, ..] small cache must stay inside
+                # the admission HBM budget (matches the warmed widths;
+                # prefix-cached groups count their broadcast prefix too).
+                R = min(R, self._chunk_cap(S + len(pkey)))
                 chunk = group[:R]
                 group = group[R:]
                 rows = [free.pop(0) for _ in range(len(chunk))]
@@ -1129,7 +1201,8 @@ class BatchScheduler:
                     self._recover_cache()
 
     def _admit_chunk(self, chunk: list[_Slot], rows: list[int], S: int,
-                     R: int = _MAX_ADMIT_CHUNK) -> None:
+                     R: int = _MAX_ADMIT_CHUNK,
+                     warm_prefix: Optional[PrefixEntry] = None) -> None:
         """One fused dispatch: batched prefill of ``chunk`` + kv splice into
         ``rows`` + first-token sample per row.
 
@@ -1143,8 +1216,12 @@ class BatchScheduler:
         ``slot.prefix``; _admit_pending groups by entry) uploads only the
         suffix tokens: S is the *suffix* bucket, ``ints`` grows a 5th row
         with total (prefix+suffix) lengths, and the prefix-variant
-        program broadcasts the cached KV instead of recomputing it."""
-        prefix = chunk[0].prefix
+        program broadcasts the cached KV instead of recomputing it.
+
+        An EMPTY chunk is the warmup path: all R entries are padding, so
+        the dispatch compiles-and-runs the exact serving program as a
+        device no-op (``warm_prefix`` selects the prefix variant)."""
+        prefix = chunk[0].prefix if chunk else warm_prefix
         P = prefix.length if prefix is not None else 0
         pad = R - len(chunk)
         tokens = np.zeros((R, S), np.int32)
