@@ -208,7 +208,11 @@ def attend_gqa_auto(q: jax.Array, k: jax.Array, v: jax.Array,
     Skv = k.shape[1]
     if (B * Hq * Sq * Skv > _FLASH_SCORE_ELEMS and Skv >= 1024
             and Skv % 512 == 0):
-        return flash_attend_gqa(q, k, v, mask)
+        # Chunk 1024 measured ~6% faster than 512 on v5e at long-prefill
+        # shapes (fewer scan steps, same VMEM fit); fall back to 512 when
+        # the KV length doesn't divide.
+        return flash_attend_gqa(q, k, v, mask,
+                                chunk=1024 if Skv % 1024 == 0 else 512)
     return attend_gqa(q, k, v, mask)
 
 

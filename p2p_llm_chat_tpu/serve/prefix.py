@@ -122,7 +122,10 @@ class PrefixStore:
             covered = 0
             for key in self._entries:
                 lk = len(key)
-                if lk > covered and tuple(ids[:lk]) == key:
+                # Only a PROPER prefix covers (match() needs a suffix
+                # token left): an entry equal to the whole prompt cannot
+                # serve it, so it must not suppress shorter grains.
+                if covered < lk < len(ids) and tuple(ids[:lk]) == key:
                     covered = lk
             for g in self.grain_ladder:
                 if g >= len(ids):       # need >= 1 suffix token

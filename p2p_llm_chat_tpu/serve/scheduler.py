@@ -78,6 +78,9 @@ _RING = 64
 _SPEC_EMA_FLOOR = 0.5
 _SPEC_EMA_ALPHA = 0.1
 _SPEC_PROBE_EVERY = 8
+# Deferred prefix-promotion builds prefer idle ticks, but under
+# sustained load one build is allowed per this many decode ticks.
+_PROMOTE_EVERY_TICKS = 256
 
 
 def _bucket(n: int, max_seq: int) -> int:
@@ -260,7 +263,8 @@ class BatchScheduler:
             self._prefix = None
         self._n_prefix_admits = 0     # requests admitted via a cached prefix
         self._n_prefix_tokens = 0     # prompt tokens NOT recomputed
-        self._promote_q: list[tuple] = []   # heads awaiting an idle build
+        self._promote_q: list[tuple] = []   # heads awaiting a build slot
+        self._last_promote_tick = 0
         # Adaptive speculation: EMA of accepted drafts per spec tick.
         # The verify forward computes K+1 positions for every row, so
         # when drafts stop landing (non-repetitive output), paying it
@@ -643,19 +647,12 @@ class BatchScheduler:
         request.
 
         Because it touches live buffers, the work runs ON the scheduler
-        thread (posted as a job through the admit queue); this wrapper
-        blocks until it completes and re-raises its error, from any
-        thread."""
-        job = _WarmupJob(lambda: self._warmup_on_thread(
-            prompt_buckets, chunk_sizes, windows, prefix_texts))
-        self._admit_q.put(job)
-        if not job.done.wait(timeout=timeout_s):
-            raise TimeoutError(f"warmup did not finish within {timeout_s}s")
-        if job.err is not None:
-            raise job.err
-
-    def _warmup_on_thread(self, prompt_buckets, chunk_sizes, windows,
-                          prefix_texts) -> None:
+        thread — split into ONE queued job per compiled program, so live
+        decode ticks and admissions interleave between compiles instead
+        of freezing for the whole ladder. This wrapper blocks until every
+        job completes and re-raises the first error, from any thread."""
+        if self._closed.is_set():
+            raise RuntimeError("scheduler is stopped")
         if chunk_sizes is None:
             if self.admit_chunk:
                 # A fixed admit width is the ONLY program admission uses.
@@ -680,76 +677,119 @@ class BatchScheduler:
             # is itself capped by the model's max_seq_len): a wider
             # window would walk past the KV allocation.
             windows = tuple(sorted({min(w, self.max_seq) for w in windows}))
-        B = self.num_slots
 
-        def chunks_for(footprint: int) -> list[int]:
-            """Chunk widths for a per-row token footprint (the suffix
-            bucket plus any broadcast prefix — the small cache is
-            [L, R, P+S, ...], so the budget must count both)."""
-            cap = self._chunk_cap(footprint)
-            return sorted({min(R, cap) for R in chunk_sizes})
-
+        steps = []
         for S in buckets:
-            for R in chunks_for(S):
-                self._admit_chunk([], [], S, R)       # all-padding no-op
+            for R in self._chunks_for(S, chunk_sizes):
+                steps.append(lambda S=S, R=R: self._admit_chunk([], [], S, R))
         # Shared-prefix programs: register the known templates (builds
         # their KV — one prefill compile per distinct P), then compile the
         # prefix-admission program for every (chunk, suffix bucket, P)
         # combination so a template hit never compiles mid-serving.
         for text in prefix_texts:
-            self.register_prefix(text)
+            steps.append(lambda t=text: self.register_prefix(t))
         if self._prefix is not None:
-            by_len: dict[int, PrefixEntry] = {
-                e.length: e for e in self._prefix.snapshot()}
-            for P, entry in sorted(by_len.items()):
-                for S in buckets:
-                    if P + S > self.max_seq:
-                        continue
-                    for R in chunks_for(P + S):
-                        self._admit_chunk([], [], S, R, warm_prefix=entry)
-        inactive = jnp.zeros((B,), bool)
-        toks = None
+            for S in buckets:
+                steps.append(lambda S=S, cs=chunk_sizes:
+                             self._warm_prefix_bucket(S, cs))
         for w in windows:
-            (toks, self._next_dev, self._cache, self._keys,
-             self._ring_dev) = self._decode_for(w)(
-                self._params, self._next_dev, self._cache, inactive,
+            steps.append(lambda w=w: self._warm_window(w))
+        if self.kv_mode == "paged":
+            steps.append(self._warm_zero_row)
+        # Admission rounds short prompts UP to the smallest warmed bucket
+        # (_serving_bucket) — recorded only after every program compiled.
+        def _record():
+            self._warmed_buckets = buckets
+            log.info("warmup compiled: admit %s x buckets %s, decode "
+                     "windows %s", chunk_sizes, buckets, windows)
+        steps.append(_record)
+        # Drain the dispatch queue at the end: warmup executions (and the
+        # axon tunnel's deferred per-program loads) are async — without a
+        # readback the first real request queues behind all of them.
+        steps.append(lambda: np.asarray(self._cache.lengths[:1]))
+
+        jobs = [_WarmupJob(fn) for fn in steps]
+        for j in jobs:
+            self._admit_q.put(j)
+        deadline = time.monotonic() + timeout_s
+        for j in jobs:
+            while not j.done.wait(timeout=1.0):
+                if self._closed.is_set() and not self._thread.is_alive():
+                    raise RuntimeError("scheduler stopped during warmup")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"warmup did not finish within {timeout_s}s")
+            if j.err is not None:
+                raise j.err
+
+    def _build_promotion(self) -> None:
+        """Build one queued prefix promotion (scheduler thread only)."""
+        self._last_promote_tick = self._n_decode_ticks
+        head = self._promote_q.pop(0)
+        try:
+            self._register_prefix_ids(list(head))
+        except Exception:   # noqa: BLE001 — the cache is optional
+            log.exception("prefix promotion failed")
+
+    def _chunks_for(self, footprint: int,
+                    chunk_sizes: tuple[int, ...]) -> list[int]:
+        """Chunk widths for a per-row token footprint (the suffix bucket
+        plus any broadcast prefix — the small cache is [L, R, P+S, ...],
+        so the budget must count both)."""
+        cap = self._chunk_cap(footprint)
+        return sorted({min(R, cap) for R in chunk_sizes})
+
+    def _warm_prefix_bucket(self, S: int,
+                            chunk_sizes: tuple[int, ...]) -> None:
+        by_len: dict[int, PrefixEntry] = {
+            e.length: e for e in self._prefix.snapshot()}
+        for P, entry in sorted(by_len.items()):
+            if P + S > self.max_seq:
+                continue
+            for R in self._chunks_for(P + S, chunk_sizes):
+                self._admit_chunk([], [], S, R, warm_prefix=entry)
+
+    def _warm_window(self, w: int) -> None:
+        """Compile+run the decode (and spec) program for one window on
+        live state as a parked-row no-op. The programs split every row's
+        PRNG key unconditionally, so live rows' keys are restored after —
+        a mid-traffic warmup must not perturb seeded requests' outputs."""
+        B = self.num_slots
+        live = np.array([s is not None for s in self._slots], bool)
+        keys_before = (self._keys + 0) if live.any() else None   # copy:
+        inactive = jnp.zeros((B,), bool)                         # donated
+        (_, self._next_dev, self._cache, self._keys,
+         self._ring_dev) = self._decode_for(w)(
+            self._params, self._next_dev, self._cache, inactive,
+            self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+            self._keys, self._ring_dev, self._rps_dev)
+        if self.spec_k:
+            K = self.spec_k
+            (_, _, self._next_dev, self._cache, self._keys,
+             self._ring_dev) = self._spec_for(w)(
+                self._params, jnp.zeros((B, K + 1), jnp.int32),
+                jnp.zeros((B, K), jnp.int32),
+                jnp.zeros((B,), jnp.int32), self._cache, inactive,
                 self._temps_dev, self._top_ks_dev, self._top_ps_dev,
                 self._keys, self._ring_dev, self._rps_dev)
-            if self.spec_k:
-                K = self.spec_k
-                (_, _, self._next_dev, self._cache, self._keys,
-                 self._ring_dev) = self._spec_for(w)(
-                    self._params, jnp.zeros((B, K + 1), jnp.int32),
-                    jnp.zeros((B, K), jnp.int32),
-                    jnp.zeros((B,), jnp.int32), self._cache, inactive,
-                    self._temps_dev, self._top_ks_dev, self._top_ps_dev,
-                    self._keys, self._ring_dev, self._rps_dev)
-        if self.kv_mode == "paged":
-            # The row-release program (_zero_row_j) otherwise compiles on
-            # the first request's release — inside a later request's TTFT.
-            # Zero a FREE row only: warmup may run mid-traffic (background
-            # warmup after serving started), and zeroing a live row's
-            # table would reroute its context reads to the garbage page.
-            # A free row's table is already zero, so this is a no-op
-            # re-zero. All rows busy: skip (compiles lazily on first
-            # release — rare, bounded cost).
-            free_row = next((i for i, s in enumerate(self._slots)
-                             if s is None), None)
-            if free_row is not None:
-                self._cache = self._zero_row_j(
-                    self._cache, jnp.asarray(free_row, jnp.int32))
-        if toks is not None:
-            # Drain the dispatch queue: warmup executions (and the axon
-            # tunnel's deferred per-program loads) are async — without a
-            # readback the first real request queues behind all of them.
-            np.asarray(self._cache.lengths[:1])
-        # Admission rounds short prompts UP to the smallest warmed bucket
-        # (_serving_bucket): a bucket-32 program warmup never compiled
-        # would otherwise compile lazily inside someone's TTFT. Recorded
-        # only now, after every program above actually compiled.
-        self._warmed_buckets = buckets
-        log.info("warmup compiled: admit %s x buckets %s, decode windows %s",
-                 chunk_sizes, buckets, windows)
+        if keys_before is not None:
+            self._keys = jnp.where(jnp.asarray(live)[:, None],
+                                   keys_before, self._keys)
+
+    def _warm_zero_row(self) -> None:
+        # The row-release program (_zero_row_j) otherwise compiles on
+        # the first request's release — inside a later request's TTFT.
+        # Zero a FREE row only: warmup may run mid-traffic (background
+        # warmup after serving started), and zeroing a live row's
+        # table would reroute its context reads to the garbage page.
+        # A free row's table is already zero, so this is a no-op
+        # re-zero. All rows busy: skip (compiles lazily on first
+        # release — rare, bounded cost).
+        free_row = next((i for i, s in enumerate(self._slots)
+                         if s is None), None)
+        if free_row is not None:
+            self._cache = self._zero_row_j(
+                self._cache, jnp.asarray(free_row, jnp.int32))
 
     def _reset_device_state(self) -> None:
         B = self.num_slots
@@ -869,11 +909,7 @@ class BatchScheduler:
                         # Idle: build one deferred prefix promotion
                         # (compile + prefill happen with no live streams
                         # to stall).
-                        head = self._promote_q.pop(0)
-                        try:
-                            self._register_prefix_ids(list(head))
-                        except Exception:   # noqa: BLE001 — optional
-                            log.exception("prefix promotion failed")
+                        self._build_promotion()
                     continue
                 # Flush the pipeline for a speculative tick only when one
                 # can actually run this tick (drafting needs current ids)
@@ -891,6 +927,13 @@ class BatchScheduler:
                 if pending is not None:
                     self._process_tick(*pending)
                 pending = new
+                if (self._promote_q and self._n_decode_ticks
+                        - self._last_promote_tick > _PROMOTE_EVERY_TICKS):
+                    # Sustained load never goes idle — without this, hot
+                    # templates would never get their prefix built
+                    # exactly when it pays most. One bounded stall per
+                    # build, amortised over hundreds of ticks.
+                    self._build_promotion()
             except Exception:   # noqa: BLE001 — fail requests, keep serving
                 log.exception("decode tick failed; failing in-flight requests")
                 pending = None
@@ -918,8 +961,12 @@ class BatchScheduler:
             except queue.Empty:
                 break
             if isinstance(slot, _WarmupJob):
-                slot.run()           # on the scheduler thread, between ticks
-                continue
+                # One job per admission round: warmup is split into one
+                # job per compiled program precisely so decode ticks and
+                # admissions run in between — draining them all here
+                # would stall every live stream for the whole ladder.
+                slot.run()
+                break
             if slot is None or self._closed.is_set():
                 if slot is not None:
                     # Already dequeued: stop()'s drain can no longer see it,

@@ -185,3 +185,29 @@ def test_flash_attend_gqa_matches_dense():
         got = flash_attend_gqa(q, k, v, mask, chunk=16)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-5, rtol=1e-5)
+
+
+def test_attend_gqa_auto_flash_dispatch_matches_dense(monkeypatch):
+    """The auto dispatch (flash for HBM-hostile shapes, chunk picked by
+    divisibility) must be output-identical to dense attention. The score
+    threshold is monkeypatched down so small test shapes take the flash
+    branch."""
+    from p2p_llm_chat_tpu.models import layers
+
+    rng = np.random.default_rng(3)
+    B, Sq, Skv, G, rep, D = 2, 8, 2048, 2, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, Sq, G * rep, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, Skv, G, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, Skv, G, D)), jnp.float32)
+    mask = layers.causal_mask(Sq, Skv, 100)
+    want = layers.attend_gqa(q, k, v, mask)
+    monkeypatch.setattr(layers, "_FLASH_SCORE_ELEMS", 1)
+    got = layers.attend_gqa_auto(q, k, v, mask)        # chunk 1024 path
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
+    got512 = layers.attend_gqa_auto(q, k[:, :1536], v[:, :1536],
+                                    layers.causal_mask(Sq, 1536, 100))
+    want512 = layers.attend_gqa(q, k[:, :1536], v[:, :1536],
+                                layers.causal_mask(Sq, 1536, 100))
+    np.testing.assert_allclose(np.asarray(got512), np.asarray(want512),
+                               atol=1e-5, rtol=1e-5)   # 1536 -> chunk 512
