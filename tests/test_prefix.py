@@ -230,3 +230,41 @@ def test_prefix_composes_with_speculative_decoding():
             "serve_prefix_admits_total"] == len(prompts)
     finally:
         eng.stop()
+
+
+def test_midtraffic_warmup_does_not_perturb_live_seeded_stream():
+    """warmup() while a seeded request is mid-decode: programs run on
+    the LIVE device state, so the stream's tokens must be identical to a
+    run without the concurrent warmup (keys restored, lengths untouched,
+    free-row-only table zeroing)."""
+    def serve_once(do_warmup: bool) -> str:
+        eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=256,
+                        kv_mode="paged", page_size=16, prefix_texts=())
+        try:
+            req = GenerateRequest(prompt="steady stream", options=
+                                  GenerateOptions(max_tokens=40,
+                                                  temperature=0.9,
+                                                  seed=1234))
+            out: list[str] = []
+            it = eng.generate_stream(req, RequestStats())
+            out.append(next(it))          # admitted and decoding
+            if do_warmup:
+                done = threading.Event()
+
+                def warm():
+                    eng.scheduler.warmup(prompt_buckets=(32, 64),
+                                         windows=(128, 256))
+                    done.set()
+
+                t = threading.Thread(target=warm)
+                t.start()
+            for delta in it:
+                out.append(delta)
+            if do_warmup:
+                assert done.wait(timeout=120), "warmup wedged"
+                t.join(timeout=10)
+            return "".join(out)
+        finally:
+            eng.stop()
+
+    assert serve_once(True) == serve_once(False)
