@@ -72,28 +72,48 @@ def multihost_mesh(cfg: MeshConfig) -> Mesh:
     n_proc = jax.process_count()
     if n_proc == 1:
         return make_mesh(cfg)
-    if cfg.dp % n_proc:
-        raise ValueError(
-            f"dp={cfg.dp} must be a multiple of process count {n_proc} "
-            "(DCN carries dp; a replica cannot straddle a host boundary)")
-    if cfg.size != len(jax.devices()):
+    devices = jax.devices()
+    if cfg.size != len(devices):
         raise ValueError(f"mesh size {cfg.size} != global device count "
-                         f"{len(jax.devices())}")
-    try:
+                         f"{len(devices)}")
+    # Key the DCN layout on the SLICE topology, not the process count: a
+    # slice can span several hosts (its devices are all on one ICI
+    # fabric), so slices — not processes — are the unit a dp replica
+    # must not straddle. Genuinely multi-slice pods go through the
+    # hybrid builder, and an error from it (or a dp that doesn't divide
+    # the slice count) is a real misconfiguration that must surface —
+    # silently substituting an ICI-oblivious placement would bury a
+    # severe interconnect performance cliff. Everything else — non-TPU
+    # platforms, the forced-host test path (every CPU device reports
+    # slice 0), a single multi-host slice — has no DCN hop to lay out,
+    # and takes the process-grouped reshape.
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None not in slice_ids and len(slice_ids) > 1:
+        n_slices = len(slice_ids)
+        if cfg.dp % n_slices:
+            raise ValueError(
+                f"dp={cfg.dp} must be a multiple of slice count "
+                f"{n_slices} (DCN carries dp; a replica cannot straddle "
+                "a slice boundary)")
         from jax.experimental import mesh_utils
-        ici = (cfg.dp // n_proc, cfg.pp, cfg.ep, cfg.sp, cfg.tp)
-        dcn = (n_proc, 1, 1, 1, 1)
+        ici = (cfg.dp // n_slices, cfg.pp, cfg.ep, cfg.sp, cfg.tp)
+        dcn = (n_slices, 1, 1, 1, 1)
         arr = mesh_utils.create_hybrid_device_mesh(ici, dcn)
-    except ValueError:
-        # create_hybrid_device_mesh keys on per-device slice indices,
-        # which exist on real TPU pods but not on forced-host CPU
-        # devices (the no-hardware test path, SURVEY.md §4) or other
-        # single-slice-per-host setups. Group by process manually: dp
-        # outermost over sorted process blocks — each process's devices
-        # fill whole dp rows, so a replica never straddles a host.
+    else:
+        # Group by process manually: dp outermost over sorted process
+        # blocks — each process's devices fill whole dp rows, so a
+        # replica never straddles a host.
+        if cfg.dp % n_proc:
+            raise ValueError(
+                f"dp={cfg.dp} must be a multiple of process count "
+                f"{n_proc} (a replica cannot straddle a host boundary)")
         import numpy as np
-        devs = sorted(jax.devices(),
-                      key=lambda d: (d.process_index, d.id))
+        log.warning(
+            "single-slice or non-TPU device topology (%d slice ids over "
+            "%d processes): building a process-grouped mesh instead of "
+            "the ICI/DCN hybrid layout",
+            len(slice_ids - {None}) or 1, n_proc)
+        devs = sorted(devices, key=lambda d: (d.process_index, d.id))
         arr = np.array(devs).reshape(cfg.dp, cfg.pp, cfg.ep, cfg.sp,
                                      cfg.tp)
     return Mesh(arr, AXES)

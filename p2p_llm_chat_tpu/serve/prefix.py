@@ -22,9 +22,13 @@ born:
   on the spot (one prefill dispatch; on TPU the first promotion of a new
   (P, S) shape pays a compile, which is logged).
 
-Prefix lengths are snapped DOWN to the grain ladder so the compiled
-admission-program shapes stay bounded: P in {64, 128, 256, 512} and the
-suffix reuses the existing prompt-bucket ladder.
+Auto-promoted prefix lengths are snapped DOWN to the grain ladder so the
+compiled admission-program shapes stay bounded: P in {64, 128, 256, 512}
+and the suffix reuses the existing prompt-bucket ladder. REGISTERED
+templates cache at their exact token length instead — the set is small
+and known at warmup, and ladder-snapping would silently drop templates
+shorter than the smallest grain (the co-pilot template is ~18 tokens
+under a real llama3 BPE vocabulary).
 
 Correctness: the cached K/V is produced by the same prefill math on the
 same weights, so a prefix-cached admission is oracle-equal to the full
@@ -45,8 +49,9 @@ DEFAULT_GRAIN_LADDER = (64, 128, 256, 512)
 
 @dataclass
 class PrefixEntry:
-    """One cached prefix: ``ids`` (exactly P tokens, a ladder length) and
-    its prefilled K/V, shaped [L, P, Hkv, D] on device."""
+    """One cached prefix: ``ids`` (exactly P tokens — a ladder length for
+    auto-promoted heads, any length for registered templates) and its
+    prefilled K/V, shaped [L, P, Hkv, D] on device."""
 
     ids: tuple[int, ...]
     k: object                    # jax.Array [L, P, Hkv, D]
@@ -82,14 +87,6 @@ class PrefixStore:
     def hits(self) -> int:
         with self._lock:
             return sum(e.hits for e in self._entries.values())
-
-    def snap(self, n: int) -> int:
-        """Largest ladder length <= n (0 when n is below the ladder)."""
-        best = 0
-        for g in self.grain_ladder:
-            if g <= n:
-                best = g
-        return best
 
     def match(self, ids: list[int]) -> Optional[PrefixEntry]:
         """Longest entry that is a proper prefix of ``ids`` (at least one
@@ -148,11 +145,13 @@ class PrefixStore:
     def put(self, entry: PrefixEntry) -> None:
         """Insert (idempotent), evicting the least-recently-used entry
         past ``max_entries``. Safe between admission dispatches: evicted
-        device arrays are freed by refcount after their last use."""
-        if entry.length not in self.grain_ladder:
-            raise ValueError(
-                f"prefix length {entry.length} not on the grain ladder "
-                f"{self.grain_ladder}")
+        device arrays are freed by refcount after their last use.
+
+        Entry lengths are NOT required to be on the grain ladder:
+        auto-promoted heads are ladder lengths by construction
+        (``observe`` only counts ladder grains), but registered
+        templates cache at their exact token length — the operator names
+        finitely many, and warmup compiles their admission shapes."""
         with self._lock:
             self._entries[entry.ids] = entry
             while len(self._entries) > self.max_entries:

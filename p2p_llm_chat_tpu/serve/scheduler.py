@@ -73,6 +73,9 @@ _MAX_ADMIT_CHUNK = 8
 _ADMIT_TOKEN_BUDGET = 16384
 # Repeat-penalty recent-token window (Ollama repeat_last_n default).
 _RING = 64
+# Shortest registered prefix worth a cache entry: below this the saved
+# prefill compute is noise next to the admission program's fixed cost.
+_MIN_REGISTER_PREFIX = 8
 # Adaptive speculation: below this EMA of accepted-drafts-per-tick the
 # verify pass costs more than it saves; probe intermittently instead.
 _SPEC_EMA_FLOOR = 0.5
@@ -571,18 +574,38 @@ class BatchScheduler:
     # -- shared-prefix KV cache ----------------------------------------------
 
     def register_prefix(self, text: str) -> int:
-        """Cache the KV of ``text``'s token head (snapped DOWN to the
-        grain ladder so compiled admission shapes stay bounded). Returns
-        the cached prefix length in tokens (0 = too short to cache).
-        Called from warmup (before traffic) or the scheduler thread
-        (promotion); the store itself is thread-safe."""
+        """Cache the KV of ``text``'s token head at its EXACT length.
+        Registered templates are not grain-bounded the way auto-promoted
+        heads are: the operator names finitely many templates and warmup
+        compiles their admission shapes up front, so exact lengths add no
+        unbounded compiles — and grain-snapping silently dropped real-
+        tokenizer templates shorter than the smallest grain (the co-pilot
+        template is ~18 llama-BPE tokens vs a 64-token ladder floor, so
+        the advertised default caching never engaged on real
+        checkpoints). Returns the cached prefix length in tokens (0 =
+        too short to be worth a cache entry, logged). Called from warmup
+        (before traffic) or the scheduler thread (promotion); the store
+        itself is thread-safe."""
         if self._prefix is None:
             return 0
         ids = self.tokenizer.encode(text, add_bos=True)
-        P = self._prefix.snap(len(ids))
-        if P <= 0:
+        if len(ids) < _MIN_REGISTER_PREFIX:
+            log.warning(
+                "prefix_text %r encodes to %d tokens — below the %d-token "
+                "minimum, not cached (caching would save almost nothing)",
+                text[:40], len(ids), _MIN_REGISTER_PREFIX)
             return 0
-        return self._register_prefix_ids(ids[:P])
+        if len(ids) + _MIN_BUCKET > self.max_seq:
+            # The admission guard rejects any prefix whose length plus
+            # the smallest suffix bucket overruns max_seq — building the
+            # entry would burn a prefill + an LRU slot on KV no request
+            # can ever use.
+            log.warning(
+                "prefix_text %r encodes to %d tokens — too long to ever "
+                "admit under max_seq=%d, not cached",
+                text[:40], len(ids), self.max_seq)
+            return 0
+        return self._register_prefix_ids(ids)
 
     def _register_prefix_ids(self, ids: list[int]) -> int:
         k, v = self._build_prefix_j(
@@ -689,9 +712,21 @@ class BatchScheduler:
         for text in prefix_texts:
             steps.append(lambda t=text: self.register_prefix(t))
         if self._prefix is not None:
-            for S in buckets:
-                steps.append(lambda S=S, cs=chunk_sizes:
-                             self._warm_prefix_bucket(S, cs))
+            # One queued job per (P, S, R) program. The P set is known
+            # before the register jobs run: already-cached lengths plus
+            # the exact token length of each template being registered.
+            plens = set(self._prefix.lengths())
+            for text in prefix_texts:
+                n = len(self.tokenizer.encode(text, add_bos=True))
+                if n >= _MIN_REGISTER_PREFIX:
+                    plens.add(n)
+            for P in sorted(plens):
+                for S in buckets:
+                    if P + S > self.max_seq:
+                        continue
+                    for R in self._chunks_for(P + S, chunk_sizes):
+                        steps.append(lambda P=P, S=S, R=R:
+                                     self._warm_prefix_combo(P, S, R))
         for w in windows:
             steps.append(lambda w=w: self._warm_window(w))
         if self.kv_mode == "paged":
@@ -739,15 +774,17 @@ class BatchScheduler:
         cap = self._chunk_cap(footprint)
         return sorted({min(R, cap) for R in chunk_sizes})
 
-    def _warm_prefix_bucket(self, S: int,
-                            chunk_sizes: tuple[int, ...]) -> None:
-        by_len: dict[int, PrefixEntry] = {
-            e.length: e for e in self._prefix.snapshot()}
-        for P, entry in sorted(by_len.items()):
-            if P + S > self.max_seq:
-                continue
-            for R in self._chunks_for(P + S, chunk_sizes):
-                self._admit_chunk([], [], S, R, warm_prefix=entry)
+    def _warm_prefix_combo(self, P: int, S: int, R: int) -> None:
+        """Compile+run ONE prefix-admission program (one queued warmup
+        job per program, so mid-traffic warmups interleave with live
+        ticks between compiles instead of stalling for a whole
+        sub-ladder). The entry is looked up at run time — registration
+        jobs queued ahead of this one have populated the store."""
+        entry = next((e for e in self._prefix.snapshot()
+                      if e.length == P), None)
+        if entry is None or P + S > self.max_seq:
+            return
+        self._admit_chunk([], [], S, R, warm_prefix=entry)
 
     def _warm_window(self, w: int) -> None:
         """Compile+run the decode (and spec) program for one window on
@@ -765,9 +802,17 @@ class BatchScheduler:
             self._keys, self._ring_dev, self._rps_dev)
         if self.spec_k:
             K = self.spec_k
+            # Feed live pending tokens as the verify window's first
+            # column: the spec program returns next_tokens =
+            # where(active, correction, tokens[:, :1]) and active is
+            # all-False here, so _next_dev round-trips instead of being
+            # clobbered with zeros for rows admitted before a
+            # background warmup finishes.
+            warm_tokens = jnp.concatenate(
+                [self._next_dev, jnp.zeros((B, K), jnp.int32)], axis=1)
             (_, _, self._next_dev, self._cache, self._keys,
              self._ring_dev) = self._spec_for(w)(
-                self._params, jnp.zeros((B, K + 1), jnp.int32),
+                self._params, warm_tokens,
                 jnp.zeros((B, K), jnp.int32),
                 jnp.zeros((B,), jnp.int32), self._cache, inactive,
                 self._temps_dev, self._top_ks_dev, self._top_ps_dev,

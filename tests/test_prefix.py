@@ -63,12 +63,36 @@ def _entry(ids):
     return PrefixEntry(ids=tuple(ids), k=None, v=None)
 
 
-def test_store_snap_to_grain_ladder():
+def test_store_accepts_exact_length_entries():
+    """Registered templates are cached at exact (non-ladder) lengths;
+    match picks them up like any other entry."""
     st = PrefixStore()
-    assert st.snap(63) == 0
-    assert st.snap(64) == 64
-    assert st.snap(200) == 128
-    assert st.snap(4096) == 512
+    st.put(_entry(range(18)))                    # e.g. BPE-short template
+    got = st.match(list(range(30)))
+    assert got is not None and got.length == 18
+
+
+def test_short_registered_template_engages():
+    """A template below the smallest promotion grain must still cache and
+    serve admissions (the real-BPE co-pilot template is ~18 tokens vs the
+    64-token ladder floor); a sub-minimum one warns and no-ops."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=256,
+                    prefix_texts=("short head: ",))   # 13 ids with BOS
+    try:
+        eng.warmup(buckets=(64,))
+        store = eng.scheduler._prefix
+        assert store.lengths() == [
+            len(TOK.encode("short head: ", add_bos=True))]
+        prompt = "short head: see you at ten?"
+        text, _ = run(eng, prompt, max_tokens=8)
+        assert text == oracle(prompt, 8)
+        assert eng.scheduler.metrics_snapshot()[
+            "serve_prefix_admits_total"] == 1
+        # Sub-minimum template: warns (see scheduler log), caches nothing.
+        assert eng.scheduler.register_prefix("hi") == 0
+        assert len(store) == 1
+    finally:
+        eng.stop()
 
 
 def test_store_match_returns_longest_proper_prefix():
@@ -127,7 +151,9 @@ def test_registered_template_admission_matches_oracle(kv):
         store = eng.scheduler._prefix
         assert store is not None and len(store) == 1
         P = store.lengths()[0]
-        assert P == 64      # byte tokenizer: 90-char template snaps to 64
+        # Registered templates cache at exact length (not ladder-snapped):
+        # the byte tokenizer encodes the 89-char template + BOS to 90 ids.
+        assert P == len(TOK.encode(SUGGEST_PREFIX, add_bos=True))
 
         prompts = [SUGGEST_PREFIX + f"message {i}: see you at ten?\n\nReply:"
                    for i in range(5)]
@@ -190,7 +216,9 @@ def test_prefix_skipped_when_budget_would_overflow():
     try:
         eng.warmup(buckets=(64, 128))
         assert len(eng.scheduler._prefix) == 1
-        prompt = "q" * 100 + "r" * 40             # 141 ids; suffix 77 -> 128
+        # Registered prefix is exact: 101 ids. 141-id prompt -> 40-token
+        # suffix -> 64 bucket; 101 + 64 = 165 > 160 max_seq -> plain path.
+        prompt = "q" * 100 + "r" * 40
         text, _ = run(eng, prompt, max_tokens=6)
         assert text == oracle(prompt, 6)
         m = eng.scheduler.metrics_snapshot()
@@ -232,14 +260,17 @@ def test_prefix_composes_with_speculative_decoding():
         eng.stop()
 
 
-def test_midtraffic_warmup_does_not_perturb_live_seeded_stream():
+@pytest.mark.parametrize("spec_k", [0, 2])
+def test_midtraffic_warmup_does_not_perturb_live_seeded_stream(spec_k):
     """warmup() while a seeded request is mid-decode: programs run on
     the LIVE device state, so the stream's tokens must be identical to a
     run without the concurrent warmup (keys restored, lengths untouched,
-    free-row-only table zeroing)."""
+    free-row-only table zeroing). spec_k>0 covers the spec warm program,
+    which must round-trip the live rows' pending next tokens."""
     def serve_once(do_warmup: bool) -> str:
         eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=256,
-                        kv_mode="paged", page_size=16, prefix_texts=())
+                        kv_mode="paged", page_size=16, prefix_texts=(),
+                        spec_k=spec_k)
         try:
             req = GenerateRequest(prompt="steady stream", options=
                                   GenerateOptions(max_tokens=40,
