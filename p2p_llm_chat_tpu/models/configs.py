@@ -128,6 +128,18 @@ _register(ModelConfig(
     bos_token_id=1, eos_token_ids=(2,),
 ))
 
+# Like ``tiny`` but every tp-sharded dim (heads, KV heads, mlp, vocab)
+# divides a tp=4 mesh: the multi-chip dryrun validates SHARDED wk/wv/KV
+# paths with it — `tiny`'s 2 kv heads at tp=4 silently fall back to
+# replication (parallel/sharding.constrain), which would leave the
+# sharded-KV path unexercised (the production 8B/70B configs' 8 kv heads
+# divide their meshes).
+_register(ModelConfig(
+    name="tiny-tp", vocab_size=512, hidden_size=128, intermediate_size=256,
+    num_layers=2, num_heads=4, num_kv_heads=4, head_dim=32, max_seq_len=256,
+    rope_theta=10000.0, bos_token_id=1, eos_token_ids=(2,),
+))
+
 # ~1B-class dense config used by bench.py on a single v5e chip (fits HBM in
 # bf16 with room for KV cache; same architecture family as the 8B).
 _register(ModelConfig(

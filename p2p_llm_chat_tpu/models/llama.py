@@ -27,7 +27,7 @@ from jax.sharding import Mesh
 
 from ..parallel.sharding import LogicalRules, DEFAULT_RULES, constrain
 from .configs import ModelConfig
-from .quant import mm
+from .quant import QTensor, mm
 from .layers import (
     DEFAULT_COMPUTE_DTYPE,
     apply_rope,
@@ -90,6 +90,48 @@ def init_params(config: ModelConfig, key: jax.Array,
     return params
 
 
+def fuse_params(params: dict) -> dict:
+    """Concatenate per-layer ``wq|wk|wv -> wqkv`` and ``w_gate|w_up ->
+    wgu`` so a decode step runs 4 weight matmuls per layer instead of 7.
+
+    Why: decode is HBM-bandwidth-bound, and on a v5e chip the measured
+    per-matmul-call fixed cost (kernel entry + tile pipeline fill) is what
+    keeps the weight stream below the bandwidth bound — fusing the
+    column-parallel pairs cut the measured matmul floor of a bench-1b
+    step by ~20% (see BASELINE.md round-3 notes). The math is identical:
+    the fused weight's output columns are the concatenation of the
+    originals', and int8 per-output-channel scales concatenate exactly
+    (models/quant.QTensor stores s per output column).
+
+    Works on bf16 arrays and QTensors alike; no-op if already fused.
+    Single-chip only: parallel/sharding.py's rule table names wq/wk/wv
+    separately (fused qkv under tp would shard q and kv columns with one
+    spec), so the engine fuses only when ``mesh is None``.
+    """
+    layers = params["layers"]
+    if "wqkv" in layers:
+        return params
+
+    def cat(ws):
+        if isinstance(ws[0], QTensor):
+            return QTensor(
+                q=jnp.concatenate([w.q for w in ws], axis=-1),
+                s=jnp.concatenate([w.s for w in ws], axis=-1))
+        return jnp.concatenate(ws, axis=-1)
+
+    fuse_mlp = layers["w_gate"].ndim == 3   # dense [L,H,E]; the MoE
+    # family's 4-D per-expert ffn leaves stay separate (models/mixtral.py
+    # moe_mlp reads them by name; its attention still gains fused qkv).
+    drop = ("wq", "wk", "wv") + (("w_gate", "w_up") if fuse_mlp else ())
+    fused = {k: v for k, v in layers.items() if k not in drop}
+    fused["wqkv"] = cat([layers["wq"], layers["wk"], layers["wv"]])
+    if fuse_mlp:
+        fused["wgu"] = cat([layers["w_gate"], layers["w_up"]])
+    out = dict(params)
+    out["layers"] = fused
+    return out
+
+
 def param_axes(config: ModelConfig) -> dict:
     """Logical-axis tree matching init_params (leading layer axis on stacked
     leaves is unsharded). Feed to parallel.sharding.shard_params."""
@@ -117,6 +159,11 @@ def param_axes(config: ModelConfig) -> dict:
 
 def _default_mlp(x: jax.Array, lp: dict, mesh: Optional[Mesh],
                  rules: LogicalRules) -> jax.Array:
+    if "wgu" in lp:                      # fused gate|up (fuse_params)
+        gu = mm(x, lp["wgu"])
+        E = gu.shape[-1] // 2
+        g = jax.nn.silu(gu[..., :E]) * gu[..., E:]
+        return mm(g, lp["w_down"])
     return swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
 
 
@@ -127,9 +174,20 @@ def _attn_qkv(h: jax.Array, lp: dict, config: ModelConfig,
     k/v [B,S,Hkv,D]. Shared between the dense and paged block variants."""
     B, S, _ = h.shape
     x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
-    q = mm(x, lp["wq"]).reshape(B, S, config.num_heads, config.head_dim)
-    k = mm(x, lp["wk"]).reshape(B, S, config.num_kv_heads, config.head_dim)
-    v = mm(x, lp["wv"]).reshape(B, S, config.num_kv_heads, config.head_dim)
+    if "wqkv" in lp:                     # fused q|k|v (fuse_params)
+        qkv = mm(x, lp["wqkv"])
+        Q, KV = config.q_dim, config.kv_dim
+        q = qkv[..., :Q].reshape(B, S, config.num_heads, config.head_dim)
+        k = qkv[..., Q: Q + KV].reshape(B, S, config.num_kv_heads,
+                                        config.head_dim)
+        v = qkv[..., Q + KV:].reshape(B, S, config.num_kv_heads,
+                                      config.head_dim)
+    else:
+        q = mm(x, lp["wq"]).reshape(B, S, config.num_heads, config.head_dim)
+        k = mm(x, lp["wk"]).reshape(B, S, config.num_kv_heads,
+                                    config.head_dim)
+        v = mm(x, lp["wv"]).reshape(B, S, config.num_kv_heads,
+                                    config.head_dim)
     q = constrain(q, mesh, ("batch", None, "act_heads", None), rules)
     k = constrain(k, mesh, ("batch", None, "act_heads", None), rules)
     q = apply_rope(q, positions, inv_freq)
@@ -389,30 +447,33 @@ def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     inv_freq = rope_frequencies(config)
 
     def body(carry, xs):
-        h, pk, pv = carry
+        h, pk, pv, sk, sv = carry
         lp, layer = xs
         q, k, v = _attn_qkv(h, lp, config, inv_freq, positions, mesh, rules)
-        step_cache = cache._replace(k=pk, v=pv)
+        step_cache = cache._replace(k=pk, v=pv, k_scale=sk, v_scale=sv)
         step_cache = write_decode_multi(step_cache, layer, k, v)
         outs = []
         for j in range(S):         # static unroll — S = spec_k+1, small
             outs.append(paged_attention(
                 q[:, j], step_cache.k, step_cache.v, cache.page_table,
                 cache.lengths + j + 1, layer, pages=pages,
-                interpret=interpret))
+                interpret=interpret, k_scale=step_cache.k_scale,
+                v_scale=step_cache.v_scale))
         attn = jnp.stack(outs, axis=1)                             # [B,S,H,D]
         h = _post_attn(h, attn, lp, config, mesh, rules, mlp_fn)
-        return (h, step_cache.k, step_cache.v), None
+        return (h, step_cache.k, step_cache.v, step_cache.k_scale,
+                step_cache.v_scale), None
 
-    (h, new_k, new_v), _ = jax.lax.scan(
-        body, (h, cache.k, cache.v),
+    (h, new_k, new_v, new_sk, new_sv), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v, cache.k_scale, cache.v_scale),
         (params["layers"], jnp.arange(config.num_layers)))
     h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
     lm_head = (params["embed"].T if config.tie_embeddings
                else params["lm_head"])
     logits = mm(h, lm_head).astype(jnp.float32)
     logits = constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
-    return logits, cache._replace(k=new_k, v=new_v)
+    return logits, cache._replace(k=new_k, v=new_v, k_scale=new_sk,
+                                  v_scale=new_sv)
 
 
 def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
@@ -446,19 +507,22 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     inv_freq = rope_frequencies(config)
 
     def body(carry, xs):
-        h, pk, pv = carry
+        h, pk, pv, sk, sv = carry
         lp, layer = xs
         q, k, v = _attn_qkv(h, lp, config, inv_freq, positions, mesh, rules)
-        step_cache = cache._replace(k=pk, v=pv)
+        step_cache = cache._replace(k=pk, v=pv, k_scale=sk, v_scale=sv)
         step_cache = write_decode(step_cache, layer, k[:, 0], v[:, 0])
         attn = paged_attention(q[:, 0], step_cache.k, step_cache.v,
                                cache.page_table, cache.lengths + 1, layer,
-                               pages=pages, interpret=interpret)
+                               pages=pages, interpret=interpret,
+                               k_scale=step_cache.k_scale,
+                               v_scale=step_cache.v_scale)
         h = _post_attn(h, attn[:, None], lp, config, mesh, rules, mlp_fn)
-        return (h, step_cache.k, step_cache.v), None
+        return (h, step_cache.k, step_cache.v, step_cache.k_scale,
+                step_cache.v_scale), None
 
-    (h, new_k, new_v), _ = jax.lax.scan(
-        body, (h, cache.k, cache.v),
+    (h, new_k, new_v, new_sk, new_sv), _ = jax.lax.scan(
+        body, (h, cache.k, cache.v, cache.k_scale, cache.v_scale),
         (params["layers"], jnp.arange(config.num_layers)))
     h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
     lm_head = (params["embed"].T if config.tie_embeddings
@@ -467,5 +531,6 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     logits = constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
     inc = (jnp.ones_like(cache.lengths) if active is None
            else active.astype(jnp.int32))
-    return logits, cache._replace(k=new_k, v=new_v,
+    return logits, cache._replace(k=new_k, v=new_v, k_scale=new_sk,
+                                  v_scale=new_sv,
                                   lengths=cache.lengths + inc)

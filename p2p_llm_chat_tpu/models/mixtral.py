@@ -42,6 +42,10 @@ from .layers import DEFAULT_COMPUTE_DTYPE, causal_mask, length_mask
 from .quant import q_einsum
 from . import llama
 from .llama import KVCache  # same cache layout/contract as the dense family
+# Fused-qkv transform: the attention projections fuse exactly as the
+# dense family's do; the 4-D per-expert ffn leaves are left separate
+# (fuse_params checks w_gate.ndim).
+from .llama import fuse_params  # noqa: F401  (re-export, serve scheduler)
 
 # Sentinel: "derive capacity from config.moe_capacity_factor".
 _AUTO = "auto"

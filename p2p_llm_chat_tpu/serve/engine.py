@@ -72,7 +72,8 @@ class TPUEngine:
                  queue_timeout_s: Optional[float] = 60.0,
                  spec_k: int = 0,
                  prefix_cache: bool = True,
-                 prefix_texts: tuple[str, ...] = (SUGGEST_PREFIX,)) -> None:
+                 prefix_texts: tuple[str, ...] = (SUGGEST_PREFIX,),
+                 kv_quant: bool = False) -> None:
         self.name = name or config.name
         self.config = config
         self.prefix_texts = tuple(prefix_texts) if prefix_cache else ()
@@ -86,7 +87,8 @@ class TPUEngine:
                                         admit_chunk=admit_chunk,
                                         queue_timeout_s=queue_timeout_s,
                                         spec_k=spec_k,
-                                        prefix_cache=prefix_cache)
+                                        prefix_cache=prefix_cache,
+                                        kv_quant=kv_quant)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
@@ -229,6 +231,12 @@ def build_engine_from_env() -> Backend:
     quant = env_or("SERVE_QUANT", "")
     if quant and quant != "int8":
         raise SystemExit(f"SERVE_QUANT must be int8 or empty, got {quant!r}")
+    kv_quant = env_or("SERVE_KV_QUANT", "")
+    if kv_quant and kv_quant != "int8":
+        raise SystemExit(
+            f"SERVE_KV_QUANT must be int8 or empty, got {kv_quant!r}")
+    if kv_quant and kv_mode != "paged":
+        raise SystemExit("SERVE_KV_QUANT=int8 requires SERVE_KV=paged")
 
     def random_init_params(config, seed: int):
         """Shared per-model build: random init -> shard -> quantize."""
@@ -249,7 +257,8 @@ def build_engine_from_env() -> Backend:
                          admit_chunk=admit_chunk,
                          queue_timeout_s=queue_timeout_s, spec_k=spec_k,
                          prefix_cache=prefix_cache,
-                         prefix_texts=prefix_texts, name=name)
+                         prefix_texts=prefix_texts, name=name,
+                         kv_quant=bool(kv_quant))
 
     def warmup_buckets():
         warmup = env_or("SERVE_WARMUP", "128,256")

@@ -177,7 +177,8 @@ class BatchScheduler:
                  queue_timeout_s: Optional[float] = 60.0,
                  spec_k: int = 0,
                  prefix_cache: bool = False,
-                 prefix_promote_after: int = 2) -> None:
+                 prefix_promote_after: int = 2,
+                 kv_quant: bool = False) -> None:
         """``admit_chunk``: burst-admission width. None (default) admits a
         backlog burst through one full-width prefill (minimal dispatches —
         best p95/throughput); a fixed power-of-two (e.g. 8) staggers the
@@ -196,6 +197,12 @@ class BatchScheduler:
         row in one forward (models/llama.verify_step[_paged] + exact
         acceptance sampling), so ticks emit 1..K+1 tokens. 0 disables.
 
+        ``kv_quant``: store the paged pool as int8 with per-(slot,
+        kv-head) scales (ops/paged_kv.py). Decode is KV-bandwidth-bound,
+        so this trades ~s/2 elementwise KV rounding (outputs may differ
+        slightly from the bf16 oracle) for half the attention read
+        traffic and double the context capacity per pool byte.
+
         ``prefix_cache``: shared-prefix KV caching (serve/prefix.py).
         Prompts that begin with a cached prefix (the co-pilot template,
         a chat history head) prefill only their suffix, attending over
@@ -205,6 +212,10 @@ class BatchScheduler:
         heads auto-promote after ``prefix_promote_after`` sightings."""
         if kv_mode not in ("dense", "paged"):
             raise ValueError(f"kv_mode must be dense|paged, got {kv_mode!r}")
+        if kv_quant and kv_mode != "paged":
+            raise ValueError("kv_quant=True needs kv_mode='paged' (the "
+                             "int8 pool lives in ops/paged_kv.py)")
+        self.kv_quant = kv_quant
         if admit_chunk is not None and admit_chunk < 1:
             raise ValueError(f"admit_chunk must be >= 1, got {admit_chunk}")
         self.admit_chunk = admit_chunk
@@ -223,12 +234,20 @@ class BatchScheduler:
         # HBM; override via num_pages / SERVE_PAGES.
         self.num_pages = (num_pages if num_pages is not None else
                           num_slots * -(-self.max_seq // page_size) + 1)
-        self._params = params
         self._dtype = params["embed"].dtype
         # llama or mixtral — same functional surface (models.family_for),
         # so dense and MoE configs serve through one scheduler.
         self._model = family_for(config)
         model = self._model
+        # Single-chip decode is bandwidth-bound and pays a fixed cost per
+        # weight-matmul call: fuse the column-parallel projection pairs
+        # (wq|wk|wv, w_gate|w_up) into single wider matmuls
+        # (models/llama.fuse_params — exact, works on bf16 and int8).
+        # Under a mesh the sharding rule table names the leaves
+        # separately, so fusion is single-chip only.
+        if mesh is None and hasattr(model, "fuse_params"):
+            params = model.fuse_params(params)
+        self._params = params
 
         self._slots: list[Optional[_Slot]] = [None] * num_slots
         self._waiting: list[_Slot] = []    # paged: admitted later, no pages yet
@@ -573,39 +592,58 @@ class BatchScheduler:
 
     # -- shared-prefix KV cache ----------------------------------------------
 
-    def register_prefix(self, text: str) -> int:
-        """Cache the KV of ``text``'s token head at its EXACT length.
-        Registered templates are not grain-bounded the way auto-promoted
-        heads are: the operator names finitely many templates and warmup
-        compiles their admission shapes up front, so exact lengths add no
-        unbounded compiles — and grain-snapping silently dropped real-
-        tokenizer templates shorter than the smallest grain (the co-pilot
-        template is ~18 llama-BPE tokens vs a 64-token ladder floor, so
-        the advertised default caching never engaged on real
-        checkpoints). Returns the cached prefix length in tokens (0 =
-        too short to be worth a cache entry, logged). Called from warmup
-        (before traffic) or the scheduler thread (promotion); the store
-        itself is thread-safe."""
-        if self._prefix is None:
-            return 0
+    def _registered_prefix_len(self, text: str, quiet: bool = False) -> int:
+        """Cached-entry length for a registered template (0 = won't
+        cache): the full token head minus ONE — match() requires a
+        proper prefix (>= 1 suffix token must prefill; its logits seed
+        sampling), so a full-length entry would never serve a prompt
+        that IS the template verbatim (a real workload: the same
+        question re-asked, or a fixed prompt benched repeatedly).
+        Shared by register_prefix and warmup's job planning so the two
+        cannot drift."""
         ids = self.tokenizer.encode(text, add_bos=True)
         if len(ids) < _MIN_REGISTER_PREFIX:
-            log.warning(
-                "prefix_text %r encodes to %d tokens — below the %d-token "
-                "minimum, not cached (caching would save almost nothing)",
-                text[:40], len(ids), _MIN_REGISTER_PREFIX)
+            if not quiet:
+                log.warning(
+                    "prefix_text %r encodes to %d tokens — below the "
+                    "%d-token minimum, not cached (caching would save "
+                    "almost nothing)",
+                    text[:40], len(ids), _MIN_REGISTER_PREFIX)
             return 0
-        if len(ids) + _MIN_BUCKET > self.max_seq:
+        P = len(ids) - 1
+        if P + _MIN_BUCKET > self.max_seq:
             # The admission guard rejects any prefix whose length plus
             # the smallest suffix bucket overruns max_seq — building the
             # entry would burn a prefill + an LRU slot on KV no request
             # can ever use.
-            log.warning(
-                "prefix_text %r encodes to %d tokens — too long to ever "
-                "admit under max_seq=%d, not cached",
-                text[:40], len(ids), self.max_seq)
+            if not quiet:
+                log.warning(
+                    "prefix_text %r encodes to %d tokens — too long to "
+                    "ever admit under max_seq=%d, not cached",
+                    text[:40], len(ids), self.max_seq)
             return 0
-        return self._register_prefix_ids(ids)
+        return P
+
+    def register_prefix(self, text: str) -> int:
+        """Cache the KV of ``text``'s token head at its EXACT length
+        (minus one — see _registered_prefix_len). Registered templates
+        are not grain-bounded the way auto-promoted heads are: the
+        operator names finitely many templates and warmup compiles their
+        admission shapes up front, so exact lengths add no unbounded
+        compiles — and grain-snapping silently dropped real-tokenizer
+        templates shorter than the smallest grain (the co-pilot template
+        is ~18 llama-BPE tokens vs a 64-token ladder floor, so the
+        advertised default caching never engaged on real checkpoints).
+        Returns the cached prefix length in tokens (0 = not cached,
+        logged). Called from warmup (before traffic) or the scheduler
+        thread (promotion); the store itself is thread-safe."""
+        if self._prefix is None:
+            return 0
+        P = self._registered_prefix_len(text)
+        if P <= 0:
+            return 0
+        ids = self.tokenizer.encode(text, add_bos=True)
+        return self._register_prefix_ids(ids[:P])
 
     def _register_prefix_ids(self, ids: list[int]) -> int:
         k, v = self._build_prefix_j(
@@ -717,8 +755,8 @@ class BatchScheduler:
             # the exact token length of each template being registered.
             plens = set(self._prefix.lengths())
             for text in prefix_texts:
-                n = len(self.tokenizer.encode(text, add_bos=True))
-                if n >= _MIN_REGISTER_PREFIX:
+                n = self._registered_prefix_len(text, quiet=True)
+                if n > 0:
                     plens.add(n)
             for P in sorted(plens):
                 for S in buckets:
@@ -844,7 +882,7 @@ class BatchScheduler:
             self._cache = PagedKVCache.create(
                 self.config, B, self.num_pages, self.page_size,
                 max_pages_per_row=-(-self.max_seq // self.page_size),
-                dtype=self._dtype)
+                dtype=self._dtype, quantized=self.kv_quant)
         else:
             self._cache = KVCache.create(self.config, B, self.max_seq,
                                          self._dtype)

@@ -1,0 +1,74 @@
+"""Fused-projection parity (models/llama.fuse_params).
+
+The serve scheduler fuses wq|wk|wv -> wqkv and w_gate|w_up -> wgu on
+single-chip engines (serve/scheduler.py) because decode is bandwidth-
+bound and each weight-matmul call carries a fixed cost on TPU. Fusion
+must be output-invisible: the fused weight's output columns are the
+concatenation of the originals', so prefill/decode logits must match the
+unfused forward to float tolerance, for bf16 and int8 params, dense and
+MoE families.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from p2p_llm_chat_tpu.models import llama, mixtral
+from p2p_llm_chat_tpu.models.configs import get_config
+from p2p_llm_chat_tpu.models.llama import KVCache
+from p2p_llm_chat_tpu.models.quant import QTensor, quantize_params
+
+
+@pytest.mark.parametrize("family,cfg_name", [(llama, "tiny"),
+                                             (mixtral, "tiny-moe")])
+@pytest.mark.parametrize("quant", [False, True])
+def test_fused_forward_matches_unfused(family, cfg_name, quant):
+    config = get_config(cfg_name)
+    params = family.init_params(config, jax.random.PRNGKey(0),
+                                dtype=jnp.float32)
+    if quant:
+        params = quantize_params(params)
+    fused = family.fuse_params(params)
+    assert "wqkv" in fused["layers"] and "wq" not in fused["layers"]
+    if family is llama:
+        assert "wgu" in fused["layers"]
+    else:   # MoE: per-expert ffn leaves must stay separate
+        assert "w_gate" in fused["layers"]
+    # Idempotent.
+    assert family.fuse_params(fused) is fused
+
+    B, S, max_seq = 2, 8, 32
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, config.vocab_size, (B, S)),
+                         jnp.int32)
+    lens = jnp.full((B,), S, jnp.int32)
+
+    def run(p):
+        cache = KVCache.create(config, B, max_seq, dtype=jnp.float32)
+        logits, cache = family.prefill(p, config, tokens, lens, cache)
+        dl, cache = family.decode_step(
+            p, config, jnp.argmax(logits[:, -1:], -1).astype(jnp.int32),
+            cache)
+        return np.asarray(logits), np.asarray(dl)
+
+    ref_pre, ref_dec = run(params)
+    got_pre, got_dec = run(fused)
+    np.testing.assert_allclose(got_pre, ref_pre, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(got_dec, ref_dec, atol=1e-5, rtol=1e-5)
+
+
+def test_fused_quantize_order_equivalent():
+    """quantize-then-fuse == fuse-then-quantize (per-output-channel scales
+    concatenate exactly)."""
+    config = get_config("tiny")
+    params = llama.init_params(config, jax.random.PRNGKey(1),
+                               dtype=jnp.float32)
+    a = llama.fuse_params(quantize_params(params))
+    b = quantize_params(llama.fuse_params(params))
+    qa, qb = a["layers"]["wqkv"], b["layers"]["wqkv"]
+    assert isinstance(qa, QTensor) and isinstance(qb, QTensor)
+    np.testing.assert_array_equal(np.asarray(qa.q), np.asarray(qb.q))
+    np.testing.assert_allclose(np.asarray(qa.s), np.asarray(qb.s),
+                               rtol=1e-7)

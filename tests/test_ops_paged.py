@@ -185,7 +185,7 @@ def test_parked_row_with_zero_table_writes_garbage_only():
     assert np.any(np.asarray(cache2.k[0, 0]) == 99.0)
 
 
-@pytest.mark.parametrize("impl", ["gather", "kernel"])
+@pytest.mark.parametrize("impl", ["gather", "kernel", "flash"])
 @pytest.mark.parametrize("lengths", [[1, 9, 16], [8, 8, 8], [3, 27, 1]])
 def test_kernel_matches_reference_and_dense(lengths, impl):
     """Both production implementations (gather default + Pallas kernel in
@@ -221,7 +221,7 @@ def test_kernel_matches_reference_and_dense(lengths, impl):
                                    atol=1e-5, rtol=1e-5)
 
 
-@pytest.mark.parametrize("impl", ["gather", "kernel"])
+@pytest.mark.parametrize("impl", ["gather", "kernel", "flash"])
 def test_kernel_ignores_garbage_table_entries_past_length(impl):
     """Dead page-table entries (0) beyond a row's live pages must not
     affect the result even when the page walk covers them."""
@@ -267,3 +267,74 @@ def test_write_decode_multi_out_of_table_goes_to_garbage():
     assert np.all(got[PS - 2:] == 7.0)
     # The overflow went to the garbage page.
     assert np.any(np.asarray(out.k[0, 0]) == 7.0)
+
+
+# -- int8 KV pool (quantized=True) --------------------------------------------
+
+def test_quant_kv_roundtrip_bound():
+    """Per-(slot, head) symmetric int8: |dequant - x| <= s/2 elementwise
+    (the same bound models/quant.py pins for weights)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(5, PS, CFG.num_kv_heads,
+                                     CFG.head_dim)) * 3, jnp.float32)
+    q, s = paged_kv.quant_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == x.shape[:-1]
+    err = np.abs(np.asarray(q, np.float32) * np.asarray(s)[..., None]
+                 - np.asarray(x))
+    assert np.all(err <= np.asarray(s)[..., None] / 2 + 1e-7)
+
+
+def test_quantized_pool_write_paths_and_attention():
+    """All write paths quantize transparently; gather_dense dequantizes;
+    int8 paged_attention matches the reference run on the dequantized
+    pool exactly (scale folding is algebra, not approximation) and the
+    bf16 attend within the rounding bound."""
+    rng = np.random.default_rng(1)
+    B, mppr = 3, 4
+    cache = PagedKVCache.create(CFG, B, 16, PS, max_pages_per_row=mppr,
+                                quantized=True)
+    assert cache.quantized and cache.k.dtype == jnp.int8
+    lengths = [5, PS + 3, 2 * PS]
+    # prefill splice per row (write_prefill_row path)
+    for b, n in enumerate(lengths):
+        pages = paged_kv.PageAllocator(16, PS).alloc(mppr)
+        table = jnp.asarray(np.array([3 + b * 4, 4 + b * 4, 0, 0],
+                                     np.int32))
+        rk = jnp.asarray(rng.normal(size=(CFG.num_layers, 2 * PS,
+                                          CFG.num_kv_heads, CFG.head_dim)),
+                         jnp.float32)
+        cache = paged_kv.write_prefill_row(cache, rk, rk * 0.5,
+                                           jnp.asarray(b),
+                                           jnp.asarray(n), table)
+    # decode append (write_decode path)
+    k1 = jnp.asarray(rng.normal(size=(B, CFG.num_kv_heads, CFG.head_dim)),
+                     jnp.float32)
+    cache2 = paged_kv.write_decode(cache, jnp.asarray(0), k1, k1 * 2)
+    lens = jnp.asarray(lengths, jnp.int32)
+
+    # int8 attention == reference over the dequantized pool (exact)
+    q = jnp.asarray(rng.normal(size=(B, CFG.num_heads, CFG.head_dim)),
+                    jnp.float32)
+    got = paged_attention(q, cache2.k, cache2.v, cache2.page_table,
+                          lens + 1, jnp.asarray(0), pages=mppr,
+                          k_scale=cache2.k_scale, v_scale=cache2.v_scale)
+    deq_k = (cache2.k.astype(jnp.float32)
+             * cache2.k_scale[..., None]).astype(jnp.float32)
+    deq_v = (cache2.v.astype(jnp.float32)
+             * cache2.v_scale[..., None]).astype(jnp.float32)
+    ref = paged_attention_reference(q, deq_k, deq_v, cache2.page_table,
+                                    lens + 1, 0, pages=mppr)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+    # gather_dense dequantizes to the same values the attend saw
+    kd, vd = paged_kv.gather_dense(cache2, 0, mppr * PS)
+    np.testing.assert_allclose(
+        np.asarray(kd[0, :5]),
+        np.asarray(deq_k[0][cache2.page_table[0, 0], :5]), rtol=1e-6)
+
+    # non-gather impls reject int8 pools
+    with pytest.raises(ValueError, match="gather"):
+        paged_attention(q, cache2.k, cache2.v, cache2.page_table, lens + 1,
+                        jnp.asarray(0), pages=mppr, impl="kernel",
+                        k_scale=cache2.k_scale, v_scale=cache2.v_scale)

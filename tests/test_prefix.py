@@ -82,7 +82,7 @@ def test_short_registered_template_engages():
         eng.warmup(buckets=(64,))
         store = eng.scheduler._prefix
         assert store.lengths() == [
-            len(TOK.encode("short head: ", add_bos=True))]
+            len(TOK.encode("short head: ", add_bos=True)) - 1]
         prompt = "short head: see you at ten?"
         text, _ = run(eng, prompt, max_tokens=8)
         assert text == oracle(prompt, 8)
@@ -151,9 +151,11 @@ def test_registered_template_admission_matches_oracle(kv):
         store = eng.scheduler._prefix
         assert store is not None and len(store) == 1
         P = store.lengths()[0]
-        # Registered templates cache at exact length (not ladder-snapped):
-        # the byte tokenizer encodes the 89-char template + BOS to 90 ids.
-        assert P == len(TOK.encode(SUGGEST_PREFIX, add_bos=True))
+        # Registered templates cache at exact length minus one (not
+        # ladder-snapped; the last token is left for verbatim-prompt
+        # matches): byte tokenizer encodes the 89-char template + BOS
+        # to 90 ids -> 89 cached.
+        assert P == len(TOK.encode(SUGGEST_PREFIX, add_bos=True)) - 1
 
         prompts = [SUGGEST_PREFIX + f"message {i}: see you at ten?\n\nReply:"
                    for i in range(5)]
@@ -216,8 +218,9 @@ def test_prefix_skipped_when_budget_would_overflow():
     try:
         eng.warmup(buckets=(64, 128))
         assert len(eng.scheduler._prefix) == 1
-        # Registered prefix is exact: 101 ids. 141-id prompt -> 40-token
-        # suffix -> 64 bucket; 101 + 64 = 165 > 160 max_seq -> plain path.
+        # Registered prefix caches 100 ids (101 - 1). 141-id prompt ->
+        # 41-token suffix -> 64 bucket; 100 + 64 = 164 > 160 max_seq ->
+        # plain path.
         prompt = "q" * 100 + "r" * 40
         text, _ = run(eng, prompt, max_tokens=6)
         assert text == oracle(prompt, 6)
