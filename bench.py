@@ -22,13 +22,16 @@ The reference publishes no numbers (SURVEY.md §6; BASELINE.json
 ``published: {}``), so ``vs_baseline`` is measured against the stated
 north-star target: ``150 ms / p50_ttft_ms`` (> 1.0 beats the target).
 
-The default configuration is the full serving stack — paged KV + int8
-weights + speculative decoding + shared-prefix cache — i.e. the
-framework's best composition (measured fastest on v5e: BASELINE.md's
-matrix; every feature is oracle-pinned by the test suite, so the speed
-is not traded against correctness). Set the env knobs to measure
-stripped-down variants, e.g. ``BENCH_KV=dense BENCH_QUANT= BENCH_SPEC=0
-BENCH_PREFIX=0`` for the plain bf16 dense baseline.
+The default configuration is paged KV + fused int8 weights +
+shared-prefix cache — the framework's best composition for the
+synthetic workload (measured on v5e: BASELINE.md's matrix; every
+feature is oracle-pinned by the test suite, so the speed is not traded
+against correctness). Speculative decoding defaults OFF here:
+prompt-lookup drafts cannot match a random-init model's continuations
+(0 accepted drafts measured even at greedy), so its verify forwards
+would be pure overhead on this bench — see BENCH_SPEC below. Set the
+env knobs to measure stripped-down variants, e.g. ``BENCH_KV=dense
+BENCH_QUANT= BENCH_PREFIX=0`` for the plain bf16 dense baseline.
 
 Env knobs (all optional):
 - ``BENCH_CONFIG``      model config (default bench-1b)
@@ -43,7 +46,12 @@ Env knobs (all optional):
                         read traffic, doubles pool capacity — the
                         long-context lever, ~1.6x step at W=1024)
 - ``BENCH_SPEC``        K>0 = speculative decoding with K drafts/tick
-                        (default 4; 0 disables)
+                        (default 0: prompt-lookup drafts cannot match a
+                        RANDOM-INIT model's continuations, so on the
+                        synthetic bench the verify forwards are pure
+                        overhead — measured 0 accepted drafts even at
+                        greedy. Enable for real checkpoints, where
+                        suggestion replies quote their context)
 - ``BENCH_PREFIX``      shared-prefix KV cache (default 1; 0 disables)
 - ``BENCH_TEMP``        request temperature (default 0.7; 0 = greedy —
                         the workload where prompt-lookup spec drafts
@@ -78,7 +86,7 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from p2p_llm_chat_tpu.models import llama
+    from p2p_llm_chat_tpu.models import family_for, llama
     from p2p_llm_chat_tpu.models.configs import get_config
     from p2p_llm_chat_tpu.models.llama import KVCache
     from p2p_llm_chat_tpu.serve.backend import (GenerateOptions,
@@ -99,8 +107,9 @@ def main() -> None:
         f"{slots} slots, max_seq {max_seq}")
 
     config = get_config(cfg_name)
+    family = family_for(config)   # llama or mixtral (bench-moe)
     dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
-    params = llama.init_params(config, jax.random.PRNGKey(0), dtype=dtype)
+    params = family.init_params(config, jax.random.PRNGKey(0), dtype=dtype)
     n_params = sum(x.size for x in jax.tree.leaves(params))
     quant = os.environ.get("BENCH_QUANT", "int8")    # "" | int8
     if quant == "int8":
@@ -118,7 +127,7 @@ def main() -> None:
     # matching the selected kv_mode). The serve scheduler fuses the
     # projection pairs on single-chip engines (models/llama.fuse_params),
     # so the raw step measures the same fused program.
-    raw_params = llama.fuse_params(params)
+    raw_params = family.fuse_params(params)
     if kv_mode == "paged":
         from p2p_llm_chat_tpu.ops.paged_kv import PagedKVCache
 
@@ -133,7 +142,7 @@ def main() -> None:
         num_pages = slots * mppr + 1
 
         def _step(params, tokens, cache, active):
-            return llama.decode_step_paged(params, config, tokens, cache,
+            return family.decode_step_paged(params, config, tokens, cache,
                                            active=active, pages=window_pages)
 
         def make_raw_cache():
@@ -146,7 +155,7 @@ def main() -> None:
                                   lengths=jnp.full((slots,), 64, jnp.int32))
     else:
         def _step(params, tokens, cache, active):
-            return llama.decode_step(params, config, tokens, cache,
+            return family.decode_step(params, config, tokens, cache,
                                      active=active)
 
         def make_raw_cache():
@@ -192,7 +201,7 @@ def main() -> None:
 
     # -- end-to-end serving: p50 TTFT at `slots` concurrent peers ------------
     admit_chunk = int(os.environ.get("BENCH_ADMIT_CHUNK", "0")) or None
-    spec_k = int(os.environ.get("BENCH_SPEC", "4"))
+    spec_k = int(os.environ.get("BENCH_SPEC", "0"))
     use_prefix = os.environ.get("BENCH_PREFIX", "1") not in ("", "0", "false")
     tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
     prompt = ("Draft a concise, friendly reply to the following message:\n\n"

@@ -149,6 +149,17 @@ _register(ModelConfig(
     bos_token_id=1, eos_token_ids=(2,),
 ))
 
+# ~1.2B-param MoE config (8 experts, top-2) for single-chip MoE benching:
+# measures the scatter/gather expert-dispatch cost of models/mixtral.py on
+# real hardware (BASELINE.json config 5's family; ep=1 on one chip).
+_register(ModelConfig(
+    name="bench-moe", vocab_size=32768, hidden_size=1024,
+    intermediate_size=2816, num_layers=16, num_heads=8, num_kv_heads=4,
+    head_dim=128, max_seq_len=2048, rope_theta=1e6,
+    num_experts=8, num_experts_per_tok=2, moe_capacity_factor=2.0,
+    bos_token_id=1, eos_token_ids=(2,),
+))
+
 
 def get_config(name: str) -> ModelConfig:
     try:

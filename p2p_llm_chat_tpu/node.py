@@ -20,6 +20,16 @@ the keypair; reference regenerates per start, README.md:134).
 Deliberate fix (documented surface change): ``GET /me`` returns the base58
 peer id string — the reference returns raw peer-ID bytes there
 (``string(h.ID())``, main.go:275), an acknowledged quirk (SURVEY.md §2).
+
+Directory resilience (additive — the directory is the acknowledged single
+point of failure, reference README.md:135): successful lookups are cached
+and served stale when the directory is down, so peers that have already
+talked keep exchanging messages through an outage; and the node
+re-registers on a background interval with exponential backoff
+(``NODE_REREGISTER_S``, default 30 s, 0 disables), so a restarted
+directory — it is in-memory, losing every record (SURVEY.md §2 C5) —
+relearns the node without operator action. Startup registration stays
+fatal-on-failure (main.go:184 parity).
 """
 
 from __future__ import annotations
@@ -70,6 +80,10 @@ class ChatNode:
         self.host = P2PHost(identity=ident, listen_addr=p2p_listen)
         self.inbox = Inbox(max_messages=inbox_cap)
         self.dir = DirectoryClient(self.directory_url)
+        self.reregister_s = float(env_or("NODE_REREGISTER_S", "30"))
+        self._lookup_cache: dict[str, object] = {}
+        self._cache_mu = threading.Lock()
+        self._closed = threading.Event()
         self._http: Optional[HttpServer] = None
         self.router = Router()
         self.router.add("POST", "/send", self._handle_send)
@@ -110,8 +124,20 @@ class ChatNode:
 
         try:
             rec = self.dir.lookup(to_username)          # main.go:225
+            with self._cache_mu:
+                self._lookup_cache[to_username] = rec
         except Exception as e:
-            return Response(404, {"error": f"lookup failed: {e}"})
+            # Directory down or record missing: fall back to the last
+            # known-good record so peers that have already talked keep
+            # talking through a directory outage (README.md:135 names the
+            # directory as the single point of failure; this removes it
+            # from the send path for warm pairs).
+            with self._cache_mu:
+                rec = self._lookup_cache.get(to_username)
+            if rec is None:
+                return Response(404, {"error": f"lookup failed: {e}"})
+            log.warning("directory lookup for %s failed (%s); using cached "
+                        "record", to_username, e)
 
         msg = ChatMessage(from_user=self.username, to_user=to_username,
                           content=content, timestamp=now_rfc3339())
@@ -176,9 +202,29 @@ class ChatNode:
             except Exception as e:  # noqa: BLE001
                 log.warning("bootstrap connect %s failed: %s", addr_str, e)
 
+        if self.reregister_s > 0:
+            threading.Thread(target=self._reregister_loop, daemon=True,
+                             name="reregister").start()
+
         self._http = HttpServer(self.router, self.http_addr).start()
         log.info("node %s HTTP API on %s", self.username, self._http.addr)
         return self
+
+    def _reregister_loop(self) -> None:
+        """Periodically re-register so an (in-memory, record-losing)
+        directory restart relearns this node; failures back off
+        exponentially up to 8x the interval and never crash the node —
+        only the STARTUP registration is fatal (main.go:184 parity)."""
+        delay = self.reregister_s
+        while not self._closed.wait(delay):
+            try:
+                addrs = [str(a) for a in self.host.addrs()]
+                self.dir.register(self.username, self.host.peer_id, addrs)
+                delay = self.reregister_s
+            except Exception as e:  # noqa: BLE001 — outage, keep trying
+                delay = min(delay * 2, self.reregister_s * 8)
+                log.debug("re-register failed (%s); next attempt in %.0fs",
+                          e, delay)
 
     @property
     def http_url(self) -> str:
@@ -190,6 +236,7 @@ class ChatNode:
         threading.Event().wait()
 
     def stop(self) -> None:
+        self._closed.set()
         if self._http:
             self._http.stop()
         self.host.close()

@@ -289,8 +289,8 @@ def _paged_attention_flash(q, k_pages, v_pages, page_table, lengths, layer,
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, Hq, D), lambda b, pt, ln, ly: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),   # k pool stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),   # v pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),      # k pool stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),      # v pool stays in HBM
         ],
         out_specs=pl.BlockSpec((1, Hq, D), lambda b, pt, ln, ly: (b, 0, 0)),
         scratch_shapes=[

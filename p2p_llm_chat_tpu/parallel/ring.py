@@ -22,14 +22,21 @@ Both paths are numerically identical (f32 softmax statistics) to the dense
 single-device oracle in models/llama.py — pinned by tests/test_ring.py on
 the virtual CPU mesh and the driver's ``dryrun_multichip``.
 
-The ring runs over ``sp`` only; the mesh's other axes must be size 1 on
-this path for now (TP×SP composition would shard heads inside the
-shard_map body — left until a config demands it).
+**TP×SP composition**: a ``tp`` axis alongside ``sp`` shards heads and
+the MLP intermediate Megatron-style INSIDE the shard_map body — q/k/v
+projections are column-sharded (each tp device runs the ring over its
+own kv-head group; ring hops move 1/tp of the kv bytes), and the output/
+down projections are row-sharded with one ``psum`` over ``tp`` each.
+This is the configuration a 70B-class long-context deployment needs:
+the sequence dim scales context over sp while tp keeps the per-device
+weight shard small. Params must be sharded with :func:`ring_param_specs`
+(embed/lm_head replicated — the vocab-sharded embedding gather is not
+worth the masked-gather+psum inside this path). The MoE ``mlp_fn`` path
+stays sp-only (expert dispatch under tp here is future work).
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -38,9 +45,62 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from ..models.configs import ModelConfig
-from ..models.layers import NEG_INF, rms_norm, rope_frequencies
-from ..models.llama import KVCache, _attn_qkv, _post_attn
+from ..models.layers import NEG_INF, apply_rope, rms_norm, rope_frequencies
+from ..models.llama import KVCache
 from ..models.quant import mm
+from .sharding import DEFAULT_RULES, tree_specs
+
+# Logical rules for the ring path: attention/MLP tp-sharded as usual,
+# embeddings and lm_head replicated (the device_fn gathers/projects the
+# full vocab; h is tp-replicated after each block's psum).
+RING_RULES = dict(DEFAULT_RULES, vocab=None, act_vocab=None)
+
+
+def ring_param_specs(axes_tree) -> object:
+    """PartitionSpec tree for ring-path params (models/*.param_axes ->
+    specs under RING_RULES). Shard params with these before calling
+    ring_prefill/sp_decode_step on a tp>1 mesh; the shard_map in_specs
+    use the same tree, so layouts always agree."""
+    return tree_specs(axes_tree, RING_RULES)
+
+
+def _attn_qkv_local(h, lp, config: ModelConfig, inv_freq, positions):
+    """Pre-norm + q/k/v projections + rope on LOCAL head shards: under
+    tp the weight columns arriving here are this device's head group, so
+    head counts come from the projection widths, not config (llama's
+    _attn_qkv reshapes with the global config.num_heads)."""
+    B, S, _ = h.shape
+    D = config.head_dim
+    x = rms_norm(h, lp["attn_norm"], config.rms_norm_eps)
+    q = mm(x, lp["wq"]).reshape(B, S, -1, D)
+    k = mm(x, lp["wk"]).reshape(B, S, -1, D)
+    v = mm(x, lp["wv"]).reshape(B, S, -1, D)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    return q, k, v
+
+
+def _post_attn_tp(h, attn, lp, config: ModelConfig, mlp_fn,
+                  tp_axis: Optional[str]):
+    """Output projection + residual + MLP + residual with row-sharded
+    wo/w_down under tp: one psum after each row-sharded matmul (the
+    Megatron pattern, written explicitly because shard_map bodies use
+    collectives, not sharding constraints)."""
+    B, S = attn.shape[:2]
+    attn = attn.reshape(B, S, -1)
+    o = mm(attn, lp["wo"])
+    if tp_axis is not None:
+        o = jax.lax.psum(o, tp_axis)
+    h = h + o
+    x = rms_norm(h, lp["mlp_norm"], config.rms_norm_eps)
+    if mlp_fn is not None:
+        mlp = mlp_fn(x, lp, None, {})
+    else:
+        g = jax.nn.silu(mm(x, lp["w_gate"])) * mm(x, lp["w_up"])
+        mlp = mm(g, lp["w_down"])
+        if tp_axis is not None:
+            mlp = jax.lax.psum(mlp, tp_axis)
+    return h + mlp
 
 
 def _chunk_scores(q: jax.Array, k: jax.Array) -> jax.Array:
@@ -106,6 +166,11 @@ def _ring_attend(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.transpose(0, 3, 1, 2, 4).reshape(B, Sl, Hq, D).astype(q.dtype)
 
 
+def _axes_for(config: ModelConfig):
+    from ..models import family_for
+    return family_for(config).param_axes(config)
+
+
 def ring_prefill(params: dict, config: ModelConfig, tokens: jax.Array,
                  prompt_lens: jax.Array, mesh: Mesh,
                  mlp_fn=None) -> tuple[jax.Array, KVCache]:
@@ -122,16 +187,20 @@ def ring_prefill(params: dict, config: ModelConfig, tokens: jax.Array,
     slots invisible to real queries; lengths gate decode.
     """
     sp = mesh.shape["sp"]
-    assert mesh.size == sp, (
-        f"ring path runs over sp only (mesh {dict(mesh.shape)}); "
+    tp = mesh.shape.get("tp", 1)
+    assert mesh.size == sp * tp, (
+        f"ring path runs over sp (x tp) only (mesh {dict(mesh.shape)}); "
         "set other axes to 1")
+    assert tp == 1 or mlp_fn is None, "MoE ring is sp-only (no tp yet)"
+    assert config.num_kv_heads % tp == 0, (config.num_kv_heads, tp)
     B, S = tokens.shape
     assert S % sp == 0, f"seq {S} not divisible by sp={sp}"
     Sl = S // sp
     inv_freq = rope_frequencies(config)
+    tp_axis = "tp" if tp > 1 else None
 
     def device_fn(params, tokens):
-        # tokens: local chunk [B, Sl]
+        # tokens: local chunk [B, Sl]; params: local tp head shards.
         my = jax.lax.axis_index("sp")
         positions = (my * Sl + jnp.arange(Sl))[None, :].astype(jnp.int32)
         positions = jnp.broadcast_to(positions, (B, Sl))
@@ -140,17 +209,16 @@ def ring_prefill(params: dict, config: ModelConfig, tokens: jax.Array,
         def body(carry, xs):
             h, ck, cv = carry
             lp, layer = xs
-            q, k, v = _attn_qkv(h, lp, config, inv_freq, positions,
-                                None, {})
+            q, k, v = _attn_qkv_local(h, lp, config, inv_freq, positions)
             ck = jax.lax.dynamic_update_index_in_dim(ck, k, layer, 0)
             cv = jax.lax.dynamic_update_index_in_dim(cv, v, layer, 0)
             attn = _ring_attend(q, k, v, "sp", sp)
-            h = _post_attn(h, attn, lp, config, None, {}, mlp_fn)
+            h = _post_attn_tp(h, attn, lp, config, mlp_fn, tp_axis)
             return (h, ck, cv), None
 
         L = config.num_layers
-        ck = jnp.zeros((L, B, Sl, config.num_kv_heads, config.head_dim),
-                       h.dtype)
+        ck = jnp.zeros((L, B, Sl, config.num_kv_heads // tp,
+                        config.head_dim), h.dtype)
         (h, ck, cv), _ = jax.lax.scan(
             body, (h, ck, jnp.zeros_like(ck)),
             (params["layers"], jnp.arange(L)))
@@ -162,10 +230,11 @@ def ring_prefill(params: dict, config: ModelConfig, tokens: jax.Array,
 
     mapped = shard_map(
         device_fn, mesh=mesh,
-        in_specs=(P(), P(None, "sp")),
+        in_specs=(ring_param_specs(_axes_for(config)),
+                  P(None, "sp")),
         out_specs=(P(None, "sp", None),
-                   P(None, None, "sp", None, None),
-                   P(None, None, "sp", None, None)),
+                   P(None, None, "sp", "tp" if tp > 1 else None, None),
+                   P(None, None, "sp", "tp" if tp > 1 else None, None)),
         check_rep=False,
     )
     logits, ck, cv = mapped(params, tokens)
@@ -187,25 +256,27 @@ def sp_decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
     Returns (logits [B,1,vocab] — replicated — and the advanced cache).
     """
     sp = mesh.shape["sp"]
-    assert mesh.size == sp, "sp-only path; see ring_prefill"
+    tp = mesh.shape.get("tp", 1)
+    assert mesh.size == sp * tp, "sp (x tp) path; see ring_prefill"
+    assert tp == 1 or mlp_fn is None, "MoE ring is sp-only (no tp yet)"
     B = tokens.shape[0]
     Sl = cache.k.shape[2] // sp
     inv_freq = rope_frequencies(config)
+    tp_axis = "tp" if tp > 1 else None
 
     def device_fn(params, tokens, ck_all, cv_all, lengths):
         my = jax.lax.axis_index("sp")
         positions = lengths[:, None]                        # [B,1] global
         h = params["embed"][tokens]
-        G, D = config.num_kv_heads, config.head_dim
-        rep = config.num_heads // G
+        G, D = config.num_kv_heads // tp, config.head_dim
+        rep = config.num_heads // config.num_kv_heads
         local_pos = jnp.arange(Sl) + my * Sl                # [Sl] global
         b_idx = jnp.arange(B)
 
         def body(carry, xs):
             h, ck, cv = carry
             lp, layer = xs
-            q, k, v = _attn_qkv(h, lp, config, inv_freq, positions,
-                                None, {})
+            q, k, v = _attn_qkv_local(h, lp, config, inv_freq, positions)
             # Scatter the new k/v at the owning device; everyone else's
             # local index is out of [0, Sl) and mode="drop" discards it.
             li = lengths - my * Sl                          # [B] local slot
@@ -233,7 +304,7 @@ def sp_decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
             out = acc_g / l_g[..., None]                    # [B,G,rep,1,D]
             attn = out.transpose(0, 3, 1, 2, 4).reshape(
                 B, 1, G * rep, D).astype(h.dtype)
-            h = _post_attn(h, attn, lp, config, None, {}, mlp_fn)
+            h = _post_attn_tp(h, attn, lp, config, mlp_fn, tp_axis)
             return (h, ck, cv), None
 
         (h, ck_all, cv_all), _ = jax.lax.scan(
@@ -245,12 +316,12 @@ def sp_decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
         logits = mm(h, lm_head).astype(jnp.float32)
         return logits, ck_all, cv_all
 
+    kv_spec = P(None, None, "sp", "tp" if tp > 1 else None, None)
     mapped = shard_map(
         device_fn, mesh=mesh,
-        in_specs=(P(), P(), P(None, None, "sp", None, None),
-                  P(None, None, "sp", None, None), P()),
-        out_specs=(P(), P(None, None, "sp", None, None),
-                   P(None, None, "sp", None, None)),
+        in_specs=(ring_param_specs(_axes_for(config)), P(), kv_spec,
+                  kv_spec, P()),
+        out_specs=(P(), kv_spec, kv_spec),
         check_rep=False,
     )
     logits, ck, cv = mapped(params, tokens, cache.k, cache.v, cache.lengths)

@@ -39,6 +39,8 @@ from .p2p.transport import (
     RELAY_INCOMING,
     RELAY_PING,
     RELAY_PONG,
+    RELAY_PUNCH,
+    RELAY_PUNCH_ACK,
     RELAY_RESERVE,
     recv_json_frame,
     send_json_frame,
@@ -71,6 +73,12 @@ class _PendingCircuit:
     target_sock: Optional[socket.socket] = None
 
 
+@dataclass
+class _PendingPunch:
+    event: threading.Event = field(default_factory=threading.Event)
+    target_udp: Optional[list] = None
+
+
 class RelayService:
     def __init__(self, addr: Optional[str] = None,
                  max_reservations: Optional[int] = None,
@@ -95,9 +103,13 @@ class RelayService:
         self.sweep_interval_s = sweep_interval_s
         self._reservations: dict[str, _Reservation] = {}
         self._pending: dict[str, _PendingCircuit] = {}
+        self._pending_punch: dict[str, _PendingPunch] = {}
         self._active_circuits = 0
+        self._n_spliced = 0          # circuits ever spliced (punch tests
+        #                              assert direct paths keep this at 0)
         self._mu = threading.Lock()
         self._server: Optional[socket.socket] = None
+        self._udp: Optional[socket.socket] = None
         self._closed = threading.Event()
 
     @property
@@ -114,6 +126,23 @@ class RelayService:
         s.listen(128)
         self._port = s.getsockname()[1]
         self._server = s
+        # STUN-lite UDP endpoint on the same port: answers "observe"
+        # datagrams with the source address it saw, so NAT'd peers learn
+        # their post-NAT UDP endpoint for hole punching (p2p/udp.py).
+        # Best-effort: observe is an optional additive feature with a
+        # graceful client fallback (observe_udp_addr tolerates silence),
+        # so an unrelated process squatting the UDP port must not take
+        # down circuit relaying.
+        try:
+            u = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            u.bind((self._host, self._port))
+            self._udp = u
+            threading.Thread(target=self._udp_observe_loop,
+                             daemon=True).start()
+        except OSError as e:
+            log.warning("UDP observe endpoint unavailable on port %d (%s); "
+                        "hole-punch endpoint discovery disabled", self._port, e)
+            self._udp = None
         threading.Thread(target=self._accept_loop, daemon=True).start()
         threading.Thread(target=self._sweep_loop, daemon=True).start()
         # Print multiaddrs like the reference does (go/cmd/relay/main.go:40-45).
@@ -122,6 +151,11 @@ class RelayService:
 
     def stop(self) -> None:
         self._closed.set()
+        if self._udp is not None:
+            try:
+                self._udp.close()
+            except OSError:
+                pass
         if self._server is not None:
             try:
                 self._server.shutdown(socket.SHUT_RDWR)
@@ -144,6 +178,26 @@ class RelayService:
         threading.Event().wait()    # block forever (go/cmd/relay/main.go:46)
 
     # -- connection handling -------------------------------------------------
+
+    def _udp_observe_loop(self) -> None:
+        assert self._udp is not None
+        while not self._closed.is_set():
+            try:
+                data, addr = self._udp.recvfrom(2048)
+            except OSError:
+                return
+            try:
+                msg = json.loads(data.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if msg.get("type") == "observe":
+                try:
+                    self._udp.sendto(json.dumps({
+                        "ok": True, "nonce": msg.get("nonce"),
+                        "addr": [addr[0], addr[1]],
+                    }).encode(), addr)
+                except OSError:
+                    pass
 
     def _accept_loop(self) -> None:
         assert self._server is not None
@@ -168,6 +222,8 @@ class RelayService:
                 self._handle_hop(conn, msg)
             elif mtype == RELAY_ACCEPT:
                 self._handle_accept(conn, msg)
+            elif mtype == RELAY_PUNCH:
+                self._handle_punch(conn, msg)
             else:
                 send_json_frame(conn, {"ok": False, "error": "unknown type"})
                 conn.close()
@@ -224,13 +280,21 @@ class RelayService:
         # RELAY_INCOMING forever once the OS send buffer fills.
         conn.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO,
                         struct.pack("ll", int(ACCEPT_TIMEOUT_S), 0))
-        # Keep reading the control channel (pongs / detect close).
+        # Keep reading the control channel (pongs, punch acks, detect
+        # close).
         try:
             while not self._closed.is_set():
                 m = recv_json_frame(conn)
                 if m is None:
                     break
                 res.last_seen = time.time()
+                if m.get("type") == RELAY_PUNCH_ACK:
+                    with self._mu:
+                        pp = self._pending_punch.get(
+                            str(m.get("punch_id") or ""))
+                    if pp is not None:
+                        pp.target_udp = m.get("udp_addr")
+                        pp.event.set()
         except (OSError, ValueError, json.JSONDecodeError):
             pass
         with self._mu:
@@ -306,6 +370,51 @@ class RelayService:
         send_json_frame(conn, {"ok": True})
         self._splice(conn, pending.target_sock)
 
+    def _handle_punch(self, conn: socket.socket, msg: dict) -> None:
+        """Hole-punch coordination: forward the dialer's observed UDP
+        endpoint to the target's control channel, wait for the target's
+        ack carrying ITS observed endpoint, and return it to the dialer.
+        The relay carries only this exchange — the handshake and message
+        bytes then flow directly between the peers' UDP sockets."""
+        target = str(msg.get("target") or "")
+        udp_addr = msg.get("udp_addr")
+        if (not isinstance(udp_addr, list) or len(udp_addr) != 2):
+            send_json_frame(conn, {"ok": False, "error": "bad udp_addr"})
+            conn.close()
+            return
+        with self._mu:
+            res = self._reservations.get(target)
+            if res is None:
+                send_json_frame(conn, {"ok": False,
+                                       "error": "no reservation for target"})
+                conn.close()
+                return
+            punch_id = uuid.uuid4().hex
+            pending = _PendingPunch()
+            self._pending_punch[punch_id] = pending
+        try:
+            with res.send_lock:
+                send_json_frame(res.sock, {
+                    "type": RELAY_PUNCH, "punch_id": punch_id,
+                    "udp_addr": [str(udp_addr[0]), int(udp_addr[1])],
+                })
+            if not pending.event.wait(ACCEPT_TIMEOUT_S):
+                send_json_frame(conn, {"ok": False,
+                                       "error": "target did not punch"})
+                conn.close()
+                return
+            send_json_frame(conn, {"ok": True,
+                                   "udp_addr": pending.target_udp})
+            conn.close()
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        finally:
+            with self._mu:
+                self._pending_punch.pop(punch_id, None)
+
     def _handle_accept(self, conn: socket.socket, msg: dict) -> None:
         conn_id = str(msg.get("conn_id") or "")
         with self._mu:
@@ -328,6 +437,7 @@ class RelayService:
         idle-timeout and half-close semantics either way."""
         with self._mu:
             self._active_circuits += 1
+            self._n_spliced += 1
         lib = native.load("net_splice")
         if lib is not None:
             import ctypes

@@ -30,13 +30,16 @@ Env surface (reference-style env-first config, utils/env.py):
 ``SERVE_PREFIX_TEXTS`` (extra templates to pre-register, ``||``-separated;
 the reference co-pilot template is always registered),
 ``SERVE_MODELS`` (multi-model serving, serve/multi.py:
-``tag=config,...`` — one independent engine per tag, requests route by
-their model field; exclusive with CKPT_DIR).
+``tag=ref,...`` where ref is a config name OR a checkpoint directory —
+one independent engine per tag with its own weights/tokenizer/KV pool,
+requests route by their model field; a CKPT_DIR alongside becomes the
+default entry under LLM_MODEL's tag).
 """
 
 from __future__ import annotations
 
 import threading
+import os
 from typing import Iterator, Optional
 
 import jax
@@ -266,39 +269,77 @@ def build_engine_from_env() -> Backend:
             return None
         return tuple(int(b) for b in warmup.split(",") if b.strip())
 
-    # Multi-model serving (serve/multi.py): SERVE_MODELS=tag=config,...
+    def load_ckpt_engine(tag: str, path: str) -> TPUEngine:
+        """One fully-independent engine from a checkpoint dir: its own
+        params, its own tokenizer, its own scheduler/KV pool — engines
+        share nothing but the HTTP front."""
+        from ..models.checkpoint import is_native_checkpoint
+        if is_native_checkpoint(path):
+            from ..models.checkpoint import load_checkpoint as load_native
+            params, config = load_native(path, mesh=mesh)
+        elif mesh is not None:
+            # Mesh loads are the big-model path: stream tensors straight
+            # into the sharded device tree so host RAM never holds the
+            # checkpoint (the 70B memory-fit requirement).
+            from ..models.weights import load_checkpoint_streaming
+            params, config = load_checkpoint_streaming(path, mesh=mesh)
+        else:
+            params, config = load_checkpoint(path, mesh=mesh)
+        tokenizer = load_tokenizer(path, vocab_size=config.vocab_size)
+        if quant:
+            from ..models.quant import quantize_params
+            params = quantize_params(params, mesh=mesh)
+        return make_engine(params, config, tokenizer, name=tag)
+
+    # Multi-model serving (serve/multi.py): SERVE_MODELS=tag=ref,...
     # builds one independent engine per tag behind one front; requests
-    # route by their model field. Checkpoints are a single-model affair
-    # (CKPT_DIR names one weight set), so the two are exclusive.
+    # route by their model field. A ref is a registered config name
+    # (random-init, byte tokenizer — the routing-demo path) or a
+    # checkpoint directory (real weights + its own tokenizer). CKPT_DIR
+    # composes: it becomes the default entry under LLM_MODEL's tag.
     models_spec = env_or("SERVE_MODELS", "")
     if models_spec:
-        if ckpt_dir:
-            raise SystemExit("SERVE_MODELS and CKPT_DIR are mutually "
-                             "exclusive (a checkpoint names one model)")
         from .multi import MultiBackend
         # Validate the whole spec BEFORE building anything: each engine
         # starts a live scheduler thread, so a bad later entry must not
         # leak earlier ones (and a duplicate tag must not silently drop
         # a fully-started engine).
         specs: list[tuple[str, str]] = []
+        if ckpt_dir:
+            specs.append((env_or("LLM_MODEL", "default"), ckpt_dir))
         for part in models_spec.split(","):
             part = part.strip()
             if not part:
                 continue
-            tag, _, cfg_name = part.partition("=")
+            tag, _, ref = part.partition("=")
             if not tag:
                 raise SystemExit(f"SERVE_MODELS entry {part!r} has an "
                                  "empty tag")
             if any(t == tag for t, _ in specs):
                 raise SystemExit(f"SERVE_MODELS has duplicate tag {tag!r}")
-            specs.append((tag, cfg_name or tag))
-        configs = [(tag, get_config(cfg_name)) for tag, cfg_name in specs]
+            specs.append((tag, ref or tag))
+        for tag, ref in specs:
+            if os.sep in ref or os.path.isdir(ref):
+                if not os.path.isdir(ref):
+                    raise SystemExit(
+                        f"SERVE_MODELS entry {tag}={ref}: no such "
+                        "checkpoint directory")
+            else:
+                try:
+                    get_config(ref)
+                except KeyError as e:
+                    raise SystemExit(f"SERVE_MODELS entry {tag}={ref}: "
+                                     f"{e}") from None
         backends: dict = {}
-        for i, (tag, config) in enumerate(configs):
-            tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
-            backends[tag] = make_engine(random_init_params(config, i),
-                                        config, tokenizer, name=tag)
-        multi = MultiBackend(backends)
+        for i, (tag, ref) in enumerate(specs):
+            if os.sep in ref or os.path.isdir(ref):
+                backends[tag] = load_ckpt_engine(tag, ref)
+            else:
+                config = get_config(ref)
+                tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
+                backends[tag] = make_engine(random_init_params(config, i),
+                                            config, tokenizer, name=tag)
+        multi = MultiBackend(backends, default=specs[0][0])
         log.info("multi-model serving: %s", ", ".join(multi.models()))
         buckets = warmup_buckets()
         if buckets:

@@ -215,6 +215,19 @@ class BatchScheduler:
         if kv_quant and kv_mode != "paged":
             raise ValueError("kv_quant=True needs kv_mode='paged' (the "
                              "int8 pool lives in ops/paged_kv.py)")
+        if kv_quant:
+            # ops/__init__ rebinds the `paged_attention` attribute to the
+            # FUNCTION, so module access must go through importlib.
+            import importlib
+            _pa = importlib.import_module(
+                "p2p_llm_chat_tpu.ops.paged_attention")
+            if _pa._DEFAULT_IMPL != "gather":
+                # Fail at construction, not on the scheduler thread at
+                # the first decode tick (which would strand queued
+                # requests until their timeout).
+                raise ValueError(
+                    "kv_quant=True requires the gather attention impl; "
+                    f"PAGED_ATTN_IMPL={_pa._DEFAULT_IMPL!r} is set")
         self.kv_quant = kv_quant
         if admit_chunk is not None and admit_chunk < 1:
             raise ValueError(f"admit_chunk must be >= 1, got {admit_chunk}")

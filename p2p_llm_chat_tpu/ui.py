@@ -26,6 +26,7 @@ LLM so the browser needs no CORS setup.
 
 from __future__ import annotations
 
+import json
 import threading
 import urllib.parse
 from importlib import resources
@@ -62,6 +63,7 @@ class ChatUI:
         self.router.add("GET", "/config.json", lambda r: Response(200, {
             "node_http": self.node_http, "llm_model": self.llm_model}))
         self.router.add("POST", "/api/suggest", self._suggest)
+        self.router.add("POST", "/api/suggest/stream", self._suggest_stream)
         self.router.add("GET", "/node/me", self._proxy_node_get("/me"))
         self.router.add("GET", "/node/inbox", self._proxy_node_get("/inbox"))
         self.router.add("POST", "/node/send", self._proxy_node_post("/send"))
@@ -95,6 +97,59 @@ class ChatUI:
         except Exception as e:  # noqa: BLE001
             suggestion = f"(LLM unavailable: {e})"           # :100-101
         return Response(200, {"suggestion": suggestion})
+
+    def _suggest_stream(self, req: Request) -> Response:
+        """Streaming co-pilot suggestions: the serve stack already
+        streams NDJSON (serve/api.py); this forwards its deltas to the
+        browser as ``{"delta", "done"}`` lines so suggestion text appears
+        incrementally instead of after the full generation. The
+        non-streaming ``/api/suggest`` keeps the reference's buffered
+        contract (streamlit_app.py:89-101) for stream:false clients."""
+        import urllib.request
+
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        content = str(body.get("content") or "")
+
+        def gen():
+            try:
+                data = json.dumps({
+                    "model": self.llm_model,
+                    "prompt": SUGGEST_TEMPLATE.format(msg=content),
+                    "stream": True,
+                }).encode("utf-8")
+                r = urllib.request.Request(
+                    f"{self.ollama_url}/api/generate", data=data,
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with urllib.request.urlopen(r, timeout=LLM_TIMEOUT_S) as resp:
+                    for line in resp:
+                        try:
+                            obj = json.loads(line)
+                        except ValueError:
+                            continue
+                        done = bool(obj.get("done"))
+                        yield (json.dumps({
+                            "delta": str(obj.get("response", "")),
+                            "done": done,
+                        }) + "\n").encode("utf-8")
+                        if done:
+                            return
+                yield (json.dumps({"delta": "", "done": True})
+                       + "\n").encode("utf-8")
+            except Exception as e:  # noqa: BLE001 — same degradation
+                # strings as the buffered path (streamlit_app.py:100-101);
+                # error:true lets the browser treat the text as a failure
+                # marker instead of appending it to a partial suggestion.
+                yield (json.dumps({
+                    "delta": f"(LLM unavailable: {e})", "done": True,
+                    "error": True,
+                }) + "\n").encode("utf-8")
+
+        return Response(200, stream=gen(),
+                        content_type="application/x-ndjson")
 
     def _proxy_node_get(self, path: str):
         def handler(req: Request) -> Response:

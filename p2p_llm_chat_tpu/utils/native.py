@@ -19,7 +19,7 @@ from .log import get_logger
 
 log = get_logger("native")
 
-_NATIVE_DIR = os.path.join(
+_NATIVE_DIR = os.environ.get("NATIVE_LIB_DIR") or os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native")
 

@@ -81,3 +81,62 @@ def test_engine_env_autodetects_native(tmp_path, monkeypatch):
         assert eng.config.name == CFG.name
     finally:
         eng.stop()
+
+
+def test_serve_models_entries_name_checkpoint_dirs(tmp_path, monkeypatch):
+    """Multi-model serving with REAL checkpoints: SERVE_MODELS entries
+    name checkpoint directories (tag=/path), each engine loads its own
+    weights + tokenizer, requests route per tag, and a CKPT_DIR
+    alongside becomes the default entry (the old mutual exclusivity is
+    gone)."""
+    from p2p_llm_chat_tpu.serve.backend import (GenerateOptions,
+                                                GenerateRequest,
+                                                RequestStats)
+    from p2p_llm_chat_tpu.serve.engine import build_engine_from_env
+
+    params_b = llama.init_params(CFG, jax.random.PRNGKey(7),
+                                 dtype=jnp.float32)
+    d_a = str(tmp_path / "alpha")
+    d_b = str(tmp_path / "beta")
+    checkpoint.save_checkpoint(d_a, PARAMS, CFG)
+    checkpoint.save_checkpoint(d_b, params_b, CFG)
+
+    monkeypatch.setenv("SERVE_MODELS", f"alpha={d_a},beta={d_b}")
+    monkeypatch.setenv("SERVE_SLOTS", "2")
+    monkeypatch.setenv("SERVE_MAX_SEQ", "64")
+    monkeypatch.setenv("SERVE_WARMUP", "0")
+    eng = build_engine_from_env()
+    try:
+        assert sorted(eng.models()) == ["alpha", "beta"]
+
+        def gen(tag):
+            req = GenerateRequest(prompt="route me", model=tag,
+                                  options=GenerateOptions(max_tokens=6,
+                                                          temperature=0.0))
+            return "".join(eng.generate_stream(req, RequestStats()))
+
+        out_a, out_b = gen("alpha"), gen("beta")
+        # Different weights behind the two tags -> different greedy text.
+        assert out_a != out_b
+        # Unknown tags fall back to the default (first entry).
+        assert gen("nosuch") == out_a
+    finally:
+        eng.stop()
+
+    # CKPT_DIR composes with SERVE_MODELS as the default entry.
+    monkeypatch.setenv("CKPT_DIR", d_a)
+    monkeypatch.setenv("LLM_MODEL", "base")
+    monkeypatch.setenv("SERVE_MODELS", f"beta={d_b}")
+    eng = build_engine_from_env()
+    try:
+        assert sorted(eng.models()) == ["base", "beta"]
+    finally:
+        eng.stop()
+
+
+def test_serve_models_rejects_missing_checkpoint_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("SERVE_MODELS", f"x={tmp_path}/nope")
+    monkeypatch.setenv("SERVE_WARMUP", "0")
+    from p2p_llm_chat_tpu.serve.engine import build_engine_from_env
+    with pytest.raises(SystemExit, match="no such checkpoint"):
+        build_engine_from_env()

@@ -104,3 +104,85 @@ def test_send_to_stale_peer_is_502(two_nodes):
                   {"to_username": "cannan", "content": "anyone home?"},
                   timeout=15.0)
     assert e.value.status == 502
+
+
+def test_warm_peers_survive_directory_outage():
+    """Directory resilience: after one successful exchange, killing the
+    directory (the acknowledged single point of failure, reference
+    README.md:135) must not break sends between the warm pair — lookups
+    serve the cached record."""
+    directory = DirectoryService(addr="127.0.0.1:0").start()
+    a = ChatNode(username="najy", http_addr="127.0.0.1:0",
+                 directory_url=directory.url, bootstrap_addrs="",
+                 relay_addrs="", identity_file="").start()
+    b = ChatNode(username="cannan", http_addr="127.0.0.1:0",
+                 directory_url=directory.url, bootstrap_addrs="",
+                 relay_addrs="", identity_file="").start()
+    try:
+        status, resp = http_json("POST", f"{a.http_url}/send",
+                                 {"to_username": "cannan",
+                                  "content": "warmup"})
+        assert status == 200
+        _wait_inbox(b.http_url, 1)
+
+        directory.stop()            # outage
+
+        status, resp = http_json("POST", f"{a.http_url}/send",
+                                 {"to_username": "cannan",
+                                  "content": "through the outage"})
+        assert status == 200, resp
+        inbox = _wait_inbox(b.http_url, 2)
+        assert inbox[-1]["content"] == "through the outage"
+
+        # A pair that never talked has no cache: still a clean 404.
+        status, resp = http_json("POST", f"{b.http_url}/send",
+                                 {"to_username": "nobody", "content": "x"},
+                                 raise_for_status=False)
+        assert status == 404
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_reregister_repopulates_restarted_directory():
+    """The directory is in-memory (loses every record on restart,
+    SURVEY.md §2 C5): nodes re-register on an interval so a restarted
+    directory relearns them without operator action."""
+    directory = DirectoryService(addr="127.0.0.1:0").start()
+    port = int(directory.url.rsplit(":", 1)[1])
+    import os
+    os.environ["NODE_REREGISTER_S"] = "0.3"
+    try:
+        a = ChatNode(username="najy", http_addr="127.0.0.1:0",
+                     directory_url=directory.url, bootstrap_addrs="",
+                     relay_addrs="", identity_file="").start()
+    finally:
+        del os.environ["NODE_REREGISTER_S"]
+    try:
+        directory.stop()
+        # Restart on the same port with an empty map.
+        deadline = time.time() + 5
+        directory2 = None
+        while directory2 is None and time.time() < deadline:
+            try:
+                directory2 = DirectoryService(
+                    addr=f"127.0.0.1:{port}").start()
+            except OSError:
+                time.sleep(0.1)
+        assert directory2 is not None, "port never freed"
+        deadline = time.time() + 5
+        found = None
+        while time.time() < deadline:
+            try:
+                _, found = http_json(
+                    "GET", f"{directory2.url}/lookup?username=najy")
+                break
+            except HttpError:
+                time.sleep(0.1)
+        assert found is not None and found["peer_id"] == a.host.peer_id
+    finally:
+        a.stop()
+        try:
+            directory2.stop()
+        except Exception:
+            pass

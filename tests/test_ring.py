@@ -118,10 +118,47 @@ def test_sp_decode_matches_dense_decode():
 
 
 def test_ring_prefill_rejects_mixed_mesh():
-    mesh = make_mesh(MeshConfig(sp=2, tp=2))
+    # dp has no meaning on the ring path (sp x tp only).
+    mesh = make_mesh(MeshConfig(dp=2, sp=2))
     params = _params()
     with pytest.raises(AssertionError):
         ring_prefill(params, CFG, _tokens(2, 16), jnp.array([16, 16]), mesh)
+
+
+@pytest.mark.parametrize("tp,sp", [(2, 4), (2, 2)])
+def test_ring_tp_sp_composition_matches_dense(tp, sp):
+    """Ring attention with heads tensor-parallel INSIDE the shard_map
+    body (the 70B-class long-context configuration): prefill + decode
+    over a tp x sp mesh must match the dense single-device oracle."""
+    mesh = make_mesh(MeshConfig(tp=tp, sp=sp))
+    params = _params()
+    B, steps = 2, 3
+    S = 8 * sp
+    prompt_len = S - steps - 1
+    rng = np.random.default_rng(3)
+    tokens = np.zeros((B, S), np.int32)
+    tokens[:, :prompt_len] = rng.integers(0, CFG.vocab_size,
+                                          (B, prompt_len))
+    tokens = jnp.asarray(tokens)
+    lens = jnp.full((B,), prompt_len, jnp.int32)
+
+    cache = KVCache.create(CFG, B, S, dtype=jnp.float32)
+    ref, ref_cache = llama.prefill(params, CFG, tokens[:, :prompt_len],
+                                   lens, cache)
+    got, got_cache = ring_prefill(params, CFG, tokens, lens, mesh)
+    np.testing.assert_allclose(np.asarray(got)[:, :prompt_len],
+                               np.asarray(ref), atol=2e-4, rtol=2e-3)
+
+    nxt = jnp.argmax(np.asarray(ref)[:, prompt_len - 1], -1).astype(
+        jnp.int32)[:, None]
+    for _ in range(steps):
+        ref_l, ref_cache = llama.decode_step(params, CFG, nxt, ref_cache)
+        got_l, got_cache = sp_decode_step(params, CFG, nxt, got_cache,
+                                          mesh)
+        np.testing.assert_allclose(np.asarray(got_l), np.asarray(ref_l),
+                                   atol=2e-4, rtol=2e-3)
+        nxt = jnp.argmax(np.asarray(ref_l)[:, 0], -1).astype(
+            jnp.int32)[:, None]
 
 
 def test_ring_composes_with_int8_weights():

@@ -103,3 +103,59 @@ def test_index_served(stack):
 def test_me_proxy(stack):
     status, me = http_json("GET", f"{stack['ui_a'].url}/node/me")
     assert status == 200 and me["username"] == "najy"
+
+
+def test_suggest_stream_delivers_incremental_ndjson(stack):
+    """/api/suggest/stream forwards the serve stack's streamed deltas as
+    NDJSON {"delta","done"} lines; concatenated deltas equal the buffered
+    /api/suggest result for the same content."""
+    import json
+    import urllib.request
+
+    ui = stack["ui_b"]
+    content = "see you at ten?"
+    req = urllib.request.Request(
+        f"{ui.url}/api/suggest/stream",
+        data=json.dumps({"content": content}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    lines = []
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers.get("Content-Type", "").startswith(
+            "application/x-ndjson")
+        for line in resp:
+            if line.strip():
+                lines.append(json.loads(line))
+    assert lines, "no NDJSON lines streamed"
+    assert lines[-1]["done"] is True
+    assert all(l["done"] is False for l in lines[:-1])
+    streamed = "".join(l["delta"] for l in lines).strip()
+
+    _, buffered = http_json("POST", f"{ui.url}/api/suggest",
+                            {"content": content})
+    assert streamed == buffered["suggestion"]
+    # More than one delta line = genuinely incremental (FakeLLM streams
+    # token-by-token through serve/api.py).
+    assert len(lines) > 1
+
+
+def test_suggest_stream_degrades_when_llm_down(stack):
+    import json
+    import urllib.request
+
+    ui = ChatUI(node_http=stack["a"].http_url,
+                ollama_url="http://127.0.0.1:9",    # nothing listens
+                addr="127.0.0.1:0").start()
+    try:
+        req = urllib.request.Request(
+            f"{ui.url}/api/suggest/stream",
+            data=json.dumps({"content": "x"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            lines = [json.loads(l) for l in resp if l.strip()]
+        assert lines[-1]["done"] is True
+        assert lines[-1]["delta"].startswith("(LLM unavailable")
+        # error:true marks the line as a failure marker so the browser
+        # never concatenates it onto a partial suggestion.
+        assert lines[-1]["error"] is True
+    finally:
+        ui.stop()
