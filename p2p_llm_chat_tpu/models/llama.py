@@ -494,9 +494,21 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
 
     cache: ops.paged_kv.PagedKVCache. Returns (logits [B,1,vocab], cache
     with lengths advanced where active).
+
+    Structure note: the default (gather-impl) path attends BEFORE the
+    pool write — the current token's k/v folds into attention via one
+    exact online-softmax merge (ops/paged_attention.
+    paged_attention_append) — and the scan stacks each layer's k/v so
+    ONE batched scatter lands the whole step afterwards
+    (write_decode_all_layers). Per-layer pool scatters inside the scan
+    carry a fixed cost that was measurable against the decode bandwidth
+    bound. Non-gather attention impls keep the write-then-attend
+    ordering (their kernels read the pool for every position).
     """
     from ..ops import paged_attention
-    from ..ops.paged_kv import PagedKVCache, write_decode
+    from ..ops.paged_kv import (PagedKVCache, write_decode,
+                                write_decode_all_layers)
+    from ..ops.paged_attention import _DEFAULT_IMPL, paged_attention_append
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
@@ -505,6 +517,31 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     h = params["embed"][tokens]
     h = constrain(h, mesh, ("batch", None, "act_embed"), rules)
     inv_freq = rope_frequencies(config)
+    inc = (jnp.ones_like(cache.lengths) if active is None
+           else active.astype(jnp.int32))
+
+    def finish(h):
+        h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+        lm_head = (params["embed"].T if config.tie_embeddings
+                   else params["lm_head"])
+        logits = mm(h, lm_head).astype(jnp.float32)
+        return constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
+
+    if _DEFAULT_IMPL == "gather":
+        def body(h, xs):
+            lp, layer = xs
+            q, k, v = _attn_qkv(h, lp, config, inv_freq, positions, mesh,
+                                rules)
+            attn = paged_attention_append(q[:, 0], k[:, 0], v[:, 0], cache,
+                                          cache.lengths, layer, pages=pages)
+            h = _post_attn(h, attn[:, None], lp, config, mesh, rules,
+                           mlp_fn)
+            return h, (k[:, 0], v[:, 0])
+
+        h, (k_all, v_all) = jax.lax.scan(
+            body, h, (params["layers"], jnp.arange(config.num_layers)))
+        cache = write_decode_all_layers(cache, k_all, v_all)
+        return finish(h), cache._replace(lengths=cache.lengths + inc)
 
     def body(carry, xs):
         h, pk, pv, sk, sv = carry
@@ -524,13 +561,6 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     (h, new_k, new_v, new_sk, new_sv), _ = jax.lax.scan(
         body, (h, cache.k, cache.v, cache.k_scale, cache.v_scale),
         (params["layers"], jnp.arange(config.num_layers)))
-    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
-    lm_head = (params["embed"].T if config.tie_embeddings
-               else params["lm_head"])
-    logits = mm(h, lm_head).astype(jnp.float32)
-    logits = constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
-    inc = (jnp.ones_like(cache.lengths) if active is None
-           else active.astype(jnp.int32))
-    return logits, cache._replace(k=new_k, v=new_v, k_scale=new_sk,
-                                  v_scale=new_sv,
-                                  lengths=cache.lengths + inc)
+    return finish(h), cache._replace(k=new_k, v=new_v, k_scale=new_sk,
+                                     v_scale=new_sv,
+                                     lengths=cache.lengths + inc)

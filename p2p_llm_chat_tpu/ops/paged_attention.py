@@ -150,6 +150,75 @@ def _paged_attention_gather(q, k_pages, v_pages, page_table, lengths, layer,
     return attend_gqa(q[:, None], k, v, mask)[:, 0]
 
 
+def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
+                           *, pages: int):
+    """Decode attention where this step's k/v is NOT yet in the pool:
+    attend over the pool window (positions < ``lengths``) and merge the
+    current token's own (k_cur, v_cur) contribution with one exact
+    online-softmax step.
+
+    Why: writing each layer's k/v into the pool BEFORE attending forces
+    one [B]-indexed pool scatter per layer inside the decode scan — 22+
+    small scatters per step whose fixed cost is measurable against the
+    bandwidth bound. With the merge, the scan collects per-layer k/v as
+    stacked outputs and ONE batched scatter (ops/paged_kv.
+    write_decode_all_layers) lands the whole step after the trunk.
+    Results are identical to write-then-attend (same f32 softmax over
+    the same set; pinned by tests/test_ops_paged.py).
+
+    q/k_cur/v_cur: [B, Hq|Hkv, D] (one token per row); cache: the
+    PagedKVCache (bf16 or int8 pools); lengths: positions already in
+    the pool per row (NOT including the current token). Returns
+    [B, Hq, D] in q.dtype.
+    """
+    B, Hq, D = q.shape
+    Hkv = k_cur.shape[1]
+    rep = Hq // Hkv
+    ps = cache.page_size
+    W = pages * ps
+    pt = cache.page_table[:, :pages].astype(jnp.int32)
+    kl = jax.lax.dynamic_index_in_dim(cache.k, layer, 0, keepdims=False)
+    vl = jax.lax.dynamic_index_in_dim(cache.v, layer, 0, keepdims=False)
+    k = kl[pt].reshape(B, W, Hkv, D)
+    v = vl[pt].reshape(B, W, Hkv, D)
+    qg = q.reshape(B, 1, Hkv, rep, D)
+    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, k.astype(q.dtype),
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(D).astype(jnp.float32)        # [B,G,rep,1,W]
+    if cache.quantized:
+        ksl = jax.lax.dynamic_index_in_dim(cache.k_scale, layer, 0,
+                                           keepdims=False)
+        vsl = jax.lax.dynamic_index_in_dim(cache.v_scale, layer, 0,
+                                           keepdims=False)
+        sk = ksl[pt].reshape(B, W, Hkv).transpose(0, 2, 1)
+        sv = vsl[pt].reshape(B, W, Hkv).transpose(0, 2, 1)
+        scores = scores * sk[:, :, None, None, :]
+    mask = (jnp.arange(W)[None, :] < lengths[:, None])[:, None, None, None, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+
+    # Current token's own score: q . k_cur per kv head.
+    s_cur = jnp.einsum("bgrd,bgd->bgr", qg[:, 0].astype(jnp.float32),
+                       k_cur.astype(jnp.float32)) / jnp.sqrt(D).astype(
+                           jnp.float32)                      # [B,G,rep]
+    s_cur = s_cur[..., None, None]                           # [B,G,rep,1,1]
+
+    m_w = jnp.max(scores, axis=-1, keepdims=True)            # [B,G,rep,1,1]
+    m = jnp.maximum(m_w, s_cur)
+    p = jnp.exp(scores - m)                                  # masked -> ~0
+    p_cur = jnp.exp(s_cur - m)                               # > 0 always
+    if cache.quantized:
+        pv = jnp.einsum("bgrst,btgd->bgrsd",
+                        (p * sv[:, :, None, None, :]).astype(q.dtype),
+                        v.astype(q.dtype)).astype(jnp.float32)
+    else:
+        pv = jnp.einsum("bgrst,btgd->bgrsd", p.astype(v.dtype),
+                        v).astype(jnp.float32)
+    num = pv + p_cur * v_cur.astype(jnp.float32)[:, :, None, None, :]
+    den = jnp.sum(p, axis=-1, keepdims=True) + p_cur         # [B,G,rep,1,1]
+    out = num / den
+    return out[:, :, :, 0].reshape(B, Hq, D).astype(q.dtype)
+
+
 def _paged_attention_gather_quant(q, k_pages, v_pages, k_scale, v_scale,
                                   page_table, lengths, layer, *, pages: int):
     """Gather-path decode attention over an int8 pool

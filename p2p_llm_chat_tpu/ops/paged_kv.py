@@ -302,6 +302,31 @@ def write_decode(cache: PagedKVCache, layer: jax.Array, k: jax.Array,
                            upd, mode="drop"))
 
 
+def write_decode_all_layers(cache: PagedKVCache, k_all: jax.Array,
+                            v_all: jax.Array) -> PagedKVCache:
+    """Write one decode step's k/v for EVERY layer in one scatter.
+
+    k_all/v_all: [L, B, Hkv, D] (the decode scan's stacked per-layer
+    outputs). Row b writes page ``page_table[b, lengths[b]//ps]`` slot
+    ``lengths[b] % ps`` across all L layers — one [B]-indexed scatter
+    with [L, Hkv, D] windows instead of L scatters with [Hkv, D]
+    windows (models/llama.decode_step_paged pairs this with
+    ops/paged_attention.paged_attention_append, which folds the current
+    token into attention before it lands in the pool). Same garbage-page
+    routing as :func:`write_decode`.
+    """
+    ps = cache.page_size
+    logical = cache.lengths // ps                      # [B]
+    phys = jnp.take_along_axis(cache.page_table, logical[:, None],
+                               axis=1)[:, 0]           # [B]
+    slot = cache.lengths % ps
+    # Advanced indices (phys, slot) sit on adjacent dims, so the update
+    # keeps array order: [L, B, Hkv, D] (and [L, B, Hkv] for scales).
+    return _scatter_kv(cache, k_all, v_all,
+                       lambda arr, upd: arr.at[:, phys, slot].set(
+                           upd, mode="drop"))
+
+
 def write_decode_multi(cache: PagedKVCache, layer: jax.Array, k: jax.Array,
                        v: jax.Array) -> PagedKVCache:
     """Write S consecutive candidate slots per row for one layer — the

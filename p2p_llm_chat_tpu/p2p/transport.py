@@ -288,6 +288,10 @@ class P2PHost:
         self._relay_addrs: list[Multiaddr] = []
         self._relay_socks: list[socket.socket] = []
         self._relay_socks_mu = threading.Lock()
+        # Negative cache for hole punching: peers whose punch failed are
+        # dialed via the relay circuit directly for a while, so every
+        # /send to a UDP-blocked peer doesn't re-pay the punch stall.
+        self._punch_failed: dict[str, float] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -407,11 +411,18 @@ class P2PHost:
         relay's byte splice when punching fails (symmetric NATs, UDP
         blocked). ``P2P_HOLEPUNCH=0`` disables the attempt."""
         if maddr.is_circuit:
-            if os.environ.get("P2P_HOLEPUNCH", "1") not in ("0", "false"):
+            punch_ok = (os.environ.get("P2P_HOLEPUNCH", "1")
+                        not in ("0", "false"))
+            failed_at = self._punch_failed.get(maddr.peer_id or "")
+            if failed_at is not None and time.time() - failed_at < 60.0:
+                punch_ok = False
+            if punch_ok:
                 try:
                     return self._dial_holepunch(maddr, timeout)
                 except (OSError, ConnectionError, HandshakeError,
-                        ValueError) as e:
+                        ValueError, TypeError, KeyError, IndexError) as e:
+                    if maddr.peer_id:
+                        self._punch_failed[maddr.peer_id] = time.time()
                     log.debug("hole punch to %s failed (%s); "
                               "falling back to relay circuit",
                               (maddr.peer_id or "?")[:12], e)
@@ -453,9 +464,14 @@ class P2PHost:
                                         attempts=2)
             if observed is None:
                 observed = usock.getsockname()
+                if observed[0] in ("0.0.0.0", "::", ""):
+                    # Without the relay's observe endpoint a wildcard
+                    # bind has no routable address to advertise — a
+                    # doomed punch would just stall the send path.
+                    raise ConnectionError("no routable UDP endpoint")
             tsock = self._tcp_connect(maddr.host, maddr.port, timeout)
             try:
-                tsock.settimeout(timeout + HANDSHAKE_TIMEOUT)
+                tsock.settimeout(timeout)
                 send_json_frame(tsock, {
                     "type": RELAY_PUNCH, "target": maddr.peer_id,
                     "udp_addr": [observed[0], observed[1]],
@@ -466,7 +482,12 @@ class P2PHost:
             if not resp or not resp.get("ok") or not resp.get("udp_addr"):
                 raise ConnectionError(
                     f"punch refused: {resp.get('error') if resp else 'closed'}")
-            peer = (str(resp["udp_addr"][0]), int(resp["udp_addr"][1]))
+            try:
+                peer = (str(resp["udp_addr"][0]), int(resp["udp_addr"][1]))
+            except (TypeError, ValueError, KeyError, IndexError):
+                raise ConnectionError(
+                    f"bad punch response addr: {resp.get('udp_addr')!r}"
+                ) from None
             punch(usock, peer)
             stream = dialer_handshake(
                 ReliableDgram(usock, peer, send_timeout_s=timeout),
@@ -590,9 +611,22 @@ class P2PHost:
         try:
             usock.bind(("0.0.0.0", 0))
             observed = observe_udp_addr(usock, relay_addr.host,
-                                        relay_addr.port)
+                                        relay_addr.port, timeout=1.5,
+                                        attempts=2)
             if observed is None:
                 observed = usock.getsockname()
+                if observed[0] in ("0.0.0.0", "::", ""):
+                    # No routable endpoint to advertise: ack with null so
+                    # the relay fails the dialer fast instead of letting
+                    # it wait out the accept window.
+                    with send_mu:
+                        send_json_frame(control_sock, {
+                            "type": RELAY_PUNCH_ACK,
+                            "punch_id": msg.get("punch_id"),
+                            "udp_addr": None,
+                        })
+                    usock.close()
+                    return
             with send_mu:
                 send_json_frame(control_sock, {
                     "type": RELAY_PUNCH_ACK,

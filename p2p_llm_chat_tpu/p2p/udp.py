@@ -183,22 +183,39 @@ class ReliableDgram:
         self._timeout = t
 
     def shutdown(self, how: int) -> None:
-        if how in (socket.SHUT_WR, socket.SHUT_RDWR):
-            with self._send_mu:
-                if self._fin_sent:      # a second FIN would never be acked
-                    return
-                self._fin_sent = True
-                try:
-                    self._send_reliable(b"F", self._send_seq, b"")
-                except OSError:
-                    pass
+        if how not in (socket.SHUT_WR, socket.SHUT_RDWR):
+            return
+        with self._send_mu:
+            if self._fin_sent:          # a second FIN would never be acked
+                return
+            self._fin_sent = True
+            if how == socket.SHUT_RDWR:
+                # Full teardown: one best-effort FIN. All data chunks
+                # were already acked (stop-and-wait), so this only risks
+                # the peer noticing EOF late — retransmitting for the
+                # full budget would stall the closing thread ~10 s when
+                # the peer has vanished.
+                self._send_ctrl(b"F", self._send_seq)
                 self._send_seq += 1
+                return
+            # Half-close: the peer's reader blocks until EOF, so the FIN
+            # is worth retransmitting — briefly (2 s covers loss; an
+            # unreachable peer shouldn't wedge the sender).
+            old = self._max_retries
+            self._max_retries = min(old, 8)
+            try:
+                self._send_reliable(b"F", self._send_seq, b"")
+            except OSError:
+                pass
+            finally:
+                self._max_retries = old
+            self._send_seq += 1
 
     def close(self) -> None:
         if self._closed.is_set():
             return
         try:
-            self.shutdown(socket.SHUT_WR)
+            self.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
         self._closed.set()

@@ -73,6 +73,21 @@ class _PendingCircuit:
     target_sock: Optional[socket.socket] = None
 
 
+def _valid_udp_addr(v) -> Optional[tuple[str, int]]:
+    """(host, port) from an untrusted wire value, or None. Punch addrs
+    cross two trust boundaries (dialer -> relay -> target and back), so
+    both hops validate instead of int()-ing whatever arrived."""
+    try:
+        if not isinstance(v, (list, tuple)) or len(v) != 2:
+            return None
+        host, port = str(v[0]), int(v[1])
+        if not host or not 0 < port < 65536:
+            return None
+        return host, port
+    except (TypeError, ValueError):
+        return None
+
+
 @dataclass
 class _PendingPunch:
     event: threading.Event = field(default_factory=threading.Event)
@@ -377,8 +392,8 @@ class RelayService:
         The relay carries only this exchange — the handshake and message
         bytes then flow directly between the peers' UDP sockets."""
         target = str(msg.get("target") or "")
-        udp_addr = msg.get("udp_addr")
-        if (not isinstance(udp_addr, list) or len(udp_addr) != 2):
+        udp_addr = _valid_udp_addr(msg.get("udp_addr"))
+        if udp_addr is None:
             send_json_frame(conn, {"ok": False, "error": "bad udp_addr"})
             conn.close()
             return
@@ -396,15 +411,24 @@ class RelayService:
             with res.send_lock:
                 send_json_frame(res.sock, {
                     "type": RELAY_PUNCH, "punch_id": punch_id,
-                    "udp_addr": [str(udp_addr[0]), int(udp_addr[1])],
+                    "udp_addr": list(udp_addr),
                 })
             if not pending.event.wait(ACCEPT_TIMEOUT_S):
                 send_json_frame(conn, {"ok": False,
                                        "error": "target did not punch"})
                 conn.close()
                 return
+            # A null/invalid ack addr is the target saying "I cannot
+            # punch" — fail the dialer fast so it falls back to the
+            # circuit instead of burning its handshake budget.
+            target_udp = _valid_udp_addr(pending.target_udp)
+            if target_udp is None:
+                send_json_frame(conn, {"ok": False,
+                                       "error": "target cannot punch"})
+                conn.close()
+                return
             send_json_frame(conn, {"ok": True,
-                                   "udp_addr": pending.target_udp})
+                                   "udp_addr": list(target_udp)})
             conn.close()
         except OSError:
             try:

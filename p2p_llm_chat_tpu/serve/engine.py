@@ -269,10 +269,13 @@ def build_engine_from_env() -> Backend:
             return None
         return tuple(int(b) for b in warmup.split(",") if b.strip())
 
-    def load_ckpt_engine(tag: str, path: str) -> TPUEngine:
+    def load_ckpt_engine(tag: Optional[str], path: str) -> TPUEngine:
         """One fully-independent engine from a checkpoint dir: its own
         params, its own tokenizer, its own scheduler/KV pool — engines
-        share nothing but the HTTP front."""
+        share nothing but the HTTP front. The single-model CKPT_DIR path
+        uses this too (tag=None names the engine LLM_MODEL/config.name),
+        so the format probe and quantization cannot drift between the
+        single- and multi-model paths."""
         from ..models.checkpoint import is_native_checkpoint
         if is_native_checkpoint(path):
             from ..models.checkpoint import load_checkpoint as load_native
@@ -289,7 +292,9 @@ def build_engine_from_env() -> Backend:
         if quant:
             from ..models.quant import quantize_params
             params = quantize_params(params, mesh=mesh)
-        return make_engine(params, config, tokenizer, name=tag)
+            log.info("weights quantized to int8 (per-channel, w8a16)")
+        return make_engine(params, config, tokenizer,
+                           name=tag or env_or("LLM_MODEL", config.name))
 
     # Multi-model serving (serve/multi.py): SERVE_MODELS=tag=ref,...
     # builds one independent engine per tag behind one front; requests
@@ -347,32 +352,17 @@ def build_engine_from_env() -> Backend:
         return multi
 
     if ckpt_dir:
-        from ..models.checkpoint import is_native_checkpoint
-        if is_native_checkpoint(ckpt_dir):
-            from ..models.checkpoint import load_checkpoint as load_native
-            params, config = load_native(ckpt_dir, mesh=mesh)
-        elif mesh is not None:
-            # Mesh loads are the big-model path: stream tensors straight
-            # into the sharded device tree so host RAM never holds the
-            # checkpoint (the 70B memory-fit requirement).
-            from ..models.weights import load_checkpoint_streaming
-            params, config = load_checkpoint_streaming(ckpt_dir, mesh=mesh)
-        else:
-            params, config = load_checkpoint(ckpt_dir, mesh=mesh)
-        tokenizer = load_tokenizer(ckpt_dir, vocab_size=config.vocab_size)
+        engine = load_ckpt_engine(None, ckpt_dir)
     else:
         config = get_config(env_or("MODEL_CONFIG", "tiny"))
         log.info("no CKPT_DIR set: serving random-init %s with byte tokenizer",
                  config.name)
         params = random_init_params(config, 0)
+        if quant:
+            log.info("weights quantized to int8 (per-channel, w8a16)")
         tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
-    if ckpt_dir and quant:
-        from ..models.quant import quantize_params
-        params = quantize_params(params, mesh=mesh)
-    if quant:
-        log.info("weights quantized to int8 (per-channel, w8a16)")
-    engine = make_engine(params, config, tokenizer,
-                         name=env_or("LLM_MODEL", config.name))
+        engine = make_engine(params, config, tokenizer,
+                             name=env_or("LLM_MODEL", config.name))
     buckets = warmup_buckets()
     if buckets:
         engine.warmup(buckets, background=True)
