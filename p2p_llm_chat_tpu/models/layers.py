@@ -192,9 +192,13 @@ def flash_attend_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, Hq, D).astype(q.dtype)
 
 
-# Score tensors past this many f32 elements take the chunked flash path
-# ([B,G,rep,Sq,Skv] at 2^27 = 512 MB of HBM just for one layer's scores).
-_FLASH_SCORE_ELEMS = 2 ** 27
+# Score tensors past this many f32 elements take the chunked flash path.
+# Measured on v5e (bench-1b): at B=2 S=2048 the dense path's 268 MB
+# score round-trips cap prefill at 66 TFLOPs/chip while the flash path
+# runs 87; at the 2^25 boundary shapes the two are equal — so the
+# threshold sits at 2^25 (128 MB of f32 scores) rather than the HBM-fit
+# bound it started as.
+_FLASH_SCORE_ELEMS = 2 ** 25
 
 
 def attend_gqa_auto(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -174,29 +174,12 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
     B, Hq, D = q.shape
     Hkv = k_cur.shape[1]
     rep = Hq // Hkv
-    ps = cache.page_size
-    W = pages * ps
-    pt = cache.page_table[:, :pages].astype(jnp.int32)
-    kl = jax.lax.dynamic_index_in_dim(cache.k, layer, 0, keepdims=False)
-    vl = jax.lax.dynamic_index_in_dim(cache.v, layer, 0, keepdims=False)
-    k = kl[pt].reshape(B, W, Hkv, D)
-    v = vl[pt].reshape(B, W, Hkv, D)
-    qg = q.reshape(B, 1, Hkv, rep, D)
-    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, k.astype(q.dtype),
-                        preferred_element_type=jnp.float32)
-    scores = scores / jnp.sqrt(D).astype(jnp.float32)        # [B,G,rep,1,W]
-    if cache.quantized:
-        ksl = jax.lax.dynamic_index_in_dim(cache.k_scale, layer, 0,
-                                           keepdims=False)
-        vsl = jax.lax.dynamic_index_in_dim(cache.v_scale, layer, 0,
-                                           keepdims=False)
-        sk = ksl[pt].reshape(B, W, Hkv).transpose(0, 2, 1)
-        sv = vsl[pt].reshape(B, W, Hkv).transpose(0, 2, 1)
-        scores = scores * sk[:, :, None, None, :]
-    mask = (jnp.arange(W)[None, :] < lengths[:, None])[:, None, None, None, :]
-    scores = jnp.where(mask, scores, NEG_INF)
+    scores, v, sv = _gather_window_scores(
+        q, cache.k, cache.v, cache.k_scale, cache.v_scale,
+        cache.page_table, lengths, layer, pages=pages)
 
     # Current token's own score: q . k_cur per kv head.
+    qg = q.reshape(B, 1, Hkv, rep, D)
     s_cur = jnp.einsum("bgrd,bgd->bgr", qg[:, 0].astype(jnp.float32),
                        k_cur.astype(jnp.float32)) / jnp.sqrt(D).astype(
                            jnp.float32)                      # [B,G,rep]
@@ -206,7 +189,7 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
     m = jnp.maximum(m_w, s_cur)
     p = jnp.exp(scores - m)                                  # masked -> ~0
     p_cur = jnp.exp(s_cur - m)                               # > 0 always
-    if cache.quantized:
+    if sv is not None:
         pv = jnp.einsum("bgrst,btgd->bgrsd",
                         (p * sv[:, :, None, None, :]).astype(q.dtype),
                         v.astype(q.dtype)).astype(jnp.float32)
@@ -217,6 +200,36 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
     den = jnp.sum(p, axis=-1, keepdims=True) + p_cur         # [B,G,rep,1,1]
     out = num / den
     return out[:, :, :, 0].reshape(B, Hq, D).astype(q.dtype)
+
+
+def _gather_window_scores(q, k_pages, v_pages, k_scale, v_scale,
+                          page_table, lengths, layer, *, pages: int):
+    """Shared preamble of the quantized gather and append paths: gather
+    one layer's window, compute masked pre-softmax scores (per-position
+    k scales folded in when the pool is int8), and return
+    (scores [B,G,rep,1,W] f32, v [B,W,Hkv,D], sv [B,G,W] | None)."""
+    B, Hq, D = q.shape
+    ps, Hkv = k_pages.shape[2], k_pages.shape[3]
+    rep = Hq // Hkv
+    W = pages * ps
+    pt = page_table[:, :pages].astype(jnp.int32)
+    kl = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
+    vl = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
+    k = kl[pt].reshape(B, W, Hkv, D)
+    v = vl[pt].reshape(B, W, Hkv, D)
+    qg = q.reshape(B, 1, Hkv, rep, D)
+    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, k.astype(q.dtype),
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(D).astype(jnp.float32)
+    sv = None
+    if k_scale is not None:
+        ksl = jax.lax.dynamic_index_in_dim(k_scale, layer, 0, keepdims=False)
+        vsl = jax.lax.dynamic_index_in_dim(v_scale, layer, 0, keepdims=False)
+        sk = ksl[pt].reshape(B, W, Hkv).transpose(0, 2, 1)     # [B,G,W]
+        sv = vsl[pt].reshape(B, W, Hkv).transpose(0, 2, 1)
+        scores = scores * sk[:, :, None, None, :]
+    mask = (jnp.arange(W)[None, :] < lengths[:, None])[:, None, None, None, :]
+    return jnp.where(mask, scores, NEG_INF), v, sv
 
 
 def _paged_attention_gather_quant(q, k_pages, v_pages, k_scale, v_scale,
@@ -231,28 +244,10 @@ def _paged_attention_gather_quant(q, k_pages, v_pages, k_scale, v_scale,
     half the bf16 pool traffic (measured ~0.3 ms off a 22-layer B=32
     W=192 walk on v5e). Math mirrors models/layers.attend_gqa (f32
     scores/softmax)."""
-    from ..models.layers import NEG_INF as MASK_NEG
-
     B, Hq, D = q.shape
-    ps, Hkv = k_pages.shape[2], k_pages.shape[3]
-    rep = Hq // Hkv
-    W = pages * ps
-    pt = page_table[:, :pages].astype(jnp.int32)
-    kl = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
-    vl = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
-    ksl = jax.lax.dynamic_index_in_dim(k_scale, layer, 0, keepdims=False)
-    vsl = jax.lax.dynamic_index_in_dim(v_scale, layer, 0, keepdims=False)
-    k = kl[pt].reshape(B, W, Hkv, D)
-    v = vl[pt].reshape(B, W, Hkv, D)
-    sk = ksl[pt].reshape(B, W, Hkv).transpose(0, 2, 1)     # [B,G,W]
-    sv = vsl[pt].reshape(B, W, Hkv).transpose(0, 2, 1)
-    qg = q.reshape(B, 1, Hkv, rep, D)
-    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, k.astype(q.dtype),
-                        preferred_element_type=jnp.float32)
-    scores = scores / jnp.sqrt(D).astype(jnp.float32)
-    scores = scores * sk[:, :, None, None, :]
-    mask = (jnp.arange(W)[None, :] < lengths[:, None])[:, None, None, None, :]
-    scores = jnp.where(mask, scores, MASK_NEG)
+    scores, v, sv = _gather_window_scores(
+        q, k_pages, v_pages, k_scale, v_scale, page_table, lengths, layer,
+        pages=pages)
     probs = jax.nn.softmax(scores, axis=-1)
     probs = probs * sv[:, :, None, None, :]
     out = jnp.einsum("bgrst,btgd->bsgrd", probs.astype(q.dtype),

@@ -420,7 +420,7 @@ class P2PHost:
                 try:
                     return self._dial_holepunch(maddr, timeout)
                 except (OSError, ConnectionError, HandshakeError,
-                        ValueError, TypeError, KeyError, IndexError) as e:
+                        ValueError) as e:
                     if maddr.peer_id:
                         self._punch_failed[maddr.peer_id] = time.time()
                     log.debug("hole punch to %s failed (%s); "

@@ -189,18 +189,11 @@ class ReliableDgram:
             if self._fin_sent:          # a second FIN would never be acked
                 return
             self._fin_sent = True
-            if how == socket.SHUT_RDWR:
-                # Full teardown: one best-effort FIN. All data chunks
-                # were already acked (stop-and-wait), so this only risks
-                # the peer noticing EOF late — retransmitting for the
-                # full budget would stall the closing thread ~10 s when
-                # the peer has vanished.
-                self._send_ctrl(b"F", self._send_seq)
-                self._send_seq += 1
-                return
-            # Half-close: the peer's reader blocks until EOF, so the FIN
-            # is worth retransmitting — briefly (2 s covers loss; an
-            # unreachable peer shouldn't wedge the sender).
+            # The peer's reader may be blocked in recv with NO timeout
+            # (the post-handshake steady state), so the FIN must be
+            # retransmitted on loss — but briefly: ~2 s covers datagram
+            # loss without wedging the closing thread for the full
+            # per-chunk budget when the peer has vanished.
             old = self._max_retries
             self._max_retries = min(old, 8)
             try:
