@@ -427,16 +427,25 @@ def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     contract (S candidate positions, lengths unchanged; caller advances
     by accepted+1) on a PagedKVCache.
 
-    Per layer, all S positions' kv go into the pool in one scatter
-    (ops/paged_kv.write_decode_multi — positions past a row's allocation
-    land in garbage page 0, so rollback/containment is inherent), then
-    the Pallas flash-decode kernel runs once per candidate position with
-    its causal length ``lengths+j+1`` — S small static unrolls; the
+    Structure mirrors decode_step_paged's default path: position j
+    attends the pool window plus block positions i <= j from the
+    in-register k/v (ops/paged_attention.paged_attention_verify_append —
+    one softmax over the concatenated scores, identical results to the
+    write-then-attend ordering), the scan stacks each layer's block k/v,
+    and ONE batched scatter lands everything afterwards
+    (write_decode_multi_all_layers — positions past a row's allocation
+    land in garbage page 0, so rollback/containment is inherent). The
     weight stream, the quantity speculation amortises, is still read
-    once. ``pages`` must cover ``lengths + S``.
+    once. ``pages`` must cover ``lengths`` on the gather path and
+    ``lengths + S`` on the non-gather impls, which keep the per-layer
+    write-then-attend ordering and read the drafts back from the pool
+    (the scheduler sizes for ``kv_window + S``, covering both).
     """
     from ..ops import paged_attention
-    from ..ops.paged_kv import write_decode_multi
+    from ..ops.paged_attention import (_DEFAULT_IMPL,
+                                       paged_attention_verify_append)
+    from ..ops.paged_kv import (write_decode_multi,
+                                write_decode_multi_all_layers)
 
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
@@ -445,6 +454,28 @@ def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     h = params["embed"][tokens]
     h = constrain(h, mesh, ("batch", None, "act_embed"), rules)
     inv_freq = rope_frequencies(config)
+
+    def finish(h):
+        h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+        lm_head = (params["embed"].T if config.tie_embeddings
+                   else params["lm_head"])
+        logits = mm(h, lm_head).astype(jnp.float32)
+        return constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
+
+    if _DEFAULT_IMPL == "gather":
+        def body(h, xs):
+            lp, layer = xs
+            q, k, v = _attn_qkv(h, lp, config, inv_freq, positions, mesh,
+                                rules)
+            attn = paged_attention_verify_append(
+                q, k, v, cache, cache.lengths, layer, pages=pages)
+            h = _post_attn(h, attn, lp, config, mesh, rules, mlp_fn)
+            return h, (k, v)
+
+        h, (k_all, v_all) = jax.lax.scan(
+            body, h, (params["layers"], jnp.arange(config.num_layers)))
+        cache = write_decode_multi_all_layers(cache, k_all, v_all)
+        return finish(h), cache
 
     def body(carry, xs):
         h, pk, pv, sk, sv = carry
@@ -467,13 +498,8 @@ def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     (h, new_k, new_v, new_sk, new_sv), _ = jax.lax.scan(
         body, (h, cache.k, cache.v, cache.k_scale, cache.v_scale),
         (params["layers"], jnp.arange(config.num_layers)))
-    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
-    lm_head = (params["embed"].T if config.tie_embeddings
-               else params["lm_head"])
-    logits = mm(h, lm_head).astype(jnp.float32)
-    logits = constrain(logits, mesh, ("batch", None, "act_vocab"), rules)
-    return logits, cache._replace(k=new_k, v=new_v, k_scale=new_sk,
-                                  v_scale=new_sv)
+    return finish(h), cache._replace(k=new_k, v=new_v, k_scale=new_sk,
+                                     v_scale=new_sv)
 
 
 def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,

@@ -175,7 +175,7 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
     Hkv = k_cur.shape[1]
     rep = Hq // Hkv
     scores, v, sv = _gather_window_scores(
-        q, cache.k, cache.v, cache.k_scale, cache.v_scale,
+        q[:, None], cache.k, cache.v, cache.k_scale, cache.v_scale,
         cache.page_table, lengths, layer, pages=pages)
 
     # Current token's own score: q . k_cur per kv head.
@@ -202,13 +202,16 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
     return out[:, :, :, 0].reshape(B, Hq, D).astype(q.dtype)
 
 
-def _gather_window_scores(q, k_pages, v_pages, k_scale, v_scale,
+def _gather_window_scores(q4, k_pages, v_pages, k_scale, v_scale,
                           page_table, lengths, layer, *, pages: int):
     """Shared preamble of the quantized gather and append paths: gather
     one layer's window, compute masked pre-softmax scores (per-position
     k scales folded in when the pool is int8), and return
-    (scores [B,G,rep,1,W] f32, v [B,W,Hkv,D], sv [B,G,W] | None)."""
-    B, Hq, D = q.shape
+    (scores [B,G,rep,S,W] f32, v [B,W,Hkv,D], sv [B,G,W] | None).
+    q4: [B, S, Hq, D] (S query positions per row; every position sees the
+    same window mask ``pos < lengths`` — block-internal causality is the
+    caller's concern, see paged_attention_verify_append)."""
+    B, S, Hq, D = q4.shape
     ps, Hkv = k_pages.shape[2], k_pages.shape[3]
     rep = Hq // Hkv
     W = pages * ps
@@ -217,8 +220,8 @@ def _gather_window_scores(q, k_pages, v_pages, k_scale, v_scale,
     vl = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
     k = kl[pt].reshape(B, W, Hkv, D)
     v = vl[pt].reshape(B, W, Hkv, D)
-    qg = q.reshape(B, 1, Hkv, rep, D)
-    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, k.astype(q.dtype),
+    qg = q4.reshape(B, S, Hkv, rep, D)
+    scores = jnp.einsum("bsgrd,btgd->bgrst", qg, k.astype(q4.dtype),
                         preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(D).astype(jnp.float32)
     sv = None
@@ -246,8 +249,8 @@ def _paged_attention_gather_quant(q, k_pages, v_pages, k_scale, v_scale,
     scores/softmax)."""
     B, Hq, D = q.shape
     scores, v, sv = _gather_window_scores(
-        q, k_pages, v_pages, k_scale, v_scale, page_table, lengths, layer,
-        pages=pages)
+        q[:, None], k_pages, v_pages, k_scale, v_scale, page_table,
+        lengths, layer, pages=pages)
     probs = jax.nn.softmax(scores, axis=-1)
     probs = probs * sv[:, :, None, None, :]
     out = jnp.einsum("bgrst,btgd->bsgrd", probs.astype(q.dtype),
@@ -329,6 +332,53 @@ def _flash_kernel(pt_ref, len_ref, layer_ref, q_ref, k_hbm, v_hbm, o_ref,
 
     out = jnp.concatenate(accs, axis=0) / jnp.concatenate(ls, axis=0)
     o_ref[0] = out.astype(o_ref.dtype)
+
+
+def paged_attention_verify_append(q_blk, k_blk, v_blk, cache, lengths,
+                                  layer, *, pages: int):
+    """Speculative-verify attention where the candidate block's k/v is
+    NOT yet in the pool: position j attends the pool window (positions
+    < ``lengths``, identical mask for every j) plus block positions
+    i <= j from the in-register k/v — one softmax over the concatenated
+    score axis, so on bf16 pools results equal the write-then-attend
+    ordering exactly. (On int8 pools the block is attended at FULL
+    precision — unlike the old ordering, which quantized drafts before
+    attending — matching what paged_attention_append does for the plain
+    path's current token, so spec and plain ticks see in-flight
+    positions identically.) The caller lands the whole block (and all
+    layers) with ONE batched scatter afterwards
+    (ops/paged_kv.write_decode_multi_all_layers) — the multi-position
+    generalisation of :func:`paged_attention_append`.
+
+    q_blk: [B, S, Hq, D]; k_blk/v_blk: [B, S, Hkv, D]; lengths: pool
+    positions per row (excluding the block). Returns [B, S, Hq, D].
+    """
+    B, S, Hq, D = q_blk.shape
+    Hkv = k_blk.shape[2]
+    rep = Hq // Hkv
+    scores_w, v_w, sv = _gather_window_scores(
+        q_blk, cache.k, cache.v, cache.k_scale, cache.v_scale,
+        cache.page_table, lengths, layer, pages=pages)   # [B,G,rep,S,W]
+
+    qg = q_blk.reshape(B, S, Hkv, rep, D)
+    scores_b = jnp.einsum("bsgrd,btgd->bgrst", qg.astype(jnp.float32),
+                          k_blk.astype(jnp.float32))     # [B,G,rep,S,S]
+    scores_b = scores_b / jnp.sqrt(D).astype(jnp.float32)
+    causal = (jnp.arange(S)[None, :] <= jnp.arange(S)[:, None])
+    scores_b = jnp.where(causal[None, None, None], scores_b, NEG_INF)
+
+    scores = jnp.concatenate([scores_w, scores_b], axis=-1)  # [.., W+S]
+    probs = jax.nn.softmax(scores, axis=-1)
+    p_w, p_b = probs[..., : scores_w.shape[-1]], probs[..., scores_w.shape[-1]:]
+    if sv is not None:
+        p_w = p_w * sv[:, :, None, None, :]
+    out = (jnp.einsum("bgrst,btgd->bgrsd", p_w.astype(q_blk.dtype),
+                      v_w.astype(q_blk.dtype)).astype(jnp.float32)
+           + jnp.einsum("bgrst,btgd->bgrsd", p_b,
+                        v_blk.astype(jnp.float32)))
+    # [B,G,rep,S,D] -> [B,S,Hq,D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, Hq, D).astype(
+        q_blk.dtype)
 
 
 # VMEM budget for one double-buffered chunk side (k + v, bf16): chunks of

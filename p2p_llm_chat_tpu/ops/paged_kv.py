@@ -327,6 +327,37 @@ def write_decode_all_layers(cache: PagedKVCache, k_all: jax.Array,
                            upd, mode="drop"))
 
 
+def _multi_write_indices(cache: PagedKVCache,
+                         S: int) -> tuple[jax.Array, jax.Array]:
+    """(phys, slot) [B,S] for S consecutive candidate positions per row.
+    Positions past the table's width go to garbage page 0 — clamping
+    them onto the last real page would wrap their slot index into
+    TRUSTED kv (observed: a fully-allocated row near its budget had
+    early slots of its last page overwritten by draft positions).
+    Shared by every multi-position write so the containment logic has
+    exactly one copy."""
+    ps = cache.page_size
+    pos = cache.lengths[:, None] + jnp.arange(S)[None, :]      # [B,S]
+    logical = pos // ps
+    safe = jnp.minimum(logical, cache.max_pages_per_row - 1)
+    phys = jnp.take_along_axis(cache.page_table, safe, axis=1)     # [B,S]
+    phys = jnp.where(logical < cache.max_pages_per_row, phys, 0)
+    return phys, pos % ps
+
+
+def write_decode_multi_all_layers(cache: PagedKVCache, k_all: jax.Array,
+                                  v_all: jax.Array) -> PagedKVCache:
+    """Write S candidate slots per row for EVERY layer in one scatter —
+    :func:`write_decode_all_layers`'s speculative-verify generalisation
+    (and :func:`write_decode_multi`'s all-layer one). k_all/v_all:
+    [L, B, S, Hkv, D]; same beyond-table garbage containment as
+    write_decode_multi."""
+    phys, slot = _multi_write_indices(cache, k_all.shape[2])
+    return _scatter_kv(cache, k_all, v_all,
+                       lambda arr, upd: arr.at[:, phys, slot].set(
+                           upd, mode="drop"))
+
+
 def write_decode_multi(cache: PagedKVCache, layer: jax.Array, k: jax.Array,
                        v: jax.Array) -> PagedKVCache:
     """Write S consecutive candidate slots per row for one layer — the
@@ -336,19 +367,8 @@ def write_decode_multi(cache: PagedKVCache, layer: jax.Array, k: jax.Array,
     ``page_table[b, (lengths[b]+j) // ps]`` slot ``(lengths[b]+j) % ps``.
     Positions past the row's page allocation hit table entries that are 0
     by contract — the garbage page — so near-budget rows' untrusted draft
-    writes are naturally contained (no clamping hazards)."""
-    B, S = k.shape[:2]
-    ps = cache.page_size
-    pos = cache.lengths[:, None] + jnp.arange(S)[None, :]      # [B,S]
-    logical = pos // ps
-    # Positions past the table's width go to garbage page 0 — clamping
-    # them onto the last real page would wrap their slot index into
-    # TRUSTED kv (observed: a fully-allocated row near its budget had
-    # early slots of its last page overwritten by draft positions).
-    safe = jnp.minimum(logical, cache.max_pages_per_row - 1)
-    phys = jnp.take_along_axis(cache.page_table, safe, axis=1)     # [B,S]
-    phys = jnp.where(logical < cache.max_pages_per_row, phys, 0)
-    slot = pos % ps
+    writes are naturally contained (see _multi_write_indices)."""
+    phys, slot = _multi_write_indices(cache, k.shape[1])
     return _scatter_kv(cache, k, v,
                        lambda arr, upd: arr.at[layer, phys, slot].set(
                            upd, mode="drop"))

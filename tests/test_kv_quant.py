@@ -104,3 +104,23 @@ def test_kv_quant_rejects_non_gather_impl_at_construction(monkeypatch):
     with pytest.raises(ValueError, match="gather"):
         TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=64,
                   kv_mode="paged", page_size=16, kv_quant=True)
+
+
+def test_spec_composes_with_quantized_pool():
+    """Speculation + int8 pool: spec and plain ticks see in-flight
+    positions at full precision identically (paged_attention_append /
+    _verify_append), so greedy spec output matches the non-spec engine
+    on the same quantized pool."""
+    def serve(spec_k):
+        eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128,
+                        kv_mode="paged", page_size=16, spec_k=spec_k,
+                        kv_quant=True)
+        try:
+            req = GenerateRequest(
+                prompt="repeat repeat repeat repeat repeat",
+                options=GenerateOptions(max_tokens=16, temperature=0.0))
+            return "".join(eng.generate_stream(req, RequestStats()))
+        finally:
+            eng.stop()
+
+    assert serve(3) == serve(0)
