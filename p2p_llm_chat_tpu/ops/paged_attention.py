@@ -343,9 +343,12 @@ def paged_attention_verify_append(q_blk, k_blk, v_blk, cache, lengths,
     score axis, so on bf16 pools results equal the write-then-attend
     ordering exactly. (On int8 pools the block is attended at FULL
     precision — unlike the old ordering, which quantized drafts before
-    attending — matching what paged_attention_append does for the plain
-    path's current token, so spec and plain ticks see in-flight
-    positions identically.) The caller lands the whole block (and all
+    attending. Position 0 then sees exactly what the plain tick's
+    paged_attention_append sees; positions j >= 1 view EARLIER drafts
+    at full precision where the plain path, once those drafts commit,
+    reads them quantized — so spec output under int8 KV tracks the
+    plain engine to rounding error, not bit-exactly, at logit ties.)
+    The caller lands the whole block (and all
     layers) with ONE batched scatter afterwards
     (ops/paged_kv.write_decode_multi_all_layers) — the multi-position
     generalisation of :func:`paged_attention_append`.

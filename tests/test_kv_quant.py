@@ -107,10 +107,13 @@ def test_kv_quant_rejects_non_gather_impl_at_construction(monkeypatch):
 
 
 def test_spec_composes_with_quantized_pool():
-    """Speculation + int8 pool: spec and plain ticks see in-flight
-    positions at full precision identically (paged_attention_append /
+    """Speculation + int8 pool: in-flight positions are attended at full
+    precision in both tick kinds (paged_attention_append /
     _verify_append), so greedy spec output matches the non-spec engine
-    on the same quantized pool."""
+    on the same quantized pool for this workload. (The match is
+    rounding-exact, not guaranteed bit-exact at logit ties — positions
+    j >= 1 see earlier drafts pre-quantization; deterministic here
+    because the suite runs f32 on CPU with fixed weights.)"""
     def serve(spec_k):
         eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128,
                         kv_mode="paged", page_size=16, spec_k=spec_k,

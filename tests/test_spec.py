@@ -222,9 +222,17 @@ def test_spec_engine_greedy_matches_oracle(kv_mode):
         eng.stop()
 
 
-def test_verify_step_paged_matches_dense():
-    """The paged verify forward (pool writes + per-position Pallas calls)
-    must produce the dense verify_step's logits for the same state."""
+@pytest.mark.parametrize("impl", ["gather", "kernel"])
+def test_verify_step_paged_matches_dense(impl, monkeypatch):
+    """The paged verify forward must produce the dense verify_step's
+    logits for the same state — on the default gather path
+    (attend-before-write + one batched scatter) AND the non-gather
+    write-then-attend branch (per-layer pool writes + per-position
+    kernel calls), which no serving default exercises."""
+    import importlib
+    pa_mod = importlib.import_module(
+        "p2p_llm_chat_tpu.ops.paged_attention")
+    monkeypatch.setattr(pa_mod, "_DEFAULT_IMPL", impl)
     from p2p_llm_chat_tpu.ops.paged_kv import (PageAllocator, PagedKVCache,
                                                set_row_table, write_prefill)
     rng = np.random.default_rng(3)
