@@ -110,12 +110,24 @@ def main() -> None:
     config = get_config(cfg_name)
     family = family_for(config)   # llama or mixtral (bench-moe)
     dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
-    params = family.init_params(config, jax.random.PRNGKey(0), dtype=dtype)
-    n_params = sum(x.size for x in jax.tree.leaves(params))
     quant = os.environ.get("BENCH_QUANT", "int8")    # "" | int8
-    if quant == "int8":
-        from p2p_llm_chat_tpu.models.quant import quantize_params
-        params = quantize_params(params)
+    if quant == "int8" and hasattr(family, "init_params_quantized"):
+        # Streamed straight to fused int8 — never materialises the bf16
+        # tree, which is what lets BENCH_CONFIG=llama3.1-8b (16 GB bf16)
+        # run on one 16 GB v5e chip (llama.init_params_quantized).
+        params = family.init_params_quantized(config, jax.random.PRNGKey(0),
+                                              dtype=dtype)
+    else:
+        params = family.init_params(config, jax.random.PRNGKey(0),
+                                    dtype=dtype)
+        if quant == "int8":
+            from p2p_llm_chat_tpu.models.quant import quantize_params
+            params = quantize_params(params)
+    from p2p_llm_chat_tpu.models.quant import QTensor
+    n_params = sum(
+        (x.q.size if isinstance(x, QTensor) else x.size)
+        for x in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor)))
     jax.block_until_ready(params)
     log(f"params: {n_params/1e9:.2f}B ({dtype.__name__}"
         f"{', int8 weights' if quant else ''})")

@@ -53,6 +53,29 @@ class QTensor(NamedTuple):
         return self.q.ndim
 
 
+class LayerSlice(NamedTuple):
+    """Deferred per-layer view of a layer-stacked weight ``w[layer]``.
+
+    Why it exists: a decode scan that slices stacked weights (scan xs or
+    an explicit dynamic-slice) and feeds them to the Pallas w8a16 kernel
+    forces XLA to MATERIALISE the slice — custom-call operands cannot
+    alias a slice view — which re-reads and re-writes the entire weight
+    set every step (measured: ~1.9 ms of a 3.8 ms bench-1b step).
+    Wrapping (stacked weight, layer index) lets :func:`mm` pass the
+    scan-invariant stacked array to a layer-indexed kernel
+    (ops/quant_mm.quant_matmul_stacked) that DMAs tiles directly; the
+    XLA fallback slices lazily, exactly like scan xs would have.
+
+    ``w``: QTensor with q [L, in, out] (plain stacked bf16 arrays are
+    sliced eagerly by llama._layer_view instead — XLA fuses those slices
+    into their consumers for free); ``layer``: scalar int32 (a scan
+    tracer in practice).
+    """
+
+    w: object
+    layer: jax.Array
+
+
 def quantize(w: jax.Array, axis: int = -2) -> QTensor:
     """Symmetric int8 quantization with per-channel scales over ``axis``
     (the matmul contraction axis — every channel that feeds one output
@@ -106,6 +129,26 @@ def mm(x: jax.Array, w) -> jax.Array:
     inline on the XLA path (correct anywhere, and the right choice for
     compute-bound prefill). Both scale per output channel after the
     contraction."""
+    if isinstance(w, LayerSlice):
+        lead, H = x.shape[:-1], x.shape[-1]
+        rows = 1
+        for d in lead:
+            rows *= d
+        inner, layer = w.w, w.layer
+        if isinstance(inner, QTensor):
+            if (inner.q.ndim == 3 and rows <= _KERNEL_MAX_ROWS
+                    and _kernel_wanted()):
+                from ..ops.quant_mm import pick_block, quant_matmul_stacked
+                if pick_block(H) and pick_block(inner.q.shape[2]):
+                    y = quant_matmul_stacked(x.reshape(rows, H), inner.q,
+                                             inner.s, layer)
+                    return y.reshape(*lead, inner.q.shape[2])
+            inner = QTensor(
+                q=jax.lax.dynamic_index_in_dim(inner.q, layer, 0, False),
+                s=jax.lax.dynamic_index_in_dim(inner.s, layer, 0, False))
+            return mm(x, inner)
+        raise TypeError("LayerSlice wraps stacked QTensors only; slice "
+                        "plain stacked arrays eagerly (llama._layer_view)")
     if isinstance(w, QTensor):
         lead, H = x.shape[:-1], x.shape[-1]
         rows = 1

@@ -18,14 +18,20 @@ implementations, both pinned to the same oracle (tests/test_ops_paged.py):
   scalar-prefetched page-table indices, accumulating online-softmax
   state in VMEM scratch across the page walk.
 
-Measured on a v5e chip at serving shapes (B=32, bench-1b, windows
-128-1024): the two are equal within noise (~10 ms full decode step,
-vs 11-16 ms for the dense cache). History lesson, for the record: the
-first kernel used grid ``(B, Hkv, pages)`` over a head-major pool
-layout — 8x more programs, each fetching a strided ``[page_size, D]``
-tile — and per-program overhead made the full step 227 ms. At decode,
-few big blocks beat many small ones; layout is the lever, not DMA
-cleverness.
+Measured on a v5e chip at serving shapes (B=32, bench-1b, W=192): the
+gather path wins and is the default everywhere. Two history lessons,
+for the record. (1) The first kernel used grid ``(B, Hkv, pages)`` over
+a head-major pool layout — 8x more programs, each fetching a strided
+``[page_size, D]`` tile — and per-program overhead made the full step
+227 ms: few big blocks beat many small ones. (2) Round 4 rebuilt the
+append path as a Pallas kernel three ways (manual page DMAs; gathered
+windows with per-head dots; gathered windows with GQA-as-selection-
+matmuls) and every variant lost to XLA's gather + fused VPU math — see
+_append_kernel's docstring for the numbers. The durable round-4 wins
+were XLA-side instead: joint (layer, page) indexing so the gather reads
+only the window (not a materialised layer slice), and head-major
+lane-padded scale storage so the scale arrays stop layout-thrashing in
+the decode carry (together ~0.7 ms off a 3.9 ms step).
 
 ``PAGED_ATTN_IMPL`` selects the process-wide default; ``interpret=True``
 runs the kernel on CPU for hardware-free tests (SURVEY.md §4);
@@ -139,19 +145,204 @@ def _paged_attention_gather(q, k_pages, v_pages, page_table, lengths, layer,
     from ..models.layers import attend_gqa
 
     B = q.shape[0]
-    ps, Hkv, D = k_pages.shape[2], k_pages.shape[3], k_pages.shape[4]
+    L, N, ps, Hkv, D = k_pages.shape
     W = pages * ps
-    pt = page_table[:, :pages].astype(jnp.int32)
-    kl = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
-    vl = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
-    k = kl[pt].reshape(B, W, Hkv, D)     # [B,P,ps,Hkv,D] -> pure reshape
-    v = vl[pt].reshape(B, W, Hkv, D)
+    # Joint (layer, page) index into the flat [L*N] page axis: slicing the
+    # layer first (k_pages[layer][pt]) materialises the layer's ENTIRE
+    # pool slice before the gather — measured at ~0.4 ms/step of pure
+    # copy at bench serving shapes. One gather from the flat pool reads
+    # only the window's pages.
+    pt = layer * N + page_table[:, :pages].astype(jnp.int32)
+    k = k_pages.reshape(L * N, ps, Hkv, D)[pt].reshape(B, W, Hkv, D)
+    v = v_pages.reshape(L * N, ps, Hkv, D)[pt].reshape(B, W, Hkv, D)
     mask = (jnp.arange(W)[None, :] < lengths[:, None])[:, None, None, :]
     return attend_gqa(q[:, None], k, v, mask)[:, 0]
 
 
+def _append_kernel(len_ref, q_ref, kc_ref, vc_ref, kwin_ref, vwin_ref,
+                   skw_ref, svw_ref, o_ref, *, page_size: int,
+                   pages: int, rep: int, rows: int, scale: float,
+                   quantized: bool):
+    """Append-attention over GATHERED windows, one program per
+    ``rows``-row block.
+
+    Division of labour, settled by measurement: XLA's native gather
+    fetches each row's pages from the paged pool (its scattered-page
+    DMA machinery runs at ~1 TB/s effective; a manual per-page
+    ``make_async_copy`` loop in an earlier version of this kernel spent
+    ~280 us/layer-call on DMA-descriptor issue alone), and this kernel
+    consumes the gathered windows as auto-pipelined VMEM blocks and
+    replaces what XLA did WORSE: the rep(=2)-row GQA attention math that
+    lowered onto the VPU with layout copies around the scale arrays
+    (~0.8 ms of a 3.0 ms bench-1b step).
+
+    Constant 0/1 selection matrices (built in-register from iotas) turn
+    every GQA shuffle into an MXU dot: ONE [W, HD] x [HD, Hq] score dot
+    and one [Hq, W] x [W, HD] output dot per row, with the kv-head ->
+    query-head expansion and the output block-collapse as tiny constant
+    matmuls. All big dots take bf16 inputs with f32 accumulation — the
+    same precision contract as the gather path's attend_gqa. The current
+    token's (k, v) folds in as one extra softmax term, so pool writes
+    batch AFTER the layer scan (write_decode_all_layers).
+    """
+    W = pages * page_size
+    Hkv = kc_ref.shape[1]
+    Hq = rep * Hkv
+    D = kc_ref.shape[2]
+    HD = Hkv * D
+    pos_col = jax.lax.broadcasted_iota(jnp.int32, (W, 1), dimension=0)
+
+    # SEL[c, d] = 1 iff c % D == d  (block-diag tiler / output collapser)
+    cmod = jax.lax.broadcasted_iota(jnp.int32, (HD, D), 0) % D
+    drng = jax.lax.broadcasted_iota(jnp.int32, (HD, D), 1)
+    sel = (cmod == drng).astype(jnp.bfloat16)                   # [HD, D]
+    # blockm[c, h] = 1 iff c // D == h // rep  (head <-> its kv block);
+    # the [Hq, HD] twin is built directly — Mosaic cannot transpose i1.
+    cdiv = jax.lax.broadcasted_iota(jnp.int32, (HD, Hq), 0) // D
+    hdiv = jax.lax.broadcasted_iota(jnp.int32, (HD, Hq), 1) // rep
+    blockm = cdiv == hdiv                                       # [HD, Hq]
+    cdiv2 = jax.lax.broadcasted_iota(jnp.int32, (Hq, HD), 1) // D
+    hdiv2 = jax.lax.broadcasted_iota(jnp.int32, (Hq, HD), 0) // rep
+    blockm_t = cdiv2 == hdiv2                                   # [Hq, HD]
+    # EXPT[h, g] = 1 iff h // rep == g  (kv-head -> query-head expander)
+    hh = jax.lax.broadcasted_iota(jnp.int32, (Hq, Hkv), 0) // rep
+    gg = jax.lax.broadcasted_iota(jnp.int32, (Hq, Hkv), 1)
+    expt = (hh == gg).astype(jnp.bfloat16)                      # [Hq, Hkv]
+
+    g0 = pl.program_id(0)
+    for r in range(rows):
+        length = len_ref[g0 * rows + r]
+        q_r = q_ref[r].astype(jnp.bfloat16)                     # [Hq, D]
+        valid_col = pos_col < length                            # [W, 1]
+        kflat = kwin_ref[r].reshape(W, HD).astype(jnp.bfloat16)
+        vflat = vwin_ref[r].reshape(W, HD).astype(jnp.bfloat16)
+
+        # Q stacked into its kv block: [HD, Hq] = tile q columns via SEL,
+        # zero the off-block copies.
+        q_cols = jax.lax.dot(sel, q_r.T,
+                             preferred_element_type=jnp.float32)
+        q_blk = jnp.where(blockm, q_cols.astype(jnp.bfloat16),
+                          jnp.zeros((), jnp.bfloat16))          # [HD, Hq]
+        s = jax.lax.dot(kflat, q_blk,
+                        preferred_element_type=jnp.float32) * scale
+        if quantized:
+            sk_all = jnp.concatenate(
+                [skw_ref[r, p][:, :page_size] for p in range(pages)],
+                axis=1)                                         # [Hkv, W]
+            sv_all = jnp.concatenate(
+                [svw_ref[r, p][:, :page_size] for p in range(pages)],
+                axis=1)
+            skw = jax.lax.dot(sk_all.T, expt.T.astype(jnp.float32),
+                              preferred_element_type=jnp.float32)
+            s = s * skw                                         # [W, Hq]
+        s = jnp.where(valid_col, s, NEG_INF)
+
+        # Current token's k/v, expanded kv-head -> query-head via EXPT.
+        kcur = jax.lax.dot(expt, kc_ref[r].astype(jnp.bfloat16),
+                           preferred_element_type=jnp.float32)  # [Hq, D]
+        vcur = jax.lax.dot(expt, vc_ref[r].astype(jnp.bfloat16),
+                           preferred_element_type=jnp.float32)
+        s_cur = jnp.sum(q_r.astype(jnp.float32) * kcur, axis=-1,
+                        keepdims=True).T * scale
+
+        m = jnp.maximum(jnp.max(s, 0, keepdims=True), s_cur)    # [1, Hq]
+        p_w = jnp.exp(s - m)                                    # [W, Hq]
+        p_c = jnp.exp(s_cur - m)                                # [1, Hq]
+        den = jnp.sum(p_w, 0, keepdims=True) + p_c              # [1, Hq]
+        if quantized:
+            svw = jax.lax.dot(sv_all.T, expt.T.astype(jnp.float32),
+                              preferred_element_type=jnp.float32)
+            p_w = p_w * svw
+        out_full = jax.lax.dot(p_w.T.astype(jnp.bfloat16), vflat,
+                               preferred_element_type=jnp.float32)
+        out_full = jnp.where(blockm_t, out_full, 0.0)           # [Hq, HD]
+        out = jax.lax.dot(out_full.astype(jnp.bfloat16), sel,
+                          preferred_element_type=jnp.float32)   # [Hq, D]
+        out = (out + p_c.T * vcur) / den.T
+        o_ref[r] = out.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("pages", "interpret", "quantized"))
+def _paged_append_kernel_call(q, k_cur, v_cur, k_pages, v_pages, k_scale,
+                              v_scale, page_table, lengths, layer, *,
+                              pages: int, quantized: bool,
+                              interpret: bool = False):
+    B, Hq, D = q.shape
+    L, N, page_size, Hkv, _ = k_pages.shape
+    rep = Hq // Hkv
+    scale = 1.0 / (D ** 0.5)
+    W = pages * page_size
+    # XLA joint-index gather fetches the windows (see _append_kernel for
+    # why this beats in-kernel page DMAs).
+    pt = layer * N + page_table[:, :pages].astype(jnp.int32)
+    kwin = k_pages.reshape(L * N, page_size, Hkv, D)[pt].reshape(
+        B, W, Hkv, D)
+    vwin = v_pages.reshape(L * N, page_size, Hkv, D)[pt].reshape(
+        B, W, Hkv, D)
+    if quantized:
+        ps_pad = k_scale.shape[-1]
+        skw = k_scale.reshape(L * N, Hkv, ps_pad)[pt]   # [B, P, Hkv, pad]
+        svw = v_scale.reshape(L * N, Hkv, ps_pad)[pt]
+    else:
+        ps_pad = 128
+        skw = jnp.zeros((B, pages, Hkv, ps_pad), jnp.float32)
+        svw = skw
+
+    # Rows per program bounded by the window VMEM footprint (k+v blocks
+    # + f32 scales, double-buffered by Mosaic); target ~4 MB.
+    bytes_per_row = 2 * W * Hkv * D * k_pages.dtype.itemsize
+    if quantized:
+        bytes_per_row += 2 * pages * Hkv * ps_pad * 4
+    rows = max(1, min(B, (4 * 1024 * 1024) // max(1, bytes_per_row)))
+    while B % rows:
+        rows -= 1
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,       # lengths (SMEM scalars)
+        grid=(B // rows,),
+        in_specs=[
+            pl.BlockSpec((rows, Hq, D), lambda i, ln: (i, 0, 0)),
+            pl.BlockSpec((rows, Hkv, D), lambda i, ln: (i, 0, 0)),
+            pl.BlockSpec((rows, Hkv, D), lambda i, ln: (i, 0, 0)),
+            pl.BlockSpec((rows, W, Hkv, D), lambda i, ln: (i, 0, 0, 0)),
+            pl.BlockSpec((rows, W, Hkv, D), lambda i, ln: (i, 0, 0, 0)),
+            pl.BlockSpec((rows, pages, Hkv, ps_pad),
+                         lambda i, ln: (i, 0, 0, 0)),
+            pl.BlockSpec((rows, pages, Hkv, ps_pad),
+                         lambda i, ln: (i, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows, Hq, D), lambda i, ln: (i, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_append_kernel, page_size=page_size, pages=pages,
+                          rep=rep, rows=rows, scale=scale,
+                          quantized=quantized),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q, k_cur, v_cur, kwin, vwin, skw, svw)
+    return out
+
+
+# Decode append-attention implementation default. "gather" (XLA) wins at
+# serving shapes and stays the default; the Pallas kernel
+# (PAGED_APPEND_IMPL=kernel) is kept for the record and for shape
+# regimes where it may win (very long windows). Measured on v5e,
+# bench-1b B=32 W=192, per step: XLA gather+attend ~1.0 ms; manual-DMA
+# kernel ~6.2 ms in DMA-descriptor issue alone (384 page copies); the
+# gather-fed block kernel ~1.8 ms (the GQA-via-selection-matmul form
+# spends 8x the MXU passes; per-head dots relayout instead). At rep=2
+# decode GQA, XLA's fused VPU math is simply the better tool.
+_APPEND_IMPL = os.environ.get("PAGED_APPEND_IMPL", "gather")
+
+
+def _append_kernel_wanted() -> bool:
+    return _APPEND_IMPL == "kernel"
+
+
 def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
-                           *, pages: int):
+                           *, pages: int, interpret: bool = False):
     """Decode attention where this step's k/v is NOT yet in the pool:
     attend over the pool window (positions < ``lengths``) and merge the
     current token's own (k_cur, v_cur) contribution with one exact
@@ -170,10 +361,21 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
     PagedKVCache (bf16 or int8 pools); lengths: positions already in
     the pool per row (NOT including the current token). Returns
     [B, Hq, D] in q.dtype.
+
+    The XLA gather+merge below is the DEFAULT everywhere (it measured
+    fastest at serving shapes — see the module docstring's round-4
+    history); ``PAGED_APPEND_IMPL=kernel`` opts into the Pallas append
+    kernel (_append_kernel). Both compute the same f32 softmax over the
+    same score set.
     """
     B, Hq, D = q.shape
     Hkv = k_cur.shape[1]
     rep = Hq // Hkv
+    if _append_kernel_wanted():
+        return _paged_append_kernel_call(
+            q, k_cur, v_cur, cache.k, cache.v, cache.k_scale,
+            cache.v_scale, cache.page_table, lengths, layer, pages=pages,
+            quantized=cache.k_scale is not None, interpret=interpret)
     scores, v, sv = _gather_window_scores(
         q[:, None], cache.k, cache.v, cache.k_scale, cache.v_scale,
         cache.page_table, lengths, layer, pages=pages)
@@ -212,24 +414,29 @@ def _gather_window_scores(q4, k_pages, v_pages, k_scale, v_scale,
     same window mask ``pos < lengths`` — block-internal causality is the
     caller's concern, see paged_attention_verify_append)."""
     B, S, Hq, D = q4.shape
-    ps, Hkv = k_pages.shape[2], k_pages.shape[3]
+    L, N, ps, Hkv, _ = k_pages.shape
     rep = Hq // Hkv
     W = pages * ps
-    pt = page_table[:, :pages].astype(jnp.int32)
-    kl = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
-    vl = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
-    k = kl[pt].reshape(B, W, Hkv, D)
-    v = vl[pt].reshape(B, W, Hkv, D)
+    # Joint (layer, page) gather from the flat pool — no layer-slice copy
+    # (see _paged_attention_gather).
+    pt = layer * N + page_table[:, :pages].astype(jnp.int32)
+    k = k_pages.reshape(L * N, ps, Hkv, D)[pt].reshape(B, W, Hkv, D)
+    v = v_pages.reshape(L * N, ps, Hkv, D)[pt].reshape(B, W, Hkv, D)
     qg = q4.reshape(B, S, Hkv, rep, D)
     scores = jnp.einsum("bsgrd,btgd->bgrst", qg, k.astype(q4.dtype),
                         preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(D).astype(jnp.float32)
     sv = None
     if k_scale is not None:
-        ksl = jax.lax.dynamic_index_in_dim(k_scale, layer, 0, keepdims=False)
-        vsl = jax.lax.dynamic_index_in_dim(v_scale, layer, 0, keepdims=False)
-        sk = ksl[pt].reshape(B, W, Hkv).transpose(0, 2, 1)     # [B,G,W]
-        sv = vsl[pt].reshape(B, W, Hkv).transpose(0, 2, 1)
+        # Scales are stored head-major, lane-padded [L, N, Hkv, ps_pad]
+        # (paged_kv.py — the layout the append kernel DMAs); the gathered
+        # [B, P, Hkv, ps] window transposes to [B, G, W] with one cheap
+        # swap of small middle axes (no full-array relayout).
+        ps_pad = k_scale.shape[-1]
+        sk = k_scale.reshape(L * N, Hkv, ps_pad)[pt][..., :ps].transpose(
+            0, 2, 1, 3).reshape(B, Hkv, W)                     # [B,G,W]
+        sv = v_scale.reshape(L * N, Hkv, ps_pad)[pt][..., :ps].transpose(
+            0, 2, 1, 3).reshape(B, Hkv, W)
         scores = scores * sk[:, :, None, None, :]
     mask = (jnp.arange(W)[None, :] < lengths[:, None])[:, None, None, None, :]
     return jnp.where(mask, scores, NEG_INF), v, sv
@@ -443,7 +650,8 @@ def paged_attention(q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
     ``ceil(window / page_size)``); impl: gather | flash | kernel (None =
     the ``PAGED_ATTN_IMPL`` env default, gather). For an int8 pool
     (ops/paged_kv quantized=True) pass ``k_scale``/``v_scale``
-    ([L, N, page_size, Hkv] f32) — gather-impl only. Returns [B, Hq, D]
+    (head-major [L, N, Hkv, ps_pad] f32, ps_pad = page_size padded to a
+    128 multiple — PagedKVCache's storage layout) — gather-impl only. Returns [B, Hq, D]
     in q.dtype.
     """
     if impl is None:

@@ -37,6 +37,23 @@ from jax.experimental.pallas import tpu as pltpu
 # 1024x1024 int8 = 1 MiB of VMEM per tile, comfortably resident.
 _BLOCK_CANDIDATES = (1024, 512, 256, 128)
 
+# VMEM budget for ONE whole-contraction weight stripe [H, bo] int8 on the
+# 1D-grid path (~16 MB VMEM/core; Mosaic double-buffers the stripe, so the
+# working set is 2x this, leaving room for x/out/everything else). Chosen
+# so bench-1b's w_down (H=5632) still runs whole-H stripes at bo=512.
+# QMM_STRIPE_BUDGET overrides (bytes; 0 forces the 2D grid everywhere).
+import os as _os
+
+_STRIPE_BUDGET_BYTES = int(_os.environ.get("QMM_STRIPE_BUDGET",
+                                           4 * 1024 * 1024))
+
+# Ceiling for the fully-resident x block of the 1D whole-contraction
+# grid (x [rows, H] bf16 + two double-buffered weight stripes must fit
+# ~16 MB VMEM). Calls above it use the 2D grid, whose x blocks tile over
+# H — hit by 512-row prefill-admission chunks at 8B dims (rows x 14336
+# bf16 = 14.7 MB, observed as a compile-time VMEM OOM).
+_X_VMEM_BUDGET_BYTES = 6 * 1024 * 1024
+
 
 def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref):
     j = pl.program_id(1)
@@ -56,11 +73,132 @@ def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref):
         o_ref[...] = (acc_ref[:] * s[None, :]).astype(o_ref.dtype)
 
 
+def _qmm_kernel_1d(x_ref, q_ref, s_ref, o_ref):
+    """Whole-contraction stripe: one program = one [H, bo] weight tile =
+    one output tile — no revisits, no scratch accumulator, and ~3x fewer
+    program invocations than the 2D grid at decode shapes (measured: the
+    per-program fixed cost, not DMA bandwidth, dominated the 2D walk)."""
+    x = x_ref[...]                                 # [rows, H] bf16
+    q = q_ref[...].astype(x.dtype)                 # int8 -> bf16 in VMEM
+    acc = jax.lax.dot(x, q, preferred_element_type=jnp.float32)
+    s = s_ref[0].astype(jnp.float32)               # [bo]
+    o_ref[...] = (acc * s[None, :]).astype(o_ref.dtype)
+
+
+def _qmm_kernel_1d_stacked(layer_ref, x_ref, q_ref, s_ref, o_ref):
+    """Whole-contraction stripe fetched from the STACKED [L, H, O] weight
+    at the scalar-prefetched layer index. This is how the decode scan
+    avoids materialising per-layer weight slices: a pallas custom-call
+    cannot alias a dynamic-slice view, so feeding it sliced operands made
+    XLA copy every layer's int8 weights before the matmul — measured at
+    ~1.9 ms of a 3.8 ms bench-1b step (half the step!). With the stacked
+    operand the kernel DMAs tiles straight from the scan-invariant pool."""
+    x = x_ref[...]                                 # [rows, H] bf16
+    q = q_ref[0].astype(x.dtype)                   # [H, bo] int8 -> bf16
+    acc = jax.lax.dot(x, q, preferred_element_type=jnp.float32)
+    s = s_ref[0, 0].astype(jnp.float32)            # [bo]
+    o_ref[...] = (acc * s[None, :]).astype(o_ref.dtype)
+
+
+def _qmm_kernel_2d_stacked(layer_ref, x_ref, q_ref, s_ref, o_ref, acc_ref):
+    j = pl.program_id(1)
+    num_h = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]                                 # [rows, bh]
+    q = q_ref[0].astype(x.dtype)                   # [bh, bo]
+    acc_ref[:] += jax.lax.dot(x, q, preferred_element_type=jnp.float32)
+
+    @pl.when(j == num_h - 1)
+    def _finalise():
+        s = s_ref[0, 0].astype(jnp.float32)        # [bo]
+        o_ref[...] = (acc_ref[:] * s[None, :]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quant_matmul_stacked(x: jax.Array, q: jax.Array, s: jax.Array,
+                         layer: jax.Array, *,
+                         interpret: bool = False) -> jax.Array:
+    """``x @ dequant(q[layer], s[layer])`` reading the stacked weight
+    directly — no per-layer slice copy (see _qmm_kernel_1d_stacked).
+
+    x: [rows, H]; q: [L, H, O] int8; s: [L, 1, O] f32 (the stacked
+    models/quant.QTensor layout); layer: scalar int32. Same block
+    preconditions as :func:`quant_matmul`.
+    """
+    rows, H = x.shape
+    O = q.shape[2]
+    bh, bo = pick_block(H), pick_block(O)
+    if bh is None or bo is None:
+        raise ValueError(f"no block divides H={H} / O={O}; use the XLA path")
+    pad = (-rows) % 8
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    rp = rows + pad
+    ly = jnp.asarray(layer, jnp.int32).reshape(1)
+
+    bo_1d = _pick_1d_bo(rp, H, O, x.dtype.itemsize)
+    if bo_1d is not None:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(O // bo_1d,),
+            in_specs=[
+                pl.BlockSpec((rp, H), lambda i, ly: (0, 0)),
+                pl.BlockSpec((1, H, bo_1d), lambda i, ly: (ly[0], 0, i)),
+                pl.BlockSpec((1, 1, bo_1d), lambda i, ly: (ly[0], 0, i)),
+            ],
+            out_specs=pl.BlockSpec((rp, bo_1d), lambda i, ly: (0, i)),
+        )
+        out = pl.pallas_call(
+            _qmm_kernel_1d_stacked,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((rp, O), x.dtype),
+            interpret=interpret,
+        )(ly, x, q, s)
+        return out[:rows] if pad else out
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(O // bo, H // bh),
+        in_specs=[
+            pl.BlockSpec((rp, bh), lambda i, j, ly: (0, j)),
+            pl.BlockSpec((1, bh, bo), lambda i, j, ly: (ly[0], j, i)),
+            pl.BlockSpec((1, 1, bo), lambda i, j, ly: (ly[0], 0, i)),
+        ],
+        out_specs=pl.BlockSpec((rp, bo), lambda i, j, ly: (0, i)),
+        scratch_shapes=[pltpu.VMEM((rp, bo), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        _qmm_kernel_2d_stacked,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rp, O), x.dtype),
+        interpret=interpret,
+    )(ly, x, q, s)
+    return out[:rows] if pad else out
+
+
 def pick_block(dim: int) -> int | None:
     for b in _BLOCK_CANDIDATES:
         if dim % b == 0:
             return b
     return None
+
+
+def _pick_1d_bo(rp: int, H: int, O: int, x_itemsize: int) -> int | None:
+    """Output-block width for the 1D whole-contraction grid, or None to
+    use the 2D grid: x [rp, H] must fit the VMEM x-budget and the [H, bo]
+    int8 stripe the stripe budget (shared by the stacked and unstacked
+    kernels so identical shapes always pick identical grids)."""
+    if rp * H * x_itemsize > _X_VMEM_BUDGET_BYTES:
+        return None
+    bo = pick_block(O)
+    while bo is not None and H * bo > _STRIPE_BUDGET_BYTES:
+        bo = next((b for b in _BLOCK_CANDIDATES
+                   if b < bo and O % b == 0), None)
+    return bo
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -83,6 +221,24 @@ def quant_matmul(x: jax.Array, q: jax.Array, s: jax.Array,
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0)))
     rp = rows + pad
+
+    # Prefer the 1D whole-contraction grid: shrink bo until the [H, bo]
+    # int8 stripe fits the VMEM budget (keeping bo a divisor of O).
+    bo_1d = _pick_1d_bo(rp, H, O, x.dtype.itemsize)
+    if bo_1d is not None:
+        out = pl.pallas_call(
+            _qmm_kernel_1d,
+            grid=(O // bo_1d,),
+            in_specs=[
+                pl.BlockSpec((rp, H), lambda i: (0, 0)),
+                pl.BlockSpec((H, bo_1d), lambda i: (0, i)),
+                pl.BlockSpec((1, bo_1d), lambda i: (0, i)),
+            ],
+            out_specs=pl.BlockSpec((rp, bo_1d), lambda i: (0, i)),
+            out_shape=jax.ShapeDtypeStruct((rp, O), x.dtype),
+            interpret=interpret,
+        )(x, q, s)
+        return out[:rows] if pad else out
 
     out = pl.pallas_call(
         _qmm_kernel,

@@ -242,8 +242,15 @@ def build_engine_from_env() -> Backend:
         raise SystemExit("SERVE_KV_QUANT=int8 requires SERVE_KV=paged")
 
     def random_init_params(config, seed: int):
-        """Shared per-model build: random init -> shard -> quantize."""
+        """Shared per-model build: random init -> shard -> quantize.
+        Single-chip int8 llama-family configs stream straight to fused
+        int8 (never materialising the bf16 tree) so MODEL_CONFIG=
+        llama3.1-8b serves on one 16 GB chip."""
         family = family_for(config)
+        if (quant and mesh is None
+                and hasattr(family, "init_params_quantized")):
+            return family.init_params_quantized(config,
+                                                jax.random.PRNGKey(seed))
         params = family.init_params(config, jax.random.PRNGKey(seed))
         if mesh is not None:
             from ..parallel.sharding import shard_params

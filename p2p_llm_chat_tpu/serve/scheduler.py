@@ -252,14 +252,18 @@ class BatchScheduler:
         # so dense and MoE configs serve through one scheduler.
         self._model = family_for(config)
         model = self._model
-        # Single-chip decode is bandwidth-bound and pays a fixed cost per
+        # Decode is bandwidth-bound and pays a fixed cost per
         # weight-matmul call: fuse the column-parallel projection pairs
         # (wq|wk|wv, w_gate|w_up) into single wider matmuls
         # (models/llama.fuse_params — exact, works on bf16 and int8).
-        # Under a mesh the sharding rule table names the leaves
-        # separately, so fusion is single-chip only.
-        if mesh is None and hasattr(model, "fuse_params"):
-            params = model.fuse_params(params)
+        # Under a mesh the fused columns interleave as per-device blocks
+        # and shard over tp (llama.fuse_tp_for), so TP serving keeps the
+        # fused-matmul win too.
+        if hasattr(model, "fuse_params"):
+            from ..models.llama import fuse_tp_for
+            params = model.fuse_params(params,
+                                       tp=fuse_tp_for(config, mesh),
+                                       mesh=mesh)
         self._params = params
 
         self._slots: list[Optional[_Slot]] = [None] * num_slots
@@ -895,7 +899,8 @@ class BatchScheduler:
             self._cache = PagedKVCache.create(
                 self.config, B, self.num_pages, self.page_size,
                 max_pages_per_row=-(-self.max_seq // self.page_size),
-                dtype=self._dtype, quantized=self.kv_quant)
+                dtype=self._dtype, quantized=self.kv_quant,
+                mesh=self.mesh)
         else:
             self._cache = KVCache.create(self.config, B, self.max_seq,
                                          self._dtype)

@@ -319,9 +319,9 @@ def test_quantized_pool_write_paths_and_attention():
                           lens + 1, jnp.asarray(0), pages=mppr,
                           k_scale=cache2.k_scale, v_scale=cache2.v_scale)
     deq_k = (cache2.k.astype(jnp.float32)
-             * cache2.k_scale[..., None]).astype(jnp.float32)
+             * cache2.k_scale_view[..., None]).astype(jnp.float32)
     deq_v = (cache2.v.astype(jnp.float32)
-             * cache2.v_scale[..., None]).astype(jnp.float32)
+             * cache2.v_scale_view[..., None]).astype(jnp.float32)
     ref = paged_attention_reference(q, deq_k, deq_v, cache2.page_table,
                                     lens + 1, 0, pages=mppr)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
