@@ -206,6 +206,13 @@ def fuse_params(params: dict, tp: int = 1, mesh: Optional[Mesh] = None,
     of giving it up (VERDICT r3 weak #3).
 
     Works on bf16 arrays and QTensors alike; no-op if already fused.
+
+    CAVEAT: the layout is derived from (config, mesh) at every use site
+    (fuse_tp_for), not recorded on the params — running tp-fused params
+    through a forward with a DIFFERENT mesh (or none) unpacks the wrong
+    interleave and silently scrambles head columns. The serving
+    scheduler, the only production composition point, fuses and runs
+    under the same mesh object by construction; keep it that way.
     """
     layers = params["layers"]
     if "wqkv" in layers:

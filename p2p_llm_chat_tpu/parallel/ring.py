@@ -31,8 +31,17 @@ This is the configuration a 70B-class long-context deployment needs:
 the sequence dim scales context over sp while tp keeps the per-device
 weight shard small. Params must be sharded with :func:`ring_param_specs`
 (embed/lm_head replicated — the vocab-sharded embedding gather is not
-worth the masked-gather+psum inside this path). The MoE ``mlp_fn`` path
-stays sp-only (expert dispatch under tp here is future work).
+worth the masked-gather+psum inside this path).
+
+**SP×EP composition** (long-context Mixtral): an ``ep`` axis alongside
+``sp`` shards the expert-stacked FFN weights; each device's sequence
+chunk is replicated across its ep group, so routing is computed
+identically everywhere, every device dispatches its chunk's tokens into
+ONLY its local experts' capacity buckets (:func:`moe_ring_mlp_fn`), and
+one ``psum`` over ep combines — tokens never move between devices, only
+the O(B·Sl·H) combine does. MoE under ``tp`` inside the ring remains
+future work (expert weights already shard over ("ep","tp") in the
+non-ring path, parallel/sharding.py).
 """
 
 from __future__ import annotations
@@ -101,6 +110,67 @@ def _post_attn_tp(h, attn, lp, config: ModelConfig, mlp_fn,
         if tp_axis is not None:
             mlp = jax.lax.psum(mlp, tp_axis)
     return h + mlp
+
+
+def moe_ring_mlp_fn(config: ModelConfig, ep_axis: Optional[str]):
+    """Sparse-MoE MLP for the ring/sp shard_map body with experts sharded
+    over ``ep_axis`` (None = experts replicated, sp-only).
+
+    The device's sequence chunk is replicated across its ep group
+    (ring_prefill's in_specs shard tokens over sp only), so every device
+    computes identical routing, scatters its chunk's tokens into its
+    LOCAL experts' buckets (the same scatter/gather dispatch as
+    models/mixtral.moe_mlp, bucketed by local expert id), runs its
+    expert shard's FFNs, and the per-token combine psums over ep —
+    non-owners contribute exact zeros via the fill-gather. Math matches
+    mixtral.moe_mlp exactly (dropless: C = T bounds every expert's
+    assignment count).
+    """
+    from ..models.quant import q_einsum
+
+    k = config.num_experts_per_tok
+    ne_total = config.num_experts
+
+    def fn(x, lp, _mesh, _rules):
+        B, S, H = x.shape
+        T = B * S
+        w_gate = lp["w_gate"]                    # [NE_local, H, F] shard
+        ne_local = (w_gate.q if hasattr(w_gate, "q") else w_gate).shape[0]
+        xt = x.reshape(T, H)
+        logits = xt.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)                # [T, NE]
+        top_w, top_i = jax.lax.top_k(probs, k)
+        top_w = top_w / jnp.sum(top_w, axis=-1, keepdims=True)
+
+        # Position-in-expert over GLOBAL expert ids — identical on every
+        # ep device, so bucket slots agree without communication.
+        sel = jax.nn.one_hot(top_i, ne_total, dtype=jnp.int32)
+        flat = sel.reshape(T * k, ne_total)
+        pos = jnp.cumsum(flat, axis=0) - flat
+        slot = jnp.sum(flat * pos, axis=-1)                    # [T*k]
+        expert = top_i.reshape(T * k)
+        base = (jax.lax.axis_index(ep_axis) * ne_local
+                if ep_axis is not None else 0)
+        local_e = expert - base
+        owned = (local_e >= 0) & (local_e < ne_local)
+        C = T                                    # dropless: slot < T
+        idx = jnp.where(owned, local_e * C + slot, ne_local * C)
+
+        x_rep = jnp.repeat(xt, k, axis=0)                      # [T*k, H]
+        xin = jnp.zeros((ne_local * C, H), xt.dtype).at[idx].set(
+            x_rep, mode="drop").reshape(ne_local, C, H)
+        g = jax.nn.silu(q_einsum("ech,ehf->ecf", xin, lp["w_gate"]))
+        u = q_einsum("ech,ehf->ecf", xin, lp["w_up"])
+        y = q_einsum("ecf,efh->ech", g * u, lp["w_down"])      # [NEl,C,H]
+        gathered = jnp.take(y.reshape(ne_local * C, H), idx, axis=0,
+                            mode="fill", fill_value=0)         # [T*k, H]
+        out = jnp.sum(gathered.reshape(T, k, H).astype(jnp.float32)
+                      * top_w[..., None], axis=1)
+        if ep_axis is not None:
+            out = jax.lax.psum(out, ep_axis)
+        return out.astype(x.dtype).reshape(B, S, H)
+
+    return fn
 
 
 def _chunk_scores(q: jax.Array, k: jax.Array) -> jax.Array:
@@ -188,10 +258,14 @@ def ring_prefill(params: dict, config: ModelConfig, tokens: jax.Array,
     """
     sp = mesh.shape["sp"]
     tp = mesh.shape.get("tp", 1)
-    assert mesh.size == sp * tp, (
-        f"ring path runs over sp (x tp) only (mesh {dict(mesh.shape)}); "
-        "set other axes to 1")
-    assert tp == 1 or mlp_fn is None, "MoE ring is sp-only (no tp yet)"
+    ep = mesh.shape.get("ep", 1)
+    assert mesh.size == sp * tp * ep, (
+        f"ring path runs over sp (x tp | x ep) only "
+        f"(mesh {dict(mesh.shape)}); set other axes to 1")
+    assert tp == 1 or mlp_fn is None, \
+        "MoE composes with the ring via ep (moe_ring_mlp_fn), not tp"
+    assert ep == 1 or mlp_fn is not None, \
+        "an ep axis shards experts; pass moe_ring_mlp_fn(config, 'ep')"
     assert config.num_kv_heads % tp == 0, (config.num_kv_heads, tp)
     B, S = tokens.shape
     assert S % sp == 0, f"seq {S} not divisible by sp={sp}"
@@ -257,8 +331,12 @@ def sp_decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
     """
     sp = mesh.shape["sp"]
     tp = mesh.shape.get("tp", 1)
-    assert mesh.size == sp * tp, "sp (x tp) path; see ring_prefill"
-    assert tp == 1 or mlp_fn is None, "MoE ring is sp-only (no tp yet)"
+    ep = mesh.shape.get("ep", 1)
+    assert mesh.size == sp * tp * ep, "sp (x tp | x ep); see ring_prefill"
+    assert tp == 1 or mlp_fn is None, \
+        "MoE composes with the ring via ep (moe_ring_mlp_fn), not tp"
+    assert ep == 1 or mlp_fn is not None, \
+        "an ep axis shards experts; pass moe_ring_mlp_fn(config, 'ep')"
     B = tokens.shape[0]
     Sl = cache.k.shape[2] // sp
     inv_freq = rope_frequencies(config)

@@ -185,3 +185,55 @@ def test_ring_composes_with_int8_weights():
     nxt = jnp.argmax(np.asarray(ref)[:, S - 3], -1).astype(jnp.int32)[:, None]
     lg, _ = sp_decode_step(params, config, nxt, got_cache, mesh)
     assert lg.shape == (B, 1, config.vocab_size)
+
+
+def test_ring_moe_ep_matches_dense_oracle():
+    """SP×EP: ring prefill + distributed decode with experts sharded over
+    ep inside the shard_map body (parallel/ring.moe_ring_mlp_fn) — the
+    long-context Mixtral layout — matches the dense MoE oracle."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_llm_chat_tpu.models import mixtral
+    from p2p_llm_chat_tpu.models.configs import get_config
+    from p2p_llm_chat_tpu.models.llama import KVCache
+    from p2p_llm_chat_tpu.parallel.mesh import MeshConfig, make_mesh
+    from p2p_llm_chat_tpu.parallel.ring import (moe_ring_mlp_fn,
+                                                ring_prefill,
+                                                sp_decode_step)
+
+    config = get_config("tiny-moe")
+    sp, ep, B, steps = 2, 2, 2, 2
+    S = 8 * sp
+    prompt_len = S - steps - 1
+    params = mixtral.init_params(config, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+    rng = np.random.default_rng(3)
+    tokens = np.zeros((B, S), np.int32)
+    tokens[:, :prompt_len] = rng.integers(0, config.vocab_size,
+                                          (B, prompt_len))
+    tokens = jnp.asarray(tokens)
+    lens = jnp.full((B,), prompt_len, jnp.int32)
+
+    cache = KVCache.create(config, B, S, dtype=jnp.float32)
+    ref, ref_cache = mixtral.prefill(params, config,
+                                     tokens[:, :prompt_len], lens, cache,
+                                     capacity=None)
+    mesh = make_mesh(MeshConfig(sp=sp, ep=ep))
+    mlp_fn = moe_ring_mlp_fn(config, "ep")
+    got, got_cache = ring_prefill(params, config, tokens, lens, mesh,
+                                  mlp_fn=mlp_fn)
+    np.testing.assert_allclose(np.asarray(got)[:, :prompt_len],
+                               np.asarray(ref), atol=2e-4, rtol=2e-3)
+    nxt = jnp.argmax(np.asarray(ref)[:, prompt_len - 1], -1).astype(
+        jnp.int32)[:, None]
+    for _ in range(steps):
+        ref_l, ref_cache = mixtral.decode_step(params, config, nxt,
+                                               ref_cache)
+        got_l, got_cache = sp_decode_step(params, config, nxt, got_cache,
+                                          mesh, mlp_fn=mlp_fn)
+        np.testing.assert_allclose(np.asarray(got_l), np.asarray(ref_l),
+                                   atol=2e-4, rtol=2e-3)
+        nxt = jnp.argmax(np.asarray(ref_l)[:, 0], -1).astype(
+            jnp.int32)[:, None]

@@ -59,11 +59,13 @@ def run(quantized: bool, B=32, pages=3, ps=64) -> None:
             q, k_cur, v_cur, cache.k, cache.v, cache.k_scale, cache.v_scale,
             cache.page_table, lens, jnp.asarray(layer), pages=pages,
             quantized=quantized)
-        os.environ["PAGED_APPEND_IMPL"] = "gather"
+        saved = pa._APPEND_IMPL
         pa._APPEND_IMPL = "gather"
-        ref = pa.paged_attention_append(q, k_cur, v_cur, cache, lens,
-                                        jnp.asarray(layer), pages=pages)
-        pa._APPEND_IMPL = "auto"
+        try:
+            ref = pa.paged_attention_append(q, k_cur, v_cur, cache, lens,
+                                            jnp.asarray(layer), pages=pages)
+        finally:
+            pa._APPEND_IMPL = saved
         kn, rn = np.asarray(kern, np.float32), np.asarray(ref, np.float32)
         err = np.max(np.abs(kn - rn))
         denom = np.max(np.abs(rn)) or 1.0

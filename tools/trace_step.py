@@ -65,6 +65,10 @@ def main() -> None:
         kw = dict(skip_attn=True)
     elif variant == "trunk_only":
         kw = dict(skip_attn=True, skip_write=True, skip_lm_head=True)
+    elif variant != "full":
+        raise SystemExit(f"unknown variant {variant!r} (full|no_attn|"
+                         "trunk_only|<path>.pb) — a mislabeled trace "
+                         "would publish wrong attribution numbers")
     jfn = jax.jit(lambda p, t, c: step_variant(p, config, t, c,
                                                pages=pages, **kw),
                   donate_argnums=(2,))
