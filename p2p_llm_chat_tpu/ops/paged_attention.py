@@ -354,8 +354,13 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
     bandwidth bound. With the merge, the scan collects per-layer k/v as
     stacked outputs and ONE batched scatter (ops/paged_kv.
     write_decode_all_layers) lands the whole step after the trunk.
-    Results are identical to write-then-attend (same f32 softmax over
-    the same set; pinned by tests/test_ops_paged.py).
+    On bf16 pools results are identical to write-then-attend (same f32
+    softmax over the same set; pinned by tests/test_ops_paged.py). On
+    int8 pools the CURRENT token is attended at FULL precision here,
+    where write-then-attend would read it back quantized — a
+    sub-quantisation-noise difference that can flip logit ties (the
+    same caveat verify_append documents for drafts; see the scheduler's
+    kv_quant notes).
 
     q/k_cur/v_cur: [B, Hq|Hkv, D] (one token per row); cache: the
     PagedKVCache (bf16 or int8 pools); lengths: positions already in

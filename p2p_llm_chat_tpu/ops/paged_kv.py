@@ -478,7 +478,11 @@ def set_row_table(cache: PagedKVCache, row: int | jax.Array,
 def gather_dense(cache: PagedKVCache, layer: int, max_seq: int,
                  ) -> tuple[jax.Array, jax.Array]:
     """Materialise one layer back to dense [B, max_seq, Hkv, D] (test
-    oracle / debugging only — defeats the point in production)."""
+    oracle / debugging only — defeats the point in production). Returns
+    the POOL dtype for bf16 pools and float32 (full-precision dequant)
+    for quantized pools — callers mixing it with bf16 tensors must cast
+    explicitly; the f32 return is deliberate so oracles compare at the
+    dequant's native precision."""
     ps = cache.page_size
     pos = jnp.arange(max_seq)
     logical = pos // ps                                # [max_seq]

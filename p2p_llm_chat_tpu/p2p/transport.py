@@ -411,9 +411,15 @@ class P2PHost:
         relay's byte splice when punching fails (symmetric NATs, UDP
         blocked). ``P2P_HOLEPUNCH=0`` disables the attempt."""
         if maddr.is_circuit:
+            deadline = time.monotonic() + timeout
             punch_ok = (os.environ.get("P2P_HOLEPUNCH", "1")
                         not in ("0", "false"))
-            failed_at = self._punch_failed.get(maddr.peer_id or "")
+            # Negative cache keyed by REAL peer ids only (id-less circuit
+            # addrs would all share one slot and suppress each other),
+            # pruned on insert so long-lived hosts don't accumulate
+            # entries forever.
+            failed_at = (self._punch_failed.get(maddr.peer_id)
+                         if maddr.peer_id else None)
             if failed_at is not None and time.time() - failed_at < 60.0:
                 punch_ok = False
             if punch_ok:
@@ -422,10 +428,18 @@ class P2PHost:
                 except (OSError, ConnectionError, HandshakeError,
                         ValueError) as e:
                     if maddr.peer_id:
-                        self._punch_failed[maddr.peer_id] = time.time()
+                        now = time.time()
+                        self._punch_failed = {
+                            pid: t for pid, t in
+                            self._punch_failed.items() if now - t < 60.0}
+                        self._punch_failed[maddr.peer_id] = now
                     log.debug("hole punch to %s failed (%s); "
                               "falling back to relay circuit",
                               (maddr.peer_id or "?")[:12], e)
+            # The relay fallback spends whatever of the dial deadline the
+            # punch attempt left (never less than a floor so a punch that
+            # consumed the budget still gets one quick relay try).
+            timeout = max(0.5, deadline - time.monotonic())
             sock = self._tcp_connect(maddr.host, maddr.port, timeout)
             try:
                 send_json_frame(sock, {"type": RELAY_HOP, "target": maddr.peer_id})
@@ -454,13 +468,22 @@ class P2PHost:
 
         usock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         usock.bind(("0.0.0.0", 0))
+        deadline = time.monotonic() + timeout
+
+        def left() -> float:
+            rem = deadline - time.monotonic()
+            if rem <= 0.05:
+                raise ConnectionError("punch deadline exhausted")
+            return rem
+
         try:
-            # The whole punch attempt is bounded by the dial timeout (the
-            # reference's 5 s /send deadline): a UDP-hostile network must
-            # fall back to the relay circuit within it, not stack observe
-            # retries on handshake retransmits.
+            # ONE deadline spans all three phases (observe, TCP punch
+            # exchange, handshake retransmits) — each phase gets only the
+            # remaining budget, so a UDP-hostile network falls back to
+            # the relay circuit within the reference's 5 s /send
+            # deadline instead of stacking per-phase timeouts ~2-3x it.
             observed = observe_udp_addr(usock, maddr.host, maddr.port,
-                                        timeout=min(1.5, timeout / 3),
+                                        timeout=min(1.5, left() / 3),
                                         attempts=2)
             if observed is None:
                 observed = usock.getsockname()
@@ -469,9 +492,9 @@ class P2PHost:
                     # bind has no routable address to advertise — a
                     # doomed punch would just stall the send path.
                     raise ConnectionError("no routable UDP endpoint")
-            tsock = self._tcp_connect(maddr.host, maddr.port, timeout)
+            tsock = self._tcp_connect(maddr.host, maddr.port, left())
             try:
-                tsock.settimeout(timeout)
+                tsock.settimeout(left())
                 send_json_frame(tsock, {
                     "type": RELAY_PUNCH, "target": maddr.peer_id,
                     "udp_addr": [observed[0], observed[1]],
@@ -490,7 +513,7 @@ class P2PHost:
                 ) from None
             punch(usock, peer)
             stream = dialer_handshake(
-                ReliableDgram(usock, peer, send_timeout_s=timeout),
+                ReliableDgram(usock, peer, send_timeout_s=left()),
                 self.identity, maddr.peer_id)
             log.info("hole-punched direct UDP path to %s",
                      stream.remote_peer_id[:12])

@@ -398,6 +398,11 @@ def main() -> None:
         except ImportError as e:
             raise SystemExit(f"SERVE_BACKEND={backend_kind} needs serve.engine: {e}")
         backend = build_engine_from_env()
+    if getattr(backend, "is_follower", False):
+        # Multi-host follower: no HTTP front — mirror the leader's
+        # programs until it broadcasts shutdown (serve/multihost.py).
+        backend.follower_loop()
+        return
     OllamaServer(backend).serve_forever()
 
 

@@ -200,7 +200,17 @@ class TPUEngine:
 
 def build_engine_from_env() -> Backend:
     """Engine from env vars; falls back to a random tiny model + byte
-    tokenizer when no checkpoint is configured (runs anywhere)."""
+    tokenizer when no checkpoint is configured (runs anywhere).
+
+    ``SERVE_COORDINATOR`` (or the JAX_COORDINATOR/... trio) switches to
+    the multi-host SPMD engine: every process joins the distributed
+    runtime and shards the model over the hybrid dp-over-DCN mesh;
+    process 0 serves HTTP, the rest mirror its programs
+    (serve/multihost.py — api.main() dispatches follower_loop)."""
+    coord = env_or("SERVE_COORDINATOR", "") or None
+    if coord or env_or("JAX_COORDINATOR", ""):
+        from .multihost import build_multihost_engine
+        return build_multihost_engine(coord)
     ckpt_dir = env_or("CKPT_DIR", "")
     num_slots = env_int("SERVE_SLOTS", 8)
     max_seq = env_int("SERVE_MAX_SEQ", 1024)
@@ -330,8 +340,21 @@ def build_engine_from_env() -> Backend:
             if any(t == tag for t, _ in specs):
                 raise SystemExit(f"SERVE_MODELS has duplicate tag {tag!r}")
             specs.append((tag, ref or tag))
+        def is_ckpt_ref(ref: str) -> bool:
+            """A ref is a checkpoint dir only when it LOOKS like a path
+            (contains a separator) or is not a registered config name —
+            a bare config name that happens to collide with a directory
+            in the CWD (e.g. ./tiny) must still serve the config."""
+            if os.sep in ref:
+                return True
+            if ref in __import__(
+                    "p2p_llm_chat_tpu.models.configs",
+                    fromlist=["CONFIGS"]).CONFIGS:
+                return False
+            return os.path.isdir(ref)
+
         for tag, ref in specs:
-            if os.sep in ref or os.path.isdir(ref):
+            if is_ckpt_ref(ref):
                 if not os.path.isdir(ref):
                     raise SystemExit(
                         f"SERVE_MODELS entry {tag}={ref}: no such "
@@ -344,7 +367,7 @@ def build_engine_from_env() -> Backend:
                                      f"{e}") from None
         backends: dict = {}
         for i, (tag, ref) in enumerate(specs):
-            if os.sep in ref or os.path.isdir(ref):
+            if is_ckpt_ref(ref):
                 backends[tag] = load_ckpt_engine(tag, ref)
             else:
                 config = get_config(ref)

@@ -201,7 +201,13 @@ class BatchScheduler:
         kv-head) scales (ops/paged_kv.py). Decode is KV-bandwidth-bound,
         so this trades ~s/2 elementwise KV rounding (outputs may differ
         slightly from the bf16 oracle) for half the attention read
-        traffic and double the context capacity per pool byte.
+        traffic and double the context capacity per pool byte. Under
+        kv_quant, spec-mode output tracks plain-tick output to rounding
+        error rather than bit-exactly: both attend-before-write paths
+        see the current block at full precision, but the verify block's
+        EARLIER drafts are unquantized where the plain path, once they
+        commit, reads them quantized — logit ties can flip
+        (ops/paged_attention.paged_attention_verify_append).
 
         ``prefix_cache``: shared-prefix KV caching (serve/prefix.py).
         Prompts that begin with a cached prefix (the co-pilot template,
