@@ -124,13 +124,48 @@ def main() -> None:
             from p2p_llm_chat_tpu.models.quant import quantize_params
             params = quantize_params(params)
     from p2p_llm_chat_tpu.models.quant import QTensor
+    workload = os.environ.get("BENCH_WORKLOAD", "")
+    if workload == "quote":
+        # Speculation workload (VERDICT r3 #6): a RANDOM-init model's
+        # greedy continuation repeats essentially no n-grams (measured:
+        # 251/256 unique tokens, 0 draft acceptances), so prompt-lookup
+        # speculation cannot be measured on it. Real co-pilot replies
+        # quote their context; this constructs a synthetic checkpoint
+        # with that output statistic: embed rows are near-orthogonal and
+        # lm_head maps each token's embedding to a fixed successor
+        # (cycles of length 16), so greedy output settles into a
+        # repeating phrase while every forward still pays the FULL model
+        # compute (all layers keep their random weights). Spec rows on
+        # this workload measure the true verify-tick cost vs accepted-
+        # draft win of the mechanism end-to-end.
+        if config.tie_embeddings:
+            raise SystemExit("BENCH_WORKLOAD=quote needs an untied lm_head "
+                             "(tied configs would ignore the successor-"
+                             "cycle construction and measure a degenerate "
+                             "self-repeat stream)")
+        C = 16
+        V, H = config.vocab_size, config.hidden_size
+        emb = np.asarray(jax.random.normal(jax.random.PRNGKey(7), (V, H),
+                                           jnp.float32))
+        perm = (np.arange(V) // C) * C + (np.arange(V) % C + 1) % C
+        inv = np.empty(V, np.int64)
+        inv[perm] = np.arange(V)
+        lm = emb[inv].T * 4.0          # logits peak hard at the successor
+        params = dict(params)
+        params["embed"] = jnp.asarray(emb, dtype)
+        from p2p_llm_chat_tpu.models.quant import quantize
+        params["lm_head"] = (quantize(jnp.asarray(lm, jnp.float32))
+                             if isinstance(params.get("lm_head"), QTensor)
+                             else jnp.asarray(lm, dtype))
+        del emb, lm
     n_params = sum(
         (x.q.size if isinstance(x, QTensor) else x.size)
         for x in jax.tree.leaves(
             params, is_leaf=lambda x: isinstance(x, QTensor)))
     jax.block_until_ready(params)
     log(f"params: {n_params/1e9:.2f}B ({dtype.__name__}"
-        f"{', int8 weights' if quant else ''})")
+        f"{', int8 weights' if quant else ''}"
+        f"{', quote workload' if workload == 'quote' else ''})")
 
     # Default int8 KV only where it applies: BENCH_KV=dense stripped-down
     # runs and PAGED_ATTN_IMPL=kernel|flash measurements (int8 pools are

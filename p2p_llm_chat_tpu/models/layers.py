@@ -154,24 +154,35 @@ def flash_attend_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
         return attend_gqa(q, k, v, mask)
     assert Skv % chunk == 0, (Skv, chunk)   # power-of-two windows hold this
     N = Skv // chunk
-    qg = q.reshape(B, Sq, G, rep, D)
     if mask is None:
         mask = jnp.ones((1, 1, Sq, Skv), bool)
     if mask.ndim == 4:
         mask = mask[:, :, None]             # [B|1, 1, 1, Sq, Skv]
     mask = jnp.broadcast_to(mask, (B, 1, 1, Sq, Skv))
 
-    kc = k.reshape(B, N, chunk, G, D).transpose(1, 0, 2, 3, 4)
-    vc = v.reshape(B, N, chunk, G, D).transpose(1, 0, 2, 3, 4)
+    # Chunks carry kv EXPANDED to query heads (repeat_kv): prefill is
+    # compute-bound, so the rep-fold read matters not at all, while the
+    # unexpanded [B,G,rep,Sq,chunk] statistics put a size-2 dim next to
+    # the minors and XLA answered with transposed layouts + VPU-shaped
+    # chains — measured ~2/5 of the whole B=2 S=2048 prefill. Natural
+    # [B,Hq,Sq,chunk] shapes + bf16 probs into the p.v dot (f32 MXU runs
+    # at 1/8 rate; the dense attend casts probs too) took a 22-layer
+    # prefill from 87 to >110 TFLOPs/chip. (The DECODE paths keep the
+    # unexpanded contraction — there the rep-fold kv READ is the
+    # bandwidth bound; see attend_gqa.)
+    kc = repeat_kv(k, rep).reshape(B, N, chunk, Hq, D).transpose(
+        1, 0, 2, 3, 4)
+    vc = repeat_kv(v, rep).reshape(B, N, chunk, Hq, D).transpose(
+        1, 0, 2, 3, 4)
     mc = mask.reshape(B, 1, 1, Sq, N, chunk).transpose(4, 0, 1, 2, 3, 5)
 
     def body(carry, xs):
         m, l, acc = carry
-        kb, vb, mb = xs                     # [B,chunk,G,D], mask [B,1,1,Sq,chunk]
-        s = jnp.einsum("bsgrd,btgd->bgrst", qg, kb,
+        kb, vb, mb = xs          # [B,chunk,Hq,D], mask [B,1,1,Sq,chunk]
+        s = jnp.einsum("bshd,bthd->bhst", q, kb,
                        preferred_element_type=jnp.float32)
         s = s / jnp.sqrt(D).astype(jnp.float32)
-        s = jnp.where(mb, s, NEG_INF)
+        s = jnp.where(mb[:, 0], s, NEG_INF)               # [B,Hq,Sq,chunk]
         m_new = jnp.maximum(m, s.max(axis=-1))
         # Fully-masked-so-far rows keep m at NEG_INF; exp(NEG_INF-NEG_INF)
         # would poison alpha, so clamp the shift.
@@ -181,15 +192,16 @@ def flash_attend_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
         p = jnp.where(m_new[..., None] <= NEG_INF / 2, 0.0, p)
         l = l * alpha + p.sum(axis=-1)
         acc = acc * alpha[..., None] + jnp.einsum(
-            "bgrst,btgd->bgrsd", p, vb.astype(jnp.float32))
+            "bhst,bthd->bhsd", p.astype(v.dtype), vb,
+            preferred_element_type=jnp.float32)
         return (m_new, l, acc), None
 
-    m0 = jnp.full((B, G, rep, Sq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, G, rep, Sq), jnp.float32)
-    a0 = jnp.zeros((B, G, rep, Sq, D), jnp.float32)
+    m0 = jnp.full((B, Hq, Sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Hq, Sq), jnp.float32)
+    a0 = jnp.zeros((B, Hq, Sq, D), jnp.float32)
     (m, l, acc), _ = jax.lax.scan(body, (m0, l0, a0), (kc, vc, mc))
     out = acc / jnp.maximum(l, 1e-20)[..., None]
-    return out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, Hq, D).astype(q.dtype)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 # Score tensors past this many f32 elements take the chunked flash path.
@@ -201,17 +213,68 @@ def flash_attend_gqa(q: jax.Array, k: jax.Array, v: jax.Array,
 _FLASH_SCORE_ELEMS = 2 ** 25
 
 
+_ON_TPU: Optional[bool] = None
+
+
+def _tpu_backend() -> bool:
+    global _ON_TPU
+    if _ON_TPU is None:
+        _ON_TPU = jax.default_backend() == "tpu"
+    return _ON_TPU
+
+
+def attend_gqa_causal0(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Causal-from-position-0 attention via the canonical Pallas TPU
+    flash kernel (jax.experimental.pallas.ops) — probabilities never
+    leave VMEM, where the XLA chunk-scan path round-trips the f32 score
+    tensor through HBM three times per chunk (~2.2 ms/layer at B=2
+    S=2048 vs 0.41 ms for the kernel at the tuned 512x512 blocks; the
+    kernel also skips the causally-dead upper triangle). kv expands to
+    query heads first — prefill is compute-bound, the rep-fold read is
+    noise. q/k/v: [B, S, H*, D] with equal S; returns [B, S, Hq, D]."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention)
+
+    B, S, Hq, D = q.shape
+    rep = Hq // k.shape[2]
+    kx = repeat_kv(k, rep).transpose(0, 2, 1, 3)       # [B, Hq, S, D]
+    vx = repeat_kv(v, rep).transpose(0, 2, 1, 3)
+    bq = bkv = min(512, S)
+    bs = BlockSizes(block_q=bq, block_k_major=bkv, block_k=bkv, block_b=1,
+                    block_q_major_dkv=bq, block_k_major_dkv=bkv,
+                    block_k_dkv=bkv, block_q_dkv=bq,
+                    block_k_major_dq=bkv, block_k_dq=bkv, block_q_dq=bq)
+    out = flash_attention(q.transpose(0, 2, 1, 3), kx, vx, causal=True,
+                          sm_scale=1.0 / (D ** 0.5), block_sizes=bs)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
 def attend_gqa_auto(q: jax.Array, k: jax.Array, v: jax.Array,
-                    mask: Optional[jax.Array]) -> jax.Array:
-    """attend_gqa, switching to the chunked flash path when the score
-    tensor would be HBM-hostile (long-context prefill at batch). The KV
-    length must also divide the chunk — SERVE_MAX_SEQ is user-set and
-    need not be a power of two; an indivisible length stays on the dense
-    path rather than tripping the flash kernel's layout assert."""
+                    mask: Optional[jax.Array],
+                    causal0_len: Optional[int] = None) -> jax.Array:
+    """attend_gqa, switching to a flash path when the score tensor would
+    be HBM-hostile (long-context prefill at batch).
+
+    ``causal0_len``: set by callers whose mask is EXACTLY causal from
+    position 0 over the first ``causal0_len`` kv slots (llama.prefill's
+    whole-prompt path) — on TPU those shapes take the canonical Pallas
+    flash kernel (attend_gqa_causal0); everything else (ragged admission
+    splices, prefix-spliced suffixes, CPU tests) keeps the XLA paths.
+    The KV length must divide the chunk for the XLA flash scan —
+    SERVE_MAX_SEQ is user-set and need not be a power of two; an
+    indivisible length stays on the dense path."""
     B, Sq, Hq, D = q.shape
     Skv = k.shape[1]
-    if (B * Hq * Sq * Skv > _FLASH_SCORE_ELEMS and Skv >= 1024
-            and Skv % 512 == 0):
+    big = B * Hq * Sq * Skv > _FLASH_SCORE_ELEMS
+    if (big and causal0_len is not None and causal0_len == Sq
+            and _tpu_backend() and Sq % 512 == 0 and D % 128 == 0):
+        return attend_gqa_causal0(q, k[:, :Sq], v[:, :Sq])
+    if big and Sq >= 256 and Skv >= 1024 and Skv % 512 == 0:
+        # Sq >= 256 keeps DECODE-side shapes (speculative verify: a few
+        # query positions against a long window) off the flash scan,
+        # whose repeat_kv-expanded chunks would pay rep-fold KV traffic
+        # on a bandwidth-bound path; the dense attend materialises the
+        # modest [B,G,rep,Sq,W] scores once instead.
         # Chunk 1024 measured ~6% faster than 512 on v5e at long-prefill
         # shapes (fewer scan steps, same VMEM fit); fall back to 512 when
         # the KV length doesn't divide.

@@ -405,7 +405,8 @@ def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
            positions: jax.Array, cache_k: jax.Array, cache_v: jax.Array,
            layer: jax.Array, write_pos: jax.Array, mask: jax.Array,
            mesh: Optional[Mesh], rules: LogicalRules,
-           kv_window: Optional[int] = None, mlp_fn=None):
+           kv_window: Optional[int] = None, mlp_fn=None,
+           causal0: bool = False):
     """One decoder block against the full stacked cache.
 
     h: [B,S,H]; cache_k/v: [L,B,max_seq,Hkv,D] (the whole stacked cache —
@@ -443,7 +444,12 @@ def _block(h: jax.Array, lp: dict, config: ModelConfig, inv_freq: jax.Array,
         k_layer = k_layer[:, :kv_window]
         v_layer = v_layer[:, :kv_window]
 
-    attn = attend_gqa_auto(q, k_layer, v_layer, mask)  # [B,S,H,D]
+    # The Pallas causal0 kernel cannot consume mesh-sharded operands
+    # (same policy as the quant matmul kernels): under a mesh the XLA
+    # flash path shards fine and stays.
+    attn = attend_gqa_auto(
+        q, k_layer, v_layer, mask,
+        causal0_len=S if (causal0 and mesh is None) else None)  # [B,S,H,D]
     return _post_attn(h, attn, lp, config, mesh, rules, mlp_fn), \
         cache_k, cache_v
 
@@ -453,7 +459,8 @@ def hidden_states(params: dict, config: ModelConfig, tokens: jax.Array,
                   mesh: Optional[Mesh] = None,
                   rules: LogicalRules = DEFAULT_RULES,
                   kv_window: Optional[int] = None,
-                  mlp_fn=None) -> tuple[jax.Array, KVCache]:
+                  mlp_fn=None, causal0: bool = False
+                  ) -> tuple[jax.Array, KVCache]:
     """embed -> scan(blocks) -> final norm. Returns (h [B,S,H], cache) —
     the shared trunk of :func:`forward`; also the embedding feature
     extractor (:func:`embed_pooled` / the serve /api/embed path)."""
@@ -468,7 +475,7 @@ def hidden_states(params: dict, config: ModelConfig, tokens: jax.Array,
         lp = _layer_view(params["layers"], layer)
         h, ck, cv = _block(h, lp, config, inv_freq, positions, ck, cv,
                            layer, positions, mask, mesh, rules, kv_window,
-                           mlp_fn)
+                           mlp_fn, causal0)
         return (h, ck, cv), None
 
     (h, new_k, new_v), _ = jax.lax.scan(
@@ -482,7 +489,8 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
             mesh: Optional[Mesh] = None,
             rules: LogicalRules = DEFAULT_RULES,
             kv_window: Optional[int] = None,
-            mlp_fn=None) -> tuple[jax.Array, KVCache]:
+            mlp_fn=None, causal0: bool = False
+            ) -> tuple[jax.Array, KVCache]:
     """Shared forward: embed -> scan(blocks) -> norm -> logits.
 
     tokens/positions: [B,S]; mask: [B or 1,1,S,W] (True = attend) where W
@@ -491,7 +499,7 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
     every layer's cache. Returns (logits [B,S,vocab] f32, updated cache).
     """
     h, cache = hidden_states(params, config, tokens, positions, cache, mask,
-                             mesh, rules, kv_window, mlp_fn)
+                             mesh, rules, kv_window, mlp_fn, causal0)
     lm_head = (params["embed"].T if config.tie_embeddings
                else params["lm_head"])
     logits = mm(h, lm_head).astype(jnp.float32)
@@ -539,8 +547,11 @@ def prefill(params: dict, config: ModelConfig, tokens: jax.Array,
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
     mask = causal_mask(S, cache.k.shape[2], 0)        # [1,1,S,max_seq]
+    # The mask is exactly causal-from-0 over the first S kv slots (pads
+    # sit after prompts; slots past S are causally dead), so big shapes
+    # may take the Pallas flash-kernel path (layers.attend_gqa_auto).
     logits, cache = forward(params, config, tokens, positions, cache, mask,
-                            mesh, rules)
+                            mesh, rules, causal0=True)
     return logits, cache._replace(lengths=prompt_lens.astype(jnp.int32))
 
 

@@ -195,13 +195,13 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
             mesh: Optional[Mesh] = None,
             rules: LogicalRules = DEFAULT_RULES,
             kv_window: Optional[int] = None,
-            capacity=_AUTO) -> tuple[jax.Array, KVCache]:
+            capacity=_AUTO, causal0: bool = False) -> tuple[jax.Array, KVCache]:
     """llama.forward with the sparse-MoE MLP plugged in (same contract)."""
     cap = _capacity_for(config, int(tokens.shape[0] * tokens.shape[1]),
                         capacity)
     return llama.forward(params, config, tokens, positions, cache, mask,
                          mesh, rules, kv_window,
-                         mlp_fn=_mlp_fn(config, cap))
+                         mlp_fn=_mlp_fn(config, cap), causal0=causal0)
 
 
 def prefill(params: dict, config: ModelConfig, tokens: jax.Array,
@@ -214,7 +214,7 @@ def prefill(params: dict, config: ModelConfig, tokens: jax.Array,
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
     mask = causal_mask(S, cache.k.shape[2], 0)
     logits, cache = forward(params, config, tokens, positions, cache, mask,
-                            mesh, rules, capacity=capacity)
+                            mesh, rules, capacity=capacity, causal0=True)
     return logits, cache._replace(lengths=prompt_lens.astype(jnp.int32))
 
 

@@ -120,15 +120,27 @@ def _kernel_wanted() -> bool:
     return _BACKEND_IS_TPU
 
 
+def _deq_once(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
+    """Materialised one-shot dequant for prefill-shaped dots.
+
+    ``x @ q.astype(bf16)`` lets XLA fuse the convert INTO the dot, which
+    re-reads (and re-converts) the whole int8 weight once per M-tile of
+    the output — measured 23.5 ms for ONE bench-1b wgu prefill matmul
+    whose FLOP bound is ~1.3 ms (B=2 S=2048: 32 M-tiles x 23 MB weight
+    re-read per layer). The optimization barrier forces the dequant to
+    materialise once, and the standard dot emitter then streams the bf16
+    weight at matmul speed."""
+    return jax.lax.optimization_barrier(dequantize(QTensor(q, s), dtype))
+
+
 def mm(x: jax.Array, w) -> jax.Array:
     """``x @ w`` for a plain array or a :class:`QTensor`.
 
     Quantized weights: decode-shaped calls (<= _KERNEL_MAX_ROWS rows, 2D
     weight, kernel-friendly dims, TPU backend) go through the Pallas
-    w8a16 kernel so HBM reads int8 only; everything else dequantizes
-    inline on the XLA path (correct anywhere, and the right choice for
-    compute-bound prefill). Both scale per output channel after the
-    contraction."""
+    w8a16 kernel so HBM reads int8 only; prefill-shaped calls
+    dequantize ONCE behind an optimization barrier (see _deq_once) and
+    run a plain bf16 dot. Both scale per output channel."""
     if isinstance(w, LayerSlice):
         lead, H = x.shape[:-1], x.shape[-1]
         rows = 1
@@ -159,6 +171,8 @@ def mm(x: jax.Array, w) -> jax.Array:
             if pick_block(H) and pick_block(w.q.shape[1]):
                 y = quant_matmul(x.reshape(rows, H), w.q, w.s)
                 return y.reshape(*lead, w.q.shape[1])
+        if rows > _KERNEL_MAX_ROWS and w.q.ndim == 2:
+            return x @ _deq_once(w.q, w.s, x.dtype)
         return (x @ w.q.astype(x.dtype)) * jnp.squeeze(w.s, -2).astype(x.dtype)
     return x @ w
 
