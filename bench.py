@@ -53,6 +53,12 @@ Env knobs (all optional):
                         overhead — measured 0 accepted drafts even at
                         greedy. Enable for real checkpoints, where
                         suggestion replies quote their context)
+- ``BENCH_WORKLOAD``    quote = synthetic checkpoint whose greedy output
+                        repeats a 16-token phrase (the quote-the-context
+                        statistic of real co-pilot replies; full model
+                        compute) — THE workload where BENCH_SPEC wins:
+                        measured +51% served tok/s at K=4 greedy with
+                        3,128/4,096 tokens from accepted drafts
 - ``BENCH_PREFIX``      shared-prefix KV cache (default 1; 0 disables)
 - ``BENCH_TEMP``        request temperature (default 0.7; 0 = greedy —
                         the workload where prompt-lookup spec drafts
@@ -111,7 +117,24 @@ def main() -> None:
     family = family_for(config)   # llama or mixtral (bench-moe)
     dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
     quant = os.environ.get("BENCH_QUANT", "int8")    # "" | int8
-    if quant == "int8" and hasattr(family, "init_params_quantized"):
+    workload = os.environ.get("BENCH_WORKLOAD", "")
+    stream_int8 = (quant == "int8"
+                   and hasattr(family, "init_params_quantized"))
+    if workload == "quote":
+        # Speculation / streaming workload (models/synth.py): random
+        # transformer layers (full compute) + an embed/lm_head whose
+        # greedy output repeats a printable 16-token phrase — the
+        # quote-the-context statistic of real co-pilot replies that
+        # random init cannot produce (251/256 unique tokens, 0 draft
+        # acceptances measured). Spec rows on this workload measure the
+        # true verify-tick cost vs accepted-draft win end-to-end.
+        from p2p_llm_chat_tpu.models.synth import quote_params
+        params = quote_params(config, jax.random.PRNGKey(0), dtype=dtype,
+                              quantized=stream_int8)
+        if quant == "int8" and not stream_int8:
+            from p2p_llm_chat_tpu.models.quant import quantize_params
+            params = quantize_params(params)
+    elif stream_int8:
         # Streamed straight to fused int8 — never materialises the bf16
         # tree, which is what lets BENCH_CONFIG=llama3.1-8b (16 GB bf16)
         # run on one 16 GB v5e chip (llama.init_params_quantized).
@@ -124,40 +147,6 @@ def main() -> None:
             from p2p_llm_chat_tpu.models.quant import quantize_params
             params = quantize_params(params)
     from p2p_llm_chat_tpu.models.quant import QTensor
-    workload = os.environ.get("BENCH_WORKLOAD", "")
-    if workload == "quote":
-        # Speculation workload (VERDICT r3 #6): a RANDOM-init model's
-        # greedy continuation repeats essentially no n-grams (measured:
-        # 251/256 unique tokens, 0 draft acceptances), so prompt-lookup
-        # speculation cannot be measured on it. Real co-pilot replies
-        # quote their context; this constructs a synthetic checkpoint
-        # with that output statistic: embed rows are near-orthogonal and
-        # lm_head maps each token's embedding to a fixed successor
-        # (cycles of length 16), so greedy output settles into a
-        # repeating phrase while every forward still pays the FULL model
-        # compute (all layers keep their random weights). Spec rows on
-        # this workload measure the true verify-tick cost vs accepted-
-        # draft win of the mechanism end-to-end.
-        if config.tie_embeddings:
-            raise SystemExit("BENCH_WORKLOAD=quote needs an untied lm_head "
-                             "(tied configs would ignore the successor-"
-                             "cycle construction and measure a degenerate "
-                             "self-repeat stream)")
-        C = 16
-        V, H = config.vocab_size, config.hidden_size
-        emb = np.asarray(jax.random.normal(jax.random.PRNGKey(7), (V, H),
-                                           jnp.float32))
-        perm = (np.arange(V) // C) * C + (np.arange(V) % C + 1) % C
-        inv = np.empty(V, np.int64)
-        inv[perm] = np.arange(V)
-        lm = emb[inv].T * 4.0          # logits peak hard at the successor
-        params = dict(params)
-        params["embed"] = jnp.asarray(emb, dtype)
-        from p2p_llm_chat_tpu.models.quant import quantize
-        params["lm_head"] = (quantize(jnp.asarray(lm, jnp.float32))
-                             if isinstance(params.get("lm_head"), QTensor)
-                             else jnp.asarray(lm, dtype))
-        del emb, lm
     n_params = sum(
         (x.q.size if isinstance(x, QTensor) else x.size)
         for x in jax.tree.leaves(

@@ -94,6 +94,16 @@ def load_checkpoint(ckpt_dir: str, mesh: Optional[Mesh] = None,
                 sharding=NamedSharding(mesh, spec_for(ax, rules))),
             abstract, axes,
             is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
+    else:
+        # Orbax requires CONCRETE shardings on some backends (observed on
+        # the axon TPU plugin: "sharding passed to deserialization should
+        # be specified" with a bare ShapeDtypeStruct).
+        single = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        abstract = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=single),
+            abstract,
+            is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct))
     with ocp.StandardCheckpointer() as ckptr:
         params = ckptr.restore(os.path.join(ckpt_dir, _TREE), abstract)
     log.info("restored %s (%s) from %s%s", config.name, dtype, ckpt_dir,

@@ -1,0 +1,86 @@
+"""Synthetic "quote-the-context" checkpoints for benchmarking.
+
+No public checkpoint ships in this image (zero egress), and a RANDOM-init
+model's output has two properties that break realistic end-to-end
+measurement: its greedy continuation repeats essentially no n-grams
+(speculative prompt-lookup can never land — measured 251/256 unique
+tokens, 0 acceptances), and its sampled byte stream almost never forms
+valid UTF-8, so the incremental detokenizer buffers nearly the whole
+generation and "streaming" TTFT at a UI degrades to completion time.
+
+:func:`quote_params` builds a full-size random tree whose OUTPUT
+statistics match a real co-pilot's instead: embeddings are
+near-orthogonal and the lm_head maps each token's embedding to a fixed
+successor, with the successor cycles laid INSIDE the byte tokenizer's
+printable-ASCII id range. Every forward still pays the full model
+compute (all transformer layers keep their random weights; the logit
+margin ~4*hidden is so large that sampling at any sane temperature
+follows the cycle), so decode/prefill cost is identical to a real
+checkpoint of the same config — but greedy/sampled output settles into a
+repeating printable phrase: prompt-lookup drafts land (the speculation
+benchmark) and the detokenizer streams byte-per-token (the UI-boundary
+TTFT benchmark). bench.py (BENCH_WORKLOAD=quote) and tools/e2e_bench.py
+share this construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .configs import ModelConfig
+
+# The byte tokenizer maps byte b to id b (specials live above 256); the
+# printable range streams through UTF-8 incremental decoding one byte at
+# a time.
+_ASCII_LO, _ASCII_HI = 32, 127
+_CYCLE = 16
+
+
+def successor_map(vocab: int) -> np.ndarray:
+    """succ[t] for every token id: printable-ASCII ids cycle in blocks of
+    ``_CYCLE`` within the printable range; every other id funnels into
+    the printable range so one step after any stray token the stream is
+    printable forever."""
+    ids = np.arange(_ASCII_LO, _ASCII_HI)
+    succ = np.empty(vocab, np.int64)
+    # stray ids -> deterministic printable entry points
+    succ[:] = _ASCII_LO + (np.arange(vocab) % len(ids))
+    for start in range(0, len(ids), _CYCLE):
+        block = ids[start: start + _CYCLE]
+        succ[block] = np.roll(block, -1)
+    return succ
+
+
+def quote_params(config: ModelConfig, key: jax.Array,
+                 dtype=jnp.bfloat16, quantized: bool = False) -> dict:
+    """Full-size tree (random transformer layers, full compute) with the
+    quote-workload embed/lm_head. ``quantized=True`` streams the layers
+    straight to fused int8 (llama.init_params_quantized); the returned
+    lm_head is a QTensor then. Requires an untied lm_head."""
+    from . import llama
+    from .quant import quantize
+
+    if config.tie_embeddings:
+        raise ValueError("quote workload needs an untied lm_head")
+    if quantized:
+        params = llama.init_params_quantized(config, key, dtype=dtype)
+    else:
+        params = dict(llama.init_params(config, key, dtype=dtype))
+
+    V, H = config.vocab_size, config.hidden_size
+    emb = np.asarray(jax.random.normal(jax.random.PRNGKey(7), (V, H),
+                                       jnp.float32))
+    succ = successor_map(V)
+    # lm_head[:, j] = 4 * sum_{succ(t)=j} emb[t]: logits_j(t) contains
+    # 4*|emb[t]|^2 ~ 4H exactly when j = succ(t); cross terms are
+    # O(4*sqrt(H)) — a margin sampling cannot overcome.
+    lm_t = np.zeros((V, H), np.float32)
+    np.add.at(lm_t, succ, emb)
+    lm = lm_t.T * 4.0
+    params = dict(params)
+    params["embed"] = jnp.asarray(emb, dtype)
+    params["lm_head"] = (quantize(jnp.asarray(lm, jnp.float32))
+                         if quantized else jnp.asarray(lm, dtype))
+    return params

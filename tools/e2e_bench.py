@@ -1,0 +1,232 @@
+"""End-to-end north-star rehearsal THROUGH THE CHAT PLANE.
+
+bench.py measures the scheduler directly; this drives the full reference
+deployment instead (VERDICT r3 #8): start_all.py boots the directory,
+the TPU serve front, N node daemons and N UI servers; every peer
+receives a real P2P message (UI -> node /send -> encrypted stream ->
+peer inbox), then all N UIs fire their co-pilot suggestion concurrently
+(POST /api/suggest/stream — the exact HTTP path the browser JS calls)
+and we record time-to-first-delta at the UI boundary. The HTTP hops,
+node hops, UI server, serve front, scheduler and chip are all in the
+number.
+
+Usage: python tools/e2e_bench.py [--peers 32] [--config bench-1b]
+Prints a one-line JSON summary (p50/p95 UI-boundary TTFT).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def wait_http(url: str, deadline_s: float = 240.0) -> None:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            urllib.request.urlopen(url, timeout=2)
+            return
+        except Exception:
+            time.sleep(0.5)
+    raise RuntimeError(f"{url} never came up (launcher tail: "
+                       f"{b''.join(globals().get('_TAIL', []))[-800:]!r})")
+
+
+def post(url: str, body: dict, timeout: float = 120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=32)
+    ap.add_argument("--config", default="bench-1b")
+    ap.add_argument("--node-base", type=int, default=19081)
+    ap.add_argument("--ui-base", type=int, default=19501)
+    ap.add_argument("--dir-port", type=int, default=19480)
+    ap.add_argument("--serve-port", type=int, default=19490)
+    ap.add_argument("--workload", default="quote",
+                    choices=["quote", "random"],
+                    help="quote (default): serve a synthetic checkpoint "
+                         "whose output is a repeating printable phrase "
+                         "(models/synth.py) so suggestions stream as "
+                         "text; random: raw random init, whose non-UTF-8 "
+                         "byte stream buffers in the detokenizer and "
+                         "degrades streaming TTFT to completion time")
+    args = ap.parse_args()
+    n = args.peers
+    users = [f"peer{i:02d}" for i in range(n)]
+
+    env = dict(
+        os.environ,
+        MODEL_CONFIG=args.config,
+        SERVE_SLOTS=str(n),
+        SERVE_MAX_SEQ="1024",
+        SERVE_KV="paged",
+        SERVE_QUANT="int8",
+        SERVE_KV_QUANT="int8",
+        SERVE_WARMUP="64,128,256",
+        # PREPEND to PYTHONPATH: clobbering it drops /root/.axon_site,
+        # where the axon TPU PJRT plugin lives, and the serve subprocess
+        # silently loses the chip.
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    if args.workload == "quote":
+        # Build the quote checkpoint in a CPU subprocess (importing jax
+        # HERE would grab the axon TPU tunnel away from the serve).
+        ckpt_dir = tempfile.mkdtemp(prefix="e2e_quote_")
+        build = (
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import jax.numpy as jnp\n"
+            "from p2p_llm_chat_tpu.models.synth import quote_params\n"
+            "from p2p_llm_chat_tpu.models.configs import get_config\n"
+            "from p2p_llm_chat_tpu.models.checkpoint import save_checkpoint\n"
+            f"cfg = get_config({args.config!r})\n"
+            "params = quote_params(cfg, jax.random.PRNGKey(0), "
+            "dtype=jnp.bfloat16)\n"
+            f"save_checkpoint({ckpt_dir!r}, params, cfg)\n")
+        subprocess.run([sys.executable, "-c", build], env=env, check=True)
+        env["CKPT_DIR"] = ckpt_dir
+        env["LLM_MODEL"] = args.config
+
+    launcher = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "start_all.py"),
+         "--backend", "tpu", "--users", ",".join(users),
+         "--node-port-base", str(args.node_base),
+         "--ui-port-base", str(args.ui_base),
+         "--dir-port", str(args.dir_port),
+         "--serve-port", str(args.serve_port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    # Drain launcher output (an undrained PIPE fills and BLOCKS the
+    # launcher mid-boot); keep a tail for diagnostics.
+    tail: list[bytes] = []
+    globals()["_TAIL"] = tail
+
+    def drain() -> None:
+        for line in launcher.stdout:
+            tail.append(line)
+            del tail[:-50]
+
+    threading.Thread(target=drain, daemon=True).start()
+    try:
+        # The launcher boots the serve front FIRST (model init + warmup on
+        # the chip can take minutes) and only then the nodes/UIs.
+        wait_http(f"http://127.0.0.1:{args.serve_port}/api/tags",
+                  deadline_s=600.0)
+        for i in range(n):
+            wait_http(f"http://127.0.0.1:{args.node_base + i}/healthz")
+            wait_http(f"http://127.0.0.1:{args.ui_base + i}/")
+        post(f"http://127.0.0.1:{args.serve_port}/api/generate",
+             {"model": args.config, "prompt": "warm", "stream": False,
+              "options": {"num_predict": 4}}, timeout=240).read()
+        # Practice suggestion through one UI: compiles any admission/
+        # decode program the warmup ladder missed, so the measured burst
+        # sees the steady-state TTFT (bench.py does the same).
+        post(f"http://127.0.0.1:{args.ui_base}/api/suggest",
+             {"content": "warmup message, please ignore"},
+             timeout=240).read()
+
+        # Each peer i sends a message to peer (i+1) % n over the real
+        # node path; the recipient's UI then has an inbox message to
+        # suggest a reply to.
+        # Distinct per-peer texts (real peers don't send 32 identical
+        # messages; an identical-prompt burst additionally triggers a
+        # prefix-cache auto-promotion build mid-burst, whose compile
+        # stalls the scheduler thread for seconds).
+        msgs = [f"Hey {users[(i + 1) % n]}, are we still meeting "
+                f"tomorrow at {8 + i % 9}:{15 * (i % 4):02d}?"
+                for i in range(n)]
+        for i in range(n):
+            to = users[(i + 1) % n]
+            with post(f"http://127.0.0.1:{args.ui_base + i}/node/send",
+                      {"to_username": to, "content": msgs[i]}) as r:
+                assert json.loads(r.read()).get("status") == "sent"
+        time.sleep(1.0)
+
+        # All peers fire the co-pilot suggestion concurrently; TTFT =
+        # time to the first NDJSON delta at the UI boundary.
+        ttfts: list[float] = [0.0] * n
+        errs: list[str] = []
+
+        def suggest(i: int) -> None:
+            t0 = time.monotonic()
+            try:
+                r = post(
+                    f"http://127.0.0.1:{args.ui_base + i}/api/suggest/stream",
+                    {"content": msgs[(i - 1) % n]})
+                first = None
+                nline = 0
+                for line in r:
+                    d = json.loads(line)
+                    nline += 1
+                    if nline <= 3 and i < 4:
+                        print(f"peer{i} line{nline} @{time.monotonic()-t0:.2f}s: "
+                              f"{line[:80]!r}", file=sys.stderr)
+                    if d.get("error"):
+                        errs.append(str(d))
+                        return
+                    if first is None and d.get("delta"):
+                        first = time.monotonic() - t0
+                    if d.get("done"):
+                        break
+                ttfts[i] = first if first is not None else -1.0
+            except Exception as e:   # noqa: BLE001
+                errs.append(f"peer{i}: {e}")
+
+        threads = [threading.Thread(target=suggest, args=(i,))
+                   for i in range(n)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        wall = time.monotonic() - t0
+        if errs:
+            print(f"suggest errors ({len(errs)}): {errs[:3]}",
+                  file=sys.stderr)
+        if len(errs) > n // 4:
+            raise RuntimeError(f"too many suggest errors: {errs[:5]}")
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{args.serve_port}/metrics",
+                    timeout=10) as m:
+                for line in m.read().decode().splitlines():
+                    if any(k in line for k in ("ttft", "admit", "queue",
+                                               "prefix", "occupancy")):
+                        print("serve-metric:", line, file=sys.stderr)
+        except Exception:
+            pass
+        good = sorted(t * 1e3 for t in ttfts if t > 0)
+        p50 = statistics.median(good)
+        p95 = good[min(len(good) - 1, int(0.95 * len(good)))]
+        print(json.dumps({
+            "metric": f"e2e_ui_ttft_ms_{n}_peers_{args.config}",
+            "p50_ttft_ms": round(p50, 1), "p95_ttft_ms": round(p95, 1),
+            "peers": n, "wall_s": round(wall, 2),
+            "path": "UI HTTP -> serve front -> scheduler -> chip",
+        }), flush=True)
+    finally:
+        launcher.terminate()
+        try:
+            launcher.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            launcher.kill()
+
+
+if __name__ == "__main__":
+    main()
