@@ -55,29 +55,64 @@ def successor_map(vocab: int) -> np.ndarray:
 
 def quote_params(config: ModelConfig, key: jax.Array,
                  dtype=jnp.bfloat16, quantized: bool = False) -> dict:
-    """Full-size tree (random transformer layers, full compute) with the
-    quote-workload embed/lm_head. ``quantized=True`` streams the layers
-    straight to fused int8 (llama.init_params_quantized); the returned
-    lm_head is a QTensor then. Requires an untied lm_head."""
-    from . import llama
-    from .quant import quantize
+    """Full-size tree (random transformer layers of the config's FAMILY —
+    llama or mixtral — full compute) with the quote-workload
+    embed/lm_head. ``quantized=True`` returns int8 matmul leaves (the
+    llama family streams straight to fused int8; other families quantize
+    after init). Requires an untied lm_head."""
+    from . import family_for, llama
+    from .quant import quantize, quantize_params
 
     if config.tie_embeddings:
         raise ValueError("quote workload needs an untied lm_head")
-    if quantized:
+    family = family_for(config)
+    if quantized and family is llama:
         params = llama.init_params_quantized(config, key, dtype=dtype)
     else:
-        params = dict(llama.init_params(config, key, dtype=dtype))
+        params = dict(family.init_params(config, key, dtype=dtype))
+        if quantized:
+            params = quantize_params(params)
+
+    # Damp the residual-writing projections (wo, w_down / expert
+    # w_down): the cycle construction needs the residual stream to stay
+    # dominated by the input embedding, and at small hidden sizes the
+    # random layers' perturbation otherwise out-shouts the successor
+    # margin (observed at the `tiny` config). Compute cost is unchanged
+    # — the matmuls still run at full shape.
+    from .quant import QTensor
+
+    def damp(leaf):
+        if isinstance(leaf, QTensor):
+            return QTensor(q=leaf.q, s=leaf.s * 0.1)
+        return leaf * 0.1
+
+    layers = dict(params["layers"])
+    for name in ("wo", "w_down"):
+        if name in layers:
+            layers[name] = damp(layers[name])
+    params = dict(params)
+    params["layers"] = layers
 
     V, H = config.vocab_size, config.hidden_size
     emb = np.asarray(jax.random.normal(jax.random.PRNGKey(7), (V, H),
                                        jnp.float32))
     succ = successor_map(V)
-    # lm_head[:, j] = 4 * sum_{succ(t)=j} emb[t]: logits_j(t) contains
-    # 4*|emb[t]|^2 ~ 4H exactly when j = succ(t); cross terms are
-    # O(4*sqrt(H)) — a margin sampling cannot overcome.
+    # lm_head[:, j] = 4 * sum_{succ(t)=j} w_t * emb[t]: logits_j(t)
+    # contains 4*w_t*|emb[t]|^2 ~ 4H exactly when j = succ(t). Printable
+    # tokens get w=1 (a pure in-range permutation); the ~V/95 stray
+    # tokens funnelled into each printable column are down-weighted by
+    # 1/sqrt(strays-per-column) so their summed cross-term noise stays
+    # at the O(4*sqrt(H)) of the permutation — an unweighted funnel at
+    # bench-1b scale (344 strays/column) put ~3300-sigma cross terms
+    # against the 4H ~ 8192 signal and broke the cycle on a nontrivial
+    # fraction of steps.
+    weights = np.full(V, 1.0, np.float32)
+    stray = np.ones(V, bool)
+    stray[_ASCII_LO:_ASCII_HI] = False
+    per_col = max(1, int(stray.sum()) // (_ASCII_HI - _ASCII_LO))
+    weights[stray] = 1.0 / np.sqrt(per_col)
     lm_t = np.zeros((V, H), np.float32)
-    np.add.at(lm_t, succ, emb)
+    np.add.at(lm_t, succ, emb * weights[:, None])
     lm = lm_t.T * 4.0
     params = dict(params)
     params["embed"] = jnp.asarray(emb, dtype)

@@ -391,3 +391,35 @@ def test_repeat_penalty_across_full_window(spec_k):
         assert got == _penalty_oracle(prompt, 24, 1.3, max_seq=256), spec_k
     finally:
         eng.stop()
+
+
+def test_quote_params_greedy_follows_printable_cycle():
+    """models/synth.quote_params: greedy decode follows the printable
+    successor cycles (the property that makes prompt-lookup drafts land
+    and suggestion streams decode as text — BASELINE.md round 4)."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_llm_chat_tpu.models import llama
+    from p2p_llm_chat_tpu.models.configs import get_config
+    from p2p_llm_chat_tpu.models.llama import KVCache
+    from p2p_llm_chat_tpu.models.synth import quote_params, successor_map
+
+    cfg = get_config("tiny")
+    params = quote_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    succ = successor_map(cfg.vocab_size)
+    ids = [1, ord("H"), ord("i")]          # BOS + printable prompt
+    cache = KVCache.create(cfg, 1, 64, dtype=jnp.float32)
+    logits, cache = llama.prefill(params, cfg, jnp.asarray([ids]),
+                                  jnp.asarray([len(ids)]), cache)
+    last = np.asarray(logits[0, len(ids) - 1])
+    cur = ids[-1]
+    for _ in range(24):
+        t = int(last.argmax())
+        assert t == int(succ[cur]), (cur, t, int(succ[cur]))
+        assert 32 <= t < 127          # printable: streams as UTF-8 text
+        cur = t
+        lg, cache = llama.decode_step(params, cfg, jnp.asarray([[t]]),
+                                      cache)
+        last = np.asarray(lg[0, 0])

@@ -212,12 +212,17 @@ def main() -> None:
         except Exception:
             pass
         good = sorted(t * 1e3 for t in ttfts if t > 0)
+        if not good:
+            raise RuntimeError(
+                f"no peer recorded a first delta (errors: {errs[:5]}; "
+                "empty generations or all streams done-without-delta)")
         p50 = statistics.median(good)
         p95 = good[min(len(good) - 1, int(0.95 * len(good)))]
         print(json.dumps({
             "metric": f"e2e_ui_ttft_ms_{n}_peers_{args.config}",
             "p50_ttft_ms": round(p50, 1), "p95_ttft_ms": round(p95, 1),
-            "peers": n, "wall_s": round(wall, 2),
+            "peers": n, "samples": len(good), "errors": len(errs),
+            "wall_s": round(wall, 2),
             "path": "UI HTTP -> serve front -> scheduler -> chip",
         }), flush=True)
     finally:
