@@ -338,3 +338,54 @@ def test_quantized_pool_write_paths_and_attention():
         paged_attention(q, cache2.k, cache2.v, cache2.page_table, lens + 1,
                         jnp.asarray(0), pages=mppr, impl="kernel",
                         k_scale=cache2.k_scale, v_scale=cache2.v_scale)
+
+
+def test_append_kernel_interpret_matches_gather():
+    """The opt-in Pallas append kernel (PAGED_APPEND_IMPL=kernel) agrees
+    with the gather path in interpret mode — CPU coverage for the Mosaic
+    program the TPU parity check (tools/check_append_kernel.py) runs on
+    hardware."""
+    import importlib
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_llm_chat_tpu.models.configs import get_config
+    from p2p_llm_chat_tpu.ops import paged_kv
+
+    pa = importlib.import_module("p2p_llm_chat_tpu.ops.paged_attention")
+    cfg = get_config("tiny-tp")     # 4 kv heads, head_dim 32
+    rng = np.random.default_rng(5)
+    B, pages, ps = 4, 2, 16
+    mppr = pages
+    for quantized in (False, True):
+        cache = paged_kv.PagedKVCache.create(
+            cfg, B, B * mppr + 1, ps, max_pages_per_row=mppr,
+            dtype=jnp.float32, quantized=quantized)
+        lens = []
+        for b in range(B):
+            n = int(rng.integers(1, pages * ps - 1))
+            lens.append(n)
+            table = jnp.asarray(1 + b * mppr + np.arange(mppr), jnp.int32)
+            rk = jnp.asarray(rng.normal(size=(cfg.num_layers, pages * ps,
+                                              cfg.num_kv_heads,
+                                              cfg.head_dim)), jnp.float32)
+            rv = jnp.asarray(rng.normal(size=rk.shape), jnp.float32)
+            cache = paged_kv.write_prefill_row(cache, rk, rv,
+                                               jnp.asarray(b),
+                                               jnp.asarray(n), table)
+        lens = jnp.asarray(lens, jnp.int32)
+        q = jnp.asarray(rng.normal(size=(B, cfg.num_heads, cfg.head_dim)),
+                        jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(B, cfg.num_kv_heads,
+                                          cfg.head_dim)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=kc.shape), jnp.float32)
+        kern = pa._paged_append_kernel_call(
+            q, kc, vc, cache.k, cache.v, cache.k_scale, cache.v_scale,
+            cache.page_table, lens, jnp.asarray(0), pages=pages,
+            quantized=quantized, interpret=True)
+        ref = pa.paged_attention_append(q, kc, vc, cache, lens,
+                                        jnp.asarray(0), pages=pages)
+        np.testing.assert_allclose(np.asarray(kern), np.asarray(ref),
+                                   atol=2e-2, rtol=2e-2)
