@@ -310,6 +310,18 @@ class BatchScheduler:
         self._n_prefix_tokens = 0     # prompt tokens NOT recomputed
         self._promote_q: list[tuple] = []   # heads awaiting a build slot
         self._last_promote_tick = 0
+        # Off-thread promotion builds: the build's jit compile + prefill
+        # read only the (immutable) params, so a worker thread computes
+        # the prefix KV while live ticks keep flowing; the scheduler
+        # thread remains the only WRITER of the store (it integrates
+        # finished builds from _promote_done each loop iteration).
+        # Measured before: an identical-prompt burst promoted its head
+        # mid-burst and the on-thread compile stalled every in-flight
+        # stream ~5 s.
+        self._promote_work: "queue.Queue[Optional[tuple]]" = queue.Queue()
+        self._promote_done: "queue.Queue[tuple]" = queue.Queue()
+        self._promote_pending: set = set()    # submitted, not yet integrated
+        self._promote_worker: Optional[threading.Thread] = None
         # Adaptive speculation: EMA of accepted drafts per spec tick.
         # The verify forward computes K+1 positions for every row, so
         # when drafts stop landing (non-repetitive output), paying it
@@ -668,12 +680,23 @@ class BatchScheduler:
         ids = self.tokenizer.encode(text, add_bos=True)
         return self._register_prefix_ids(ids[:P])
 
-    def _register_prefix_ids(self, ids: list[int]) -> int:
-        k, v = self._build_prefix_j(
+    def _build_prefix_kv(self, ids) -> tuple:
+        """Prefix KV for ``ids`` — reads only immutable state (params +
+        the jitted builder), so it is safe on the promotion worker
+        thread too."""
+        return self._build_prefix_j(
             self._params, jnp.asarray(np.asarray(ids, np.int32)[None, :]))
+
+    def _install_prefix(self, ids, k, v, note: str = "") -> None:
+        """Store insert + log (scheduler thread only — single writer)."""
         self._prefix.put(PrefixEntry(ids=tuple(ids), k=k, v=v))
-        log.info("cached prefix KV: %d tokens (%d entr%s)", len(ids),
-                 len(self._prefix), "y" if len(self._prefix) == 1 else "ies")
+        log.info("cached prefix KV: %d tokens (%d entr%s%s)", len(ids),
+                 len(self._prefix),
+                 "y" if len(self._prefix) == 1 else "ies", note)
+
+    def _register_prefix_ids(self, ids: list[int]) -> int:
+        k, v = self._build_prefix_kv(ids)
+        self._install_prefix(ids, k, v)
         return len(ids)
 
     def _decode_for(self, window: int):
@@ -788,6 +811,19 @@ class BatchScheduler:
                     for R in self._chunks_for(P + S, chunk_sizes):
                         steps.append(lambda P=P, S=S, R=R:
                                      self._warm_prefix_combo(P, S, R))
+            # Grain pre-warm: auto-promoted prefixes always land on the
+            # grain ladder, so compiling each grain's splice program for
+            # the SMALLEST suffix bucket now (synthetic zero entries —
+            # only shapes matter to the compile cache) means a hot
+            # template promoted mid-traffic admits through a warm
+            # program. Bounded: grains x 1 bucket x chunk widths.
+            smallest = buckets[0] if buckets else 0
+            for P in (self._prefix.grain_ladder if buckets else ()):
+                if P in plens or P + smallest > self.max_seq:
+                    continue
+                for R in self._chunks_for(P + smallest, chunk_sizes):
+                    steps.append(lambda P=P, R=R: self._warm_prefix_combo(
+                        P, smallest, R, synthetic=True))
         for w in windows:
             steps.append(lambda w=w: self._warm_window(w))
         if self.kv_mode == "paged":
@@ -819,13 +855,47 @@ class BatchScheduler:
                 raise j.err
 
     def _build_promotion(self) -> None:
-        """Build one queued prefix promotion (scheduler thread only)."""
+        """Hand one queued prefix promotion to the build worker
+        (scheduler thread only). The worker computes the prefix KV off
+        the serving loop; _drain_promotions integrates the result."""
         self._last_promote_tick = self._n_decode_ticks
         head = self._promote_q.pop(0)
-        try:
-            self._register_prefix_ids(list(head))
-        except Exception:   # noqa: BLE001 — the cache is optional
-            log.exception("prefix promotion failed")
+        if self._promote_worker is None:
+            self._promote_worker = threading.Thread(
+                target=self._promotion_worker, daemon=True,
+                name="prefix-promote")
+            self._promote_worker.start()
+        self._promote_pending.add(head)
+        self._promote_work.put(head)
+
+    def _promotion_worker(self) -> None:
+        """Daemon: builds promotion prefix KV off the scheduler thread.
+        Touches ONLY immutable state (params, the jitted builder — jit
+        call caches are thread-safe); results go back through
+        _promote_done for the scheduler thread to install."""
+        while True:
+            head = self._promote_work.get()
+            if head is None or self._closed.is_set():
+                return
+            try:
+                k, v = self._build_prefix_kv(head)
+                self._promote_done.put((head, k, v))
+            except Exception:   # noqa: BLE001 — promotion is optional
+                log.exception("prefix promotion build failed")
+                self._promote_done.put((head, None, None))
+
+    def _drain_promotions(self) -> None:
+        """Install finished promotion builds (scheduler thread only —
+        keeps the store single-writer)."""
+        while True:
+            try:
+                head, k, v = self._promote_done.get_nowait()
+            except queue.Empty:
+                return
+            self._promote_pending.discard(head)
+            if k is None:
+                continue
+            self._install_prefix(head, k, v, note=", promoted off-thread")
 
     def _chunks_for(self, footprint: int,
                     chunk_sizes: tuple[int, ...]) -> list[int]:
@@ -835,16 +905,30 @@ class BatchScheduler:
         cap = self._chunk_cap(footprint)
         return sorted({min(R, cap) for R in chunk_sizes})
 
-    def _warm_prefix_combo(self, P: int, S: int, R: int) -> None:
+    def _warm_prefix_combo(self, P: int, S: int, R: int,
+                           synthetic: bool = False) -> None:
         """Compile+run ONE prefix-admission program (one queued warmup
         job per program, so mid-traffic warmups interleave with live
         ticks between compiles instead of stalling for a whole
         sub-ladder). The entry is looked up at run time — registration
-        jobs queued ahead of this one have populated the store."""
+        jobs queued ahead of this one have populated the store.
+        ``synthetic``: no entry exists yet (grain pre-warm) — run the
+        program against a zeros entry of the right SHAPES, which is all
+        the compile cache keys on; auto-promoted prefixes are
+        grain-snapped, so their first real admission then hits a warm
+        program instead of compiling mid-burst (measured ~5 s stall for
+        every in-flight stream)."""
         entry = next((e for e in self._prefix.snapshot()
                       if e.length == P), None)
-        if entry is None or P + S > self.max_seq:
+        if P + S > self.max_seq:
             return
+        if entry is None:
+            if not synthetic:
+                return
+            z = jnp.zeros((self.config.num_layers, P,
+                           self.config.num_kv_heads, self.config.head_dim),
+                          self._dtype)
+            entry = PrefixEntry(ids=tuple(range(P)), k=z, v=z)
         self._admit_chunk([], [], S, R, warm_prefix=entry)
 
     def _warm_window(self, w: int) -> None:
@@ -957,6 +1041,7 @@ class BatchScheduler:
     def stop(self) -> None:
         self._closed.set()
         self._admit_q.put(None)    # wake the loop if parked
+        self._promote_work.put(None)   # wake the promotion worker
         self._thread.join(timeout=10.0)
         # Unblock every consumer: in-flight slots and never-admitted
         # requests would otherwise hang forever on out_q.get().
@@ -1008,6 +1093,8 @@ class BatchScheduler:
                                     and pending is None)
                 if self._closed.is_set():
                     return
+                if self._prefix is not None:
+                    self._drain_promotions()
                 if not self._any_active():
                     if pending is not None:
                         self._process_tick(*pending)
@@ -1129,13 +1216,14 @@ class BatchScheduler:
                 # one under pressure is free.
                 head = self._prefix.observe(ids)
                 if (head is not None and len(self._promote_q) < 8
-                        # A QUEUED longer head covers this one the same
-                        # way a built entry would (match() takes the
-                        # longest) — building the shorter grain too
-                        # would be pure compile/prefill waste.
+                        # A QUEUED (or in-flight) longer head covers this
+                        # one the same way a built entry would (match()
+                        # takes the longest) — building the shorter
+                        # grain too would be pure compile/prefill waste.
                         and not any(len(q) >= len(head)
                                     and q[: len(head)] == head
-                                    for q in self._promote_q)):
+                                    for q in list(self._promote_q)
+                                    + list(self._promote_pending))):
                     self._promote_q.append(head)
             out.append(slot)
         return out
@@ -1234,6 +1322,13 @@ class BatchScheduler:
         free = self._free_rows()
         if not free:
             return
+        # Install finished off-thread promotion builds BEFORE matching:
+        # the loop may have been parked inside this call's blocking
+        # collect when the build finished, and the burst that woke it
+        # must see the new entry (draining only back in the loop would
+        # make the whole first burst miss the prefix it paid to build).
+        if self._prefix is not None:
+            self._drain_promotions()
         had_active = len(free) < self.num_slots   # live streams to protect
         pending: list[_Slot] = []
         for s in self._admit_carry:           # prepared last round

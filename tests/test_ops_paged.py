@@ -347,13 +347,6 @@ def test_append_kernel_interpret_matches_gather():
     hardware."""
     import importlib
 
-    import numpy as np
-    import jax
-    import jax.numpy as jnp
-
-    from p2p_llm_chat_tpu.models.configs import get_config
-    from p2p_llm_chat_tpu.ops import paged_kv
-
     pa = importlib.import_module("p2p_llm_chat_tpu.ops.paged_attention")
     cfg = get_config("tiny-tp")     # 4 kv heads, head_dim 32
     rng = np.random.default_rng(5)
@@ -385,7 +378,12 @@ def test_append_kernel_interpret_matches_gather():
             q, kc, vc, cache.k, cache.v, cache.k_scale, cache.v_scale,
             cache.page_table, lens, jnp.asarray(0), pages=pages,
             quantized=quantized, interpret=True)
-        ref = pa.paged_attention_append(q, kc, vc, cache, lens,
-                                        jnp.asarray(0), pages=pages)
+        saved = pa._APPEND_IMPL
+        pa._APPEND_IMPL = "gather"      # pin the reference path
+        try:
+            ref = pa.paged_attention_append(q, kc, vc, cache, lens,
+                                            jnp.asarray(0), pages=pages)
+        finally:
+            pa._APPEND_IMPL = saved
         np.testing.assert_allclose(np.asarray(kern), np.asarray(ref),
                                    atol=2e-2, rtol=2e-2)

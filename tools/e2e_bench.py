@@ -57,6 +57,9 @@ def main() -> None:
     ap.add_argument("--ui-base", type=int, default=19501)
     ap.add_argument("--dir-port", type=int, default=19480)
     ap.add_argument("--serve-port", type=int, default=19490)
+    ap.add_argument("--identical", action="store_true",
+                    help="all peers send the SAME text (stress case: "
+                         "triggers prefix auto-promotion mid-burst)")
     ap.add_argument("--workload", default="quote",
                     choices=["quote", "random"],
                     help="quote (default): serve a synthetic checkpoint "
@@ -151,6 +154,8 @@ def main() -> None:
         msgs = [f"Hey {users[(i + 1) % n]}, are we still meeting "
                 f"tomorrow at {8 + i % 9}:{15 * (i % 4):02d}?"
                 for i in range(n)]
+        if args.identical:
+            msgs = ["Hey, are we still meeting tomorrow at 10?"] * n
         for i in range(n):
             to = users[(i + 1) % n]
             with post(f"http://127.0.0.1:{args.ui_base + i}/node/send",
