@@ -30,6 +30,18 @@ re-registers on a background interval with exponential backoff
 directory — it is in-memory, losing every record (SURVEY.md §2 C5) —
 relearns the node without operator action. Startup registration stays
 fatal-on-failure (main.go:184 parity).
+
+DHT rung (additive): the reference constructs a kad-DHT it never routes
+with (go/cmd/node/main.go:151, errors non-fatal at :153). Here the
+from-scratch Kademlia (p2p/dht.py) is the THIRD rung of the lookup
+ladder — directory -> cached record -> DHT — so never-before-paired
+peers still resolve each other through a directory outage. The node
+publishes its signed address record to the DHT on registration and on
+every re-register tick. Env: ``DHT_ADDR`` (UDP listen, default
+``127.0.0.1:0``; ``off`` disables), ``DHT_BOOTSTRAP`` (comma-separated
+``host:port`` seeds). All DHT failures are non-fatal (reference :153
+parity); ``GET /me`` exposes ``dht_addr`` so deployments can chain
+bootstrap seeds without extra config.
 """
 
 from __future__ import annotations
@@ -40,6 +52,7 @@ from typing import Optional
 from .directory import DirectoryClient
 from .inbox import Inbox
 from .p2p import Identity, Multiaddr, P2PHost
+from .p2p.dht import DHTNode, parse_seeds
 from .p2p.transport import SecureStream
 from .proto import ChatMessage, now_rfc3339
 from .utils.env import env_or
@@ -62,6 +75,8 @@ class ChatNode:
         relay_addrs: Optional[str] = None,
         identity_file: Optional[str] = None,
         inbox_cap: Optional[int] = None,
+        dht_addr: Optional[str] = None,
+        dht_bootstrap: Optional[str] = None,
     ) -> None:
         # Env-var defaults keep the reference's exact config surface
         # (go/cmd/node/main.go:131-134).
@@ -80,6 +95,17 @@ class ChatNode:
         self.host = P2PHost(identity=ident, listen_addr=p2p_listen)
         self.inbox = Inbox(max_messages=inbox_cap)
         self.dir = DirectoryClient(self.directory_url)
+        dht_addr = dht_addr if dht_addr is not None else env_or("DHT_ADDR", "127.0.0.1:0")
+        self.dht: Optional[DHTNode] = None
+        if dht_addr.lower() not in ("off", "0", ""):
+            try:
+                self.dht = DHTNode(ident, dht_addr)
+            except (ValueError, OSError) as e:
+                # Bad addr / port taken: degrade, don't crash — every DHT
+                # failure is non-fatal (go/cmd/node/main.go:153 parity).
+                log.warning("DHT disabled: cannot bind %r (%s)", dht_addr, e)
+        self.dht_bootstrap = (dht_bootstrap if dht_bootstrap is not None
+                              else env_or("DHT_BOOTSTRAP", ""))
         self.reregister_s = float(env_or("NODE_REREGISTER_S", "30"))
         self._lookup_cache: dict[str, object] = {}
         self._cache_mu = threading.Lock()
@@ -122,6 +148,8 @@ class ChatNode:
         if not to_username or not content:
             return Response(400, {"error": "to_username and content required"})
 
+        from_cache = False
+        via_dht = False
         try:
             rec = self.dir.lookup(to_username)          # main.go:225
             with self._cache_mu:
@@ -134,17 +162,67 @@ class ChatNode:
             # from the send path for warm pairs).
             with self._cache_mu:
                 rec = self._lookup_cache.get(to_username)
+            if rec is not None:
+                from_cache = True
+                log.warning("directory lookup for %s failed (%s); using "
+                            "cached record", to_username, e)
+            elif self.dht is not None:
+                # Third rung: never-paired peers resolve via the DHT's
+                # signed records while the directory is down (the cache
+                # rung only covers peers we've already talked to).
+                rec = self.dht.get_record(to_username)
+                if rec is not None:
+                    log.warning("directory lookup for %s failed (%s); "
+                                "resolved via DHT", to_username, e)
+                    via_dht = True
             if rec is None:
                 return Response(404, {"error": f"lookup failed: {e}"})
-            log.warning("directory lookup for %s failed (%s); using cached "
-                        "record", to_username, e)
 
         msg = ChatMessage(from_user=self.username, to_user=to_username,
                           content=content, timestamp=now_rfc3339())
 
-        # Try each advertised addr (direct first, then circuits), one stream
-        # per message, write JSON, close (main.go:235-261).
-        errors = []
+        errors: list[str] = []
+        if self._deliver(rec, msg, errors):
+            if via_dht:
+                # Cache only after a delivery proves the record good — a
+                # dead DHT record must not poison the cache rung.
+                with self._cache_mu:
+                    self._lookup_cache[to_username] = rec
+            return Response(200, {"status": "sent", "id": msg.id})  # main.go:264
+
+        # The cached record may be stale (the peer moved while the
+        # directory was down). If the DHT holds a record with different
+        # addrs, try those before giving up — it is republished every
+        # re-register tick, so it tracks moves the cache cannot.
+        if from_cache and self.dht is not None:
+            fresh = self.dht.get_record(to_username)
+            if fresh is not None and fresh.peer_id != rec.peer_id:
+                # Identity pinning: for a peer we already hold a binding
+                # for, a DHT record signed by a DIFFERENT identity is a
+                # username squat, not a move — refuse it. (Never-paired
+                # resolution has no prior binding and is trust-on-first-
+                # use, the same model as the reference's unauthenticated
+                # directory.)
+                log.warning("DHT record for %s signed by a different "
+                            "identity; ignoring", to_username)
+                fresh = None
+            if fresh is not None and set(fresh.addrs) != set(rec.addrs):
+                log.warning("cached addrs for %s are dead; retrying via "
+                            "DHT record", to_username)
+                if self._deliver(fresh, msg, errors):
+                    with self._cache_mu:
+                        self._lookup_cache[to_username] = fresh
+                    return Response(200, {"status": "sent", "id": msg.id})
+        if from_cache:
+            # Total failure on a cached record: drop it so the next send
+            # re-resolves instead of re-dialing dead addrs forever.
+            with self._cache_mu:
+                self._lookup_cache.pop(to_username, None)
+        return Response(502, {"error": "could not reach peer", "attempts": errors})
+
+    def _deliver(self, rec, msg: ChatMessage, errors: list[str]) -> bool:
+        """Try each advertised addr (direct first, then circuits), one stream
+        per message, write JSON, close (main.go:235-261)."""
         addrs = sorted(rec.addrs, key=lambda a: "/p2p-circuit/" in a)
         for addr_str in addrs:
             try:
@@ -157,10 +235,10 @@ class ChatNode:
                     stream.close_write()
                 finally:
                     stream.close()
-                return Response(200, {"status": "sent", "id": msg.id})  # main.go:264
+                return True
             except Exception as e:  # noqa: BLE001 — collect and try next addr
                 errors.append(f"{addr_str}: {e}")
-        return Response(502, {"error": "could not reach peer", "attempts": errors})
+        return False
 
     def _handle_inbox(self, req: Request) -> Response:
         """GET /inbox?after=<id> (go/cmd/node/main.go:267-270)."""
@@ -170,11 +248,14 @@ class ChatNode:
     def _handle_me(self, req: Request) -> Response:
         """GET /me (go/cmd/node/main.go:272-278). Returns the base58 peer id
         (deliberate fix of the raw-bytes quirk at main.go:275) plus addrs."""
-        return Response(200, {
+        out = {
             "username": self.username,
             "peer_id": self.host.peer_id,
             "addrs": [str(a) for a in self.host.addrs()],
-        })
+        }
+        if self.dht is not None:
+            out["dht_addr"] = "%s:%d" % self.dht.addr
+        return Response(200, out)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -193,6 +274,15 @@ class ChatNode:
         log.info("registered %s (%s) with directory %s",
                  self.username, self.host.peer_id[:12], self.directory_url)
 
+        # DHT join + signed-record publish — every step non-fatal
+        # (go/cmd/node/main.go:153 parity). The join runs on a background
+        # thread: unreachable seeds cost seconds of UDP timeouts and must
+        # not delay the HTTP API coming up.
+        if self.dht is not None:
+            self.dht.start()
+            threading.Thread(target=self._dht_join, args=(addrs,),
+                             daemon=True, name="dht-join").start()
+
         # Bootstrap connects: parse multiaddr -> connect; errors logged,
         # non-fatal (go/cmd/node/main.go:189-211).
         for addr_str in filter(None, (s.strip() for s in self.bootstrap_addrs.split(","))):
@@ -210,6 +300,18 @@ class ChatNode:
         log.info("node %s HTTP API on %s", self.username, self._http.addr)
         return self
 
+    def _dht_join(self, addrs: list[str]) -> None:
+        """Background DHT bootstrap + initial record publish (start() must
+        not block on UDP timeouts to dead seeds). The re-register loop
+        republishes afterwards, so a failed initial publish self-heals."""
+        try:
+            seeds = parse_seeds(self.dht_bootstrap)
+            if seeds:
+                self.dht.bootstrap(seeds)
+            self.dht.put_self_record(self.username, addrs)
+        except Exception as e:  # noqa: BLE001
+            log.warning("dht join/publish failed (non-fatal): %s", e)
+
     def _reregister_loop(self) -> None:
         """Periodically re-register so an (in-memory, record-losing)
         directory restart relearns this node; failures back off
@@ -218,13 +320,29 @@ class ChatNode:
         delay = self.reregister_s
         while not self._closed.wait(delay):
             try:
+                # In a try: host sockets may be mid-close when stop()
+                # races this tick, and the loop must survive it.
                 addrs = [str(a) for a in self.host.addrs()]
+            except Exception:  # noqa: BLE001
+                continue
+            try:
                 self.dir.register(self.username, self.host.peer_id, addrs)
                 delay = self.reregister_s
             except Exception as e:  # noqa: BLE001 — outage, keep trying
                 delay = min(delay * 2, self.reregister_s * 8)
                 log.debug("re-register failed (%s); next attempt in %.0fs",
                           e, delay)
+            # DHT republish runs even when the directory is down — that is
+            # precisely when the DHT rung carries the lookups.
+            if self.dht is not None:
+                try:
+                    # AFTER the directory register (dead-contact RPC
+                    # timeouts here must not delay directory relearn):
+                    # republish keeps the record alive past the DHT's TTL
+                    # and re-seeds it onto nodes that joined since.
+                    self.dht.put_self_record(self.username, addrs)
+                except Exception as e:  # noqa: BLE001
+                    log.debug("dht republish failed: %s", e)
 
     @property
     def http_url(self) -> str:
@@ -239,6 +357,8 @@ class ChatNode:
         self._closed.set()
         if self._http:
             self._http.stop()
+        if self.dht is not None:
+            self.dht.close()
         self.host.close()
 
 
