@@ -42,6 +42,13 @@ every re-register tick. Env: ``DHT_ADDR`` (UDP listen, default
 ``host:port`` seeds). All DHT failures are non-fatal (reference :153
 parity); ``GET /me`` exposes ``dht_addr`` so deployments can chain
 bootstrap seeds without extra config.
+
+NAT-PMP (parity with ``libp2p.NATPortMap()``, main.go:143): on by
+default, best-effort, background — maps the p2p listen port on the
+gateway (p2p/natpmp.py, RFC 6886) and advertises the external address
+in directory/DHT records; renews at half-lifetime from the re-register
+loop; releases on stop. ``NATPMP=0`` disables, ``NATPMP_GATEWAY``
+overrides gateway discovery.
 """
 
 from __future__ import annotations
@@ -53,6 +60,7 @@ from .directory import DirectoryClient
 from .inbox import Inbox
 from .p2p import Identity, Multiaddr, P2PHost
 from .p2p.dht import DHTNode, parse_seeds
+from .p2p.natpmp import PortMapper
 from .p2p.transport import SecureStream
 from .proto import ChatMessage, now_rfc3339
 from .utils.env import env_or
@@ -106,6 +114,15 @@ class ChatNode:
                 log.warning("DHT disabled: cannot bind %r (%s)", dht_addr, e)
         self.dht_bootstrap = (dht_bootstrap if dht_bootstrap is not None
                               else env_or("DHT_BOOTSTRAP", ""))
+        # NAT-PMP port mapping (libp2p.NATPortMap() parity, main.go:143):
+        # on by default like the reference, best-effort — no cooperative
+        # gateway just means punch/relay carry reachability instead.
+        # NATPMP=0 disables; NATPMP_GATEWAY=host[:port] overrides discovery
+        # (used by tests to point at a fake gateway).
+        self._natpmp_enabled = env_or("NATPMP", "1") not in ("0", "off", "")
+        self._natpmp_gateway = env_or("NATPMP_GATEWAY", "")
+        self._mapper: Optional[PortMapper] = None
+        self._nat_ext: Optional[tuple[str, int]] = None
         self.reregister_s = float(env_or("NODE_REREGISTER_S", "30"))
         self._lookup_cache: dict[str, object] = {}
         self._cache_mu = threading.Lock()
@@ -254,7 +271,12 @@ class ChatNode:
             "addrs": [str(a) for a in self.host.addrs()],
         }
         if self.dht is not None:
-            out["dht_addr"] = "%s:%d" % self.dht.addr
+            dht_host, dht_port = self.dht.addr
+            if dht_host in ("0.0.0.0", "::"):
+                # A wildcard bind is not dialable — substitute the host's
+                # advertise address so seed chaining works cross-host.
+                dht_host = self.host.advertise_host
+            out["dht_addr"] = f"{dht_host}:{dht_port}"
         return Response(200, out)
 
     # -- lifecycle -----------------------------------------------------------
@@ -282,6 +304,13 @@ class ChatNode:
             self.dht.start()
             threading.Thread(target=self._dht_join, args=(addrs,),
                              daemon=True, name="dht-join").start()
+
+        # NAT-PMP mapping — background (gateway retransmits cost seconds),
+        # best-effort; a mapped external addr is re-advertised via the
+        # re-register loop once acquired.
+        if self._natpmp_enabled:
+            threading.Thread(target=self._natpmp_setup, daemon=True,
+                             name="natpmp").start()
 
         # Bootstrap connects: parse multiaddr -> connect; errors logged,
         # non-fatal (go/cmd/node/main.go:189-211).
@@ -312,6 +341,54 @@ class ChatNode:
         except Exception as e:  # noqa: BLE001
             log.warning("dht join/publish failed (non-fatal): %s", e)
 
+    def _natpmp_setup(self) -> None:
+        """Map the p2p listen port on the gateway and advertise the
+        external addr (NATPortMap parity). Every failure degrades to
+        punch/relay reachability."""
+        try:
+            gw_host, gw_port = None, 5351
+            if self._natpmp_gateway:
+                h, _, p = self._natpmp_gateway.rpartition(":")
+                gw_host, gw_port = (h or self._natpmp_gateway,
+                                    int(p) if h else 5351)
+            mapper = PortMapper(self.host.listen_port,
+                                gateway=gw_host, port=gw_port)
+            if self._closed.is_set():
+                return
+            ext = mapper.acquire()
+            # Assign BEFORE checking _closed: stop() sets _closed first and
+            # checks _mapper second, so whichever thread loses the race
+            # still sees the other's write and release() runs exactly once
+            # (it is a no-op on an already-released mapping).
+            self._mapper = mapper
+            if self._closed.is_set():
+                mapper.release()
+                return
+            if ext is None:
+                return
+            self._advertise_mapping(ext)
+        except Exception as e:  # noqa: BLE001
+            log.warning("NAT-PMP setup failed (non-fatal): %s", e)
+
+    def _advertise_mapping(self, ext: tuple[str, int]) -> None:
+        """(Re)advertise the NAT-mapped external addr and eagerly push the
+        updated record to the directory + DHT."""
+        if self._nat_ext is not None and self._nat_ext != ext:
+            self.host.remove_advertised_addr(
+                Multiaddr(self._nat_ext[0], self._nat_ext[1]))
+        self._nat_ext = ext
+        self.host.add_advertised_addr(Multiaddr(ext[0], ext[1]))
+        addrs = [str(a) for a in self.host.addrs()]
+        try:
+            self.dir.register(self.username, self.host.peer_id, addrs)
+        except Exception:  # noqa: BLE001 — reregister loop will retry
+            pass
+        if self.dht is not None:
+            try:
+                self.dht.put_self_record(self.username, addrs)
+            except Exception:  # noqa: BLE001
+                pass
+
     def _reregister_loop(self) -> None:
         """Periodically re-register so an (in-memory, record-losing)
         directory restart relearns this node; failures back off
@@ -332,6 +409,17 @@ class ChatNode:
                 delay = min(delay * 2, self.reregister_s * 8)
                 log.debug("re-register failed (%s); next attempt in %.0fs",
                           e, delay)
+            # Renew the NAT-PMP mapping before it lapses (half-lifetime
+            # cadence is tracked inside the mapper); a changed grant
+            # (gateway reboot, reassigned port) is re-advertised so the
+            # records track the LIVE external addr, not the original one.
+            if self._mapper is not None:
+                try:
+                    changed = self._mapper.renew_if_due()
+                    if changed is not None:
+                        self._advertise_mapping(changed)
+                except Exception as e:  # noqa: BLE001
+                    log.debug("NAT-PMP renew failed: %s", e)
             # DHT republish runs even when the directory is down — that is
             # precisely when the DHT rung carries the lookups.
             if self.dht is not None:
@@ -350,8 +438,18 @@ class ChatNode:
         return self._http.url
 
     def serve_forever(self) -> None:
+        """Run as a daemon until SIGTERM/SIGINT, then clean up — the
+        NAT-PMP mapping in particular must be released (a plain kill
+        would leave the gateway forwarding the port for up to the
+        mapping lifetime)."""
+        import signal
+
+        done = threading.Event()
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(signum, lambda *_: done.set())
         self.start()
-        threading.Event().wait()
+        done.wait()
+        self.stop()
 
     def stop(self) -> None:
         self._closed.set()
@@ -359,6 +457,11 @@ class ChatNode:
             self._http.stop()
         if self.dht is not None:
             self.dht.close()
+        if self._mapper is not None:
+            try:
+                self._mapper.release()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
         self.host.close()
 
 

@@ -233,20 +233,22 @@ class RoutingTable:
                 bucket.append(fresh)
 
     def get(self, peer_id: str) -> Optional[Contact]:
+        # The contact's bucket is derivable from its id — no full scan
+        # (this runs on the rx thread for every request datagram).
         with self._mu:
-            for bucket in self._buckets:
-                for existing in bucket:
-                    if existing.peer_id == peer_id:
-                        return existing
+            bucket = self._buckets[self._bucket_index(node_id_for_peer(peer_id))]
+            for existing in bucket:
+                if existing.peer_id == peer_id:
+                    return existing
         return None
 
     def remove(self, peer_id: str) -> None:
         with self._mu:
-            for bucket in self._buckets:
-                for i, existing in enumerate(bucket):
-                    if existing.peer_id == peer_id:
-                        bucket.pop(i)
-                        return
+            bucket = self._buckets[self._bucket_index(node_id_for_peer(peer_id))]
+            for i, existing in enumerate(bucket):
+                if existing.peer_id == peer_id:
+                    bucket.pop(i)
+                    return
 
     def closest(self, target: int, n: Optional[int] = None) -> list[Contact]:
         n = self.k if n is None else n
@@ -294,6 +296,11 @@ class DHTNode:
         self._challenge_mu = threading.Lock()
         self._closed = threading.Event()
         self._rx: Optional[threading.Thread] = None
+        # One long-lived pool for lookup/store fan-out — per-round executor
+        # creation on the inline /send path would pay thread startup for
+        # every ALPHA-batch and leak straggler threads per round.
+        self._pool = ThreadPoolExecutor(max_workers=max(k, ALPHA),
+                                        thread_name_prefix="dht-fan")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -309,6 +316,7 @@ class DHTNode:
 
     def close(self) -> None:
         self._closed.set()
+        self._pool.shutdown(wait=False)
         try:
             self.sock.close()
         except OSError:
@@ -545,13 +553,16 @@ class DHTNode:
 
     def _fan_out(self, contacts: list[Contact],
                  fn: Callable[[Contact], object]) -> dict[Contact, object]:
-        """Run ``fn`` over contacts in parallel; drop stragglers/failures.
-        Bounded: fn is an _rpc wrapper, itself capped at attempts*timeout."""
+        """Run ``fn`` over contacts on the shared pool; drop stragglers and
+        raised calls (a missing key = no answer). Bounded: fn is an _rpc
+        wrapper, itself capped at attempts*timeout."""
         if not contacts:
             return {}
         out: dict[Contact, object] = {}
-        ex = ThreadPoolExecutor(max_workers=len(contacts))
-        futs = {ex.submit(fn, c): c for c in contacts}
+        try:
+            futs = {self._pool.submit(fn, c): c for c in contacts}
+        except RuntimeError:      # pool shut down: node closing
+            return {}
         try:
             for f in as_completed(futs, timeout=2 * self.rpc_timeout_s + 0.5):
                 try:
@@ -560,7 +571,6 @@ class DHTNode:
                     pass
         except FutTimeout:
             pass
-        ex.shutdown(wait=False)
         return out
 
     def _iterate(self, target: int,
@@ -583,22 +593,25 @@ class DHTNode:
                 live = [c for c in ordered if c.peer_id in queried]
                 return best, live[:self.k]
             results = self._fan_out(batch, query)
-            for c, (rec, nodes) in results.items():
+            for c in batch:
                 queried.add(c.peer_id)
+                res = results.get(c)
+                if res is None:
+                    # No answer (query returns None on RPC timeout, never
+                    # an empty tuple): out of this lookup, but NOT out of
+                    # the routing table directly — a dedicated background
+                    # ping decides eviction (one miss under bursty loss
+                    # must not strip live long-lived contacts; the
+                    # docstring's liveness bias).
+                    shortlist.pop(c.peer_id, None)
+                    self._suspect(c)
+                    continue
+                rec, nodes = res
                 if rec is not None and (best is None or rec.seq > best.seq):
                     best = rec
                 for nc in nodes:
                     if nc.peer_id != self.ident.peer_id:
                         shortlist.setdefault(nc.peer_id, nc)
-            # Unresponsive batch members leave the lookup, but NOT the
-            # routing table directly — a dedicated background ping decides
-            # eviction (one lookup miss under bursty loss must not strip
-            # live long-lived contacts; the docstring's liveness bias).
-            for c in batch:
-                if c not in results:
-                    queried.add(c.peer_id)
-                    shortlist.pop(c.peer_id, None)
-                    self._suspect(c)
             if best is not None:
                 # FIND_VALUE terminates on the first verified value — the
                 # /send path calls this inline, and walking the rest of the
@@ -609,11 +622,13 @@ class DHTNode:
                 return best, live[:self.k]
 
     def iterative_find_node(self, target: int) -> list[Contact]:
-        def q(c: Contact) -> tuple[None, list[Contact]]:
+        def q(c: Contact) -> Optional[tuple[None, list[Contact]]]:
             resp = self._rpc({"t": "find_node", "target": f"{target:064x}"},
                              (c.host, c.port))
-            if resp is None or resp.get("t") != "nodes":
-                return None, []
+            if resp is None:
+                return None            # no answer -> suspect path
+            if resp.get("t") != "nodes":
+                return (None, [])      # answered, just not useful
             return None, [Contact.from_wire(d) for d in resp.get("nodes", [])]
         _, closest = self._iterate(target, q)
         return closest
@@ -639,21 +654,22 @@ class DHTNode:
         key = key_for_username(username)
         local = self._load(key)
 
-        def q(c: Contact) -> tuple[Optional[SignedRecord], list[Contact]]:
+        def q(c: Contact) -> Optional[tuple[Optional[SignedRecord],
+                                            list[Contact]]]:
             resp = self._rpc({"t": "get", "key": f"{key:064x}"},
                              (c.host, c.port))
             if resp is None:
-                return None, []
+                return None            # no answer -> suspect path
             if resp.get("t") == "value":
                 try:
                     rec = SignedRecord.from_wire(resp["record"])
                 except (KeyError, ValueError, TypeError):
-                    return None, []
+                    return (None, [])
                 return (rec if rec.verify(expect_key=key) else None), []
             if resp.get("t") == "nodes":
                 return None, [Contact.from_wire(d)
                               for d in resp.get("nodes", [])]
-            return None, []
+            return (None, [])
 
         best, _ = self._iterate(key, q)
         if local is not None and (best is None or local.seq > best.seq):

@@ -286,6 +286,7 @@ class P2PHost:
         self._closed = threading.Event()
         self._relay_threads: list[threading.Thread] = []
         self._relay_addrs: list[Multiaddr] = []
+        self._extra_addrs: list[Multiaddr] = []
         self._relay_socks: list[socket.socket] = []
         self._relay_socks_mu = threading.Lock()
         # Negative cache for hole punching: peers whose punch failed are
@@ -343,12 +344,36 @@ class P2PHost:
 
     def addrs(self) -> list[Multiaddr]:
         """Advertised multiaddrs, each encapsulating /p2p/<peer-id>
-        (go/cmd/node/main.go:176-181), plus any relay circuit addrs."""
+        (go/cmd/node/main.go:176-181), plus any extra advertised addrs
+        (e.g. a NAT-PMP-mapped external address) and relay circuit addrs."""
         out = [Multiaddr(self._advertise_host, self._listen_port, peer_id=self.peer_id)]
+        for extra in list(self._extra_addrs):
+            out.append(extra.with_peer(self.peer_id))
         for r in self._relay_addrs:
             out.append(Multiaddr(r.host, r.port, peer_id=self.peer_id,
                                  relay_peer_id=r.peer_id, is_circuit=True))
         return out
+
+    def add_advertised_addr(self, maddr: Multiaddr) -> None:
+        """Advertise an additional dialable address for this host (the
+        NAT-PMP mapper's external ip:port; parity with the addrs a
+        NATPortMap'd libp2p host announces)."""
+        if not any(a.host == maddr.host and a.port == maddr.port
+                   for a in self._extra_addrs):
+            self._extra_addrs.append(maddr)
+
+    def remove_advertised_addr(self, maddr: Multiaddr) -> None:
+        """Stop advertising an extra addr (a lapsed/moved NAT mapping)."""
+        self._extra_addrs = [a for a in self._extra_addrs
+                             if (a.host, a.port) != (maddr.host, maddr.port)]
+
+    @property
+    def listen_port(self) -> int:
+        return self._listen_port
+
+    @property
+    def advertise_host(self) -> str:
+        return self._advertise_host
 
     def set_stream_handler(self, protocol_id: str, handler: StreamHandler) -> None:
         self._handlers[protocol_id] = handler

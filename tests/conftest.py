@@ -17,6 +17,10 @@ import sys
 
 # Env vars still set for any subprocesses tests spawn.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Hermetic networking: node daemons must not probe the CI host's real
+# gateway for NAT-PMP during tests (test_natpmp.py opts back in against
+# a fake gateway explicitly).
+os.environ.setdefault("NATPMP", "0")
 os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "highest")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -1,0 +1,248 @@
+"""NAT-PMP client tests against an in-process fake gateway.
+
+Pins the RFC 6886 wire behavior (request/response formats, assigned
+external ports, error results, the retransmit schedule, deletes) and the
+node integration: a mapped external address is advertised via /me and
+registered, and released on stop — the from-scratch parity for the
+reference's ``libp2p.NATPortMap()`` (go/cmd/node/main.go:143).
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from p2p_llm_chat_tpu.directory import DirectoryService
+from p2p_llm_chat_tpu.node import ChatNode
+from p2p_llm_chat_tpu.p2p.natpmp import (
+    PROTO_TCP,
+    NatPmpClient,
+    NatPmpError,
+    NatPmpUnavailable,
+    PortMapper,
+)
+from p2p_llm_chat_tpu.utils.http import http_json
+
+
+class FakeGateway:
+    """Minimal NAT-PMP responder: external-address + map/unmap opcodes,
+    optional fault injection (drop N requests, forced error result)."""
+
+    def __init__(self, external_ip="203.0.113.7", assign_offset=0,
+                 drop_first=0, error_code=0):
+        self.external_ip = external_ip
+        self.assign_offset = assign_offset   # external = requested + offset
+        self.drop_first = drop_first
+        self.error_code = error_code
+        self.mappings = {}                   # (proto, iport) -> (eport, lifetime)
+        self.requests = 0
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self._closed = threading.Event()
+        self._epoch0 = time.monotonic()
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    @property
+    def addr(self):
+        return self.sock.getsockname()
+
+    def close(self):
+        self._closed.set()
+        self.sock.close()
+
+    def _serve(self):
+        while not self._closed.is_set():
+            try:
+                data, src = self.sock.recvfrom(64)
+            except OSError:
+                return
+            self.requests += 1
+            if self.requests <= self.drop_first:
+                continue
+            if len(data) < 2 or data[0] != 0:
+                continue
+            op = data[1]
+            epoch = int(time.monotonic() - self._epoch0)
+            if op == 0:                      # external address
+                resp = struct.pack("!BBHI", 0, 128, self.error_code, epoch)
+                resp += socket.inet_aton(self.external_ip)
+                self.sock.sendto(resp, src)
+            elif op in (1, 2) and len(data) >= 12:
+                _, _, _, iport, eport, lifetime = struct.unpack("!BBHHHI", data)
+                if lifetime == 0:            # delete (§3.4)
+                    self.mappings.pop((op, iport), None)
+                    granted_e, granted_l = 0, 0
+                elif (op, iport) in self.mappings:
+                    # Existing mapping: renew in place (§3.3 — a gateway
+                    # keeps a stable external port per internal port).
+                    granted_e = self.mappings[(op, iport)][0]
+                    granted_l = lifetime
+                    self.mappings[(op, iport)] = (granted_e, granted_l)
+                else:
+                    granted_e = (eport or iport) + self.assign_offset
+                    granted_l = lifetime
+                    self.mappings[(op, iport)] = (granted_e, granted_l)
+                resp = struct.pack("!BBHIHHI", 0, 128 + op, self.error_code,
+                                   epoch, iport, granted_e, granted_l)
+                self.sock.sendto(resp, src)
+
+
+@pytest.fixture()
+def gw():
+    g = FakeGateway()
+    yield g
+    g.close()
+
+
+def _client(g, **kw):
+    kw.setdefault("first_rto_s", 0.1)
+    kw.setdefault("tries", 3)
+    return NatPmpClient(g.addr[0], g.addr[1], **kw)
+
+
+def test_external_address_and_mapping(gw):
+    c = _client(gw)
+    assert c.external_address() == "203.0.113.7"
+    m = c.map_port(PROTO_TCP, 4001, 4001, lifetime_s=600)
+    assert (m.external_port, m.lifetime_s) == (4001, 600)
+    assert gw.mappings[(2, 4001)] == (4001, 600)
+
+
+def test_gateway_assigned_port_is_used():
+    g = FakeGateway(assign_offset=1000)
+    try:
+        m = _client(g).map_port(PROTO_TCP, 4001, 4001)
+        assert m.external_port == 5001   # §3.3: use what the gateway granted
+    finally:
+        g.close()
+
+
+def test_error_result_raises():
+    g = FakeGateway(error_code=2)        # not authorized
+    try:
+        with pytest.raises(NatPmpError) as ei:
+            _client(g).external_address()
+        assert ei.value.result_code == 2
+    finally:
+        g.close()
+
+
+def test_no_gateway_raises_unavailable():
+    dead = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    dead.bind(("127.0.0.1", 0))
+    port = dead.getsockname()[1]
+    dead.close()                         # nothing listens here now
+    c = NatPmpClient("127.0.0.1", port, first_rto_s=0.05, tries=2)
+    with pytest.raises(NatPmpUnavailable):
+        c.external_address()
+
+
+def test_retransmit_recovers_from_loss():
+    g = FakeGateway(drop_first=1)        # first datagram lost
+    try:
+        assert _client(g).external_address() == "203.0.113.7"
+        assert g.requests >= 2
+    finally:
+        g.close()
+
+
+def test_unmap_deletes(gw):
+    c = _client(gw)
+    c.map_port(PROTO_TCP, 4001)
+    assert (2, 4001) in gw.mappings
+    c.unmap(PROTO_TCP, 4001)
+    assert (2, 4001) not in gw.mappings
+
+
+def test_port_mapper_acquire_renew_release(gw):
+    mapper = PortMapper(4500, gateway=gw.addr[0], port=gw.addr[1],
+                        lifetime_s=1)
+    ext = mapper.acquire()
+    assert ext == ("203.0.113.7", 4500)
+    # Renew becomes due at half-lifetime (0.5 s).
+    reqs_before = gw.requests
+    mapper.renew_if_due()                # not due yet — no traffic
+    assert gw.requests == reqs_before
+    time.sleep(0.6)
+    mapper.renew_if_due()
+    assert gw.requests > reqs_before
+    mapper.release()
+    assert (2, 4500) not in gw.mappings
+
+
+def test_renewal_reports_changed_grant(gw):
+    """A gateway reboot may grant a different port/IP at renewal (§3.3);
+    renew_if_due must surface the change so callers re-advertise."""
+    mapper = PortMapper(4600, gateway=gw.addr[0], port=gw.addr[1],
+                        lifetime_s=1)
+    assert mapper.acquire() == ("203.0.113.7", 4600)
+    # "Reboot": gateway loses its mapping state, reassigns ports, and
+    # came back with a different external IP.
+    gw.mappings.clear()
+    gw.assign_offset = 50
+    gw.external_ip = "203.0.113.99"
+    time.sleep(0.6)
+    changed = mapper.renew_if_due()
+    assert changed == ("203.0.113.99", 4650)
+    # A steady-state renewal reports no change.
+    time.sleep(0.6)
+    assert mapper.renew_if_due() is None
+
+
+def test_advertise_mapping_replaces_stale_addr(gw):
+    directory = DirectoryService(addr="127.0.0.1:0").start()
+    n = ChatNode(username="najy", http_addr="127.0.0.1:0",
+                 directory_url=directory.url, bootstrap_addrs="",
+                 relay_addrs="", identity_file="",
+                 dht_addr="off", dht_bootstrap="").start()
+    try:
+        n._advertise_mapping(("203.0.113.7", 4001))
+        n._advertise_mapping(("203.0.113.99", 4650))
+        addrs = [str(a) for a in n.host.addrs()]
+        assert any("203.0.113.99/tcp/4650" in a for a in addrs)
+        assert not any("203.0.113.7/tcp/4001" in a for a in addrs), addrs
+    finally:
+        n.stop()
+        directory.stop()
+
+
+def test_node_advertises_mapped_external_addr(gw):
+    directory = DirectoryService(addr="127.0.0.1:0").start()
+    n = ChatNode(username="najy", http_addr="127.0.0.1:0",
+                 directory_url=directory.url, bootstrap_addrs="",
+                 relay_addrs="", identity_file="",
+                 dht_addr="off", dht_bootstrap="")
+    n._natpmp_enabled = True
+    n._natpmp_gateway = "%s:%d" % gw.addr
+    n.start()
+    try:
+        deadline = time.time() + 5.0
+        me = {}
+        while time.time() < deadline:
+            _, me = http_json("GET", f"{n.http_url}/me")
+            if any("203.0.113.7" in a for a in me["addrs"]):
+                break
+            time.sleep(0.05)
+        ext = [a for a in me["addrs"] if "203.0.113.7" in a]
+        assert ext, me["addrs"]
+        # The mapped addr carries the node's own peer id and the EXTERNAL
+        # port granted by the gateway.
+        assert ext[0] == (f"/ip4/203.0.113.7/tcp/{n.host.listen_port}"
+                          f"/p2p/{n.host.peer_id}")
+        # And it reached the directory record too (eager re-register —
+        # happens just after the addr add on the same background thread,
+        # so poll).
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            rec = n.dir.lookup("najy")
+            if any("203.0.113.7" in a for a in rec.addrs):
+                break
+            time.sleep(0.05)
+        assert any("203.0.113.7" in a for a in rec.addrs), rec.addrs
+    finally:
+        n.stop()
+        directory.stop()
+    # stop() released the mapping on the gateway.
+    assert (2, n.host.listen_port) not in gw.mappings
