@@ -489,7 +489,8 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
             mesh: Optional[Mesh] = None,
             rules: LogicalRules = DEFAULT_RULES,
             kv_window: Optional[int] = None,
-            mlp_fn=None, causal0: bool = False
+            mlp_fn=None, causal0: bool = False,
+            last_idx: Optional[jax.Array] = None,
             ) -> tuple[jax.Array, KVCache]:
     """Shared forward: embed -> scan(blocks) -> norm -> logits.
 
@@ -497,9 +498,20 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
     is ``kv_window`` (or max_seq when unset — the static attention-read
     window; see _block); k/v for this step are written at ``positions`` in
     every layer's cache. Returns (logits [B,S,vocab] f32, updated cache).
+
+    ``last_idx`` ([B] int): gather each row's hidden state at that
+    position BEFORE the lm_head and return [B,1,vocab] logits for those
+    positions only. Admission sampling needs exactly one position per
+    row, and the full-S path materialises an [B*S, vocab] f32 logits
+    temp — 3.9 GB (and ~8.6 TFLOP of discarded lm_head compute) at 8B
+    dims with a 64x128 admission chunk, which is what OOM'd 64-slot
+    serving on a 16 GB chip.
     """
     h, cache = hidden_states(params, config, tokens, positions, cache, mask,
                              mesh, rules, kv_window, mlp_fn, causal0)
+    if last_idx is not None:
+        h = jnp.take_along_axis(h, last_idx[:, None, None].astype(jnp.int32),
+                                axis=1)                     # [B,1,H]
     lm_head = (params["embed"].T if config.tie_embeddings
                else params["lm_head"])
     logits = mm(h, lm_head).astype(jnp.float32)
@@ -536,13 +548,16 @@ def embed_pooled(params: dict, config: ModelConfig, tokens: jax.Array,
 def prefill(params: dict, config: ModelConfig, tokens: jax.Array,
             prompt_lens: jax.Array, cache: KVCache,
             mesh: Optional[Mesh] = None,
-            rules: LogicalRules = DEFAULT_RULES) -> tuple[jax.Array, KVCache]:
+            rules: LogicalRules = DEFAULT_RULES,
+            last_only: bool = False) -> tuple[jax.Array, KVCache]:
     """Process right-padded prompts from position 0.
 
     tokens: [B,S] right-padded; prompt_lens: [B]. Causal masking makes pad
     slots invisible to real queries (pads sit after the prompt); cache
     lengths are set to prompt_lens so decode never attends to pad slots.
-    Returns (logits [B,S,vocab], cache).
+    Returns (logits [B,S,vocab], cache) — or (logits [B,1,vocab] at each
+    row's last prompt position, cache) with ``last_only`` (the admission
+    shape; see forward's last_idx note).
     """
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
@@ -551,7 +566,8 @@ def prefill(params: dict, config: ModelConfig, tokens: jax.Array,
     # sit after prompts; slots past S are causally dead), so big shapes
     # may take the Pallas flash-kernel path (layers.attend_gqa_auto).
     logits, cache = forward(params, config, tokens, positions, cache, mask,
-                            mesh, rules, causal0=True)
+                            mesh, rules, causal0=True,
+                            last_idx=prompt_lens - 1 if last_only else None)
     return logits, cache._replace(lengths=prompt_lens.astype(jnp.int32))
 
 

@@ -195,26 +195,30 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
             mesh: Optional[Mesh] = None,
             rules: LogicalRules = DEFAULT_RULES,
             kv_window: Optional[int] = None,
-            capacity=_AUTO, causal0: bool = False) -> tuple[jax.Array, KVCache]:
+            capacity=_AUTO, causal0: bool = False,
+            last_idx: Optional[jax.Array] = None) -> tuple[jax.Array, KVCache]:
     """llama.forward with the sparse-MoE MLP plugged in (same contract)."""
     cap = _capacity_for(config, int(tokens.shape[0] * tokens.shape[1]),
                         capacity)
     return llama.forward(params, config, tokens, positions, cache, mask,
                          mesh, rules, kv_window,
-                         mlp_fn=_mlp_fn(config, cap), causal0=causal0)
+                         mlp_fn=_mlp_fn(config, cap), causal0=causal0,
+                         last_idx=last_idx)
 
 
 def prefill(params: dict, config: ModelConfig, tokens: jax.Array,
             prompt_lens: jax.Array, cache: KVCache,
             mesh: Optional[Mesh] = None,
             rules: LogicalRules = DEFAULT_RULES,
-            capacity=_AUTO) -> tuple[jax.Array, KVCache]:
-    """Same contract as llama.prefill (right-padded prompts from pos 0)."""
+            capacity=_AUTO, last_only: bool = False) -> tuple[jax.Array, KVCache]:
+    """Same contract as llama.prefill (right-padded prompts from pos 0),
+    incl. ``last_only`` (admission's one-position logits)."""
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
     mask = causal_mask(S, cache.k.shape[2], 0)
     logits, cache = forward(params, config, tokens, positions, cache, mask,
-                            mesh, rules, capacity=capacity, causal0=True)
+                            mesh, rules, capacity=capacity, causal0=True,
+                            last_idx=prompt_lens - 1 if last_only else None)
     return logits, cache._replace(lengths=prompt_lens.astype(jnp.int32))
 
 

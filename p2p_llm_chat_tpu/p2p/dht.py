@@ -298,8 +298,11 @@ class DHTNode:
         self._rx: Optional[threading.Thread] = None
         # One long-lived pool for lookup/store fan-out — per-round executor
         # creation on the inline /send path would pay thread startup for
-        # every ALPHA-batch and leak straggler threads per round.
-        self._pool = ThreadPoolExecutor(max_workers=max(k, ALPHA),
+        # every ALPHA-batch and leak straggler threads per round. Sized at
+        # 3x the widest single fan-out (k) so a /send-path lookup does not
+        # queue behind a concurrent republish's k store RPCs and time out
+        # live contacts as false no-answers.
+        self._pool = ThreadPoolExecutor(max_workers=3 * max(k, ALPHA),
                                         thread_name_prefix="dht-fan")
 
     # -- lifecycle -----------------------------------------------------------
@@ -571,6 +574,8 @@ class DHTNode:
                     pass
         except FutTimeout:
             pass
+        for f in futs:
+            f.cancel()   # drop any still-queued RPCs nobody will read
         return out
 
     def _iterate(self, target: int,

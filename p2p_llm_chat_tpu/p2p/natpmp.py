@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import socket
 import struct
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional
@@ -187,6 +188,11 @@ class PortMapper:
         self._gw_port = port
         self.mapping: Optional[Mapping] = None
         self._renew_at = 0.0
+        # Orders renew against release: a renew in flight when release()
+        # fires would otherwise RE-create the mapping after the delete,
+        # leaking the port forward shutdown cleanup exists to prevent.
+        self._mu = threading.Lock()
+        self._released = False
 
     def acquire(self) -> Optional[tuple[str, int]]:
         if self.gateway is None:
@@ -212,27 +218,34 @@ class PortMapper:
         when it CHANGED (gateway reboot / reassigned port — RFC 6886 §3.3
         allows a different grant; §3.6's epoch exists for exactly this),
         else None. Callers must re-advertise on change."""
-        if self.mapping is None or time.monotonic() < self._renew_at:
-            return None
-        prev = (self.mapping.external_ip, self.mapping.external_port)
-        client = NatPmpClient(self.gateway, self._gw_port)
-        try:
-            ext_ip = client.external_address()
-            m = client.map_port(PROTO_TCP, self.internal_port,
-                                self.mapping.external_port, self.lifetime_s)
-            m.external_ip = ext_ip
-            self.mapping = m
-            self._renew_at = time.monotonic() + m.lifetime_s / 2
-            cur = (ext_ip, m.external_port)
-            return cur if cur != prev else None
-        except (NatPmpError, NatPmpUnavailable) as e:
-            log.warning("NAT-PMP renew failed (%s); mapping may lapse", e)
-            # Back off half a lifetime before retrying.
-            self._renew_at = time.monotonic() + self.lifetime_s / 4
-            return None
+        with self._mu:
+            if (self._released or self.mapping is None
+                    or time.monotonic() < self._renew_at):
+                return None
+            prev = (self.mapping.external_ip, self.mapping.external_port)
+            client = NatPmpClient(self.gateway, self._gw_port)
+            try:
+                ext_ip = client.external_address()
+                m = client.map_port(PROTO_TCP, self.internal_port,
+                                    self.mapping.external_port,
+                                    self.lifetime_s)
+                m.external_ip = ext_ip
+                self.mapping = m
+                self._renew_at = time.monotonic() + m.lifetime_s / 2
+                cur = (ext_ip, m.external_port)
+                return cur if cur != prev else None
+            except (NatPmpError, NatPmpUnavailable) as e:
+                log.warning("NAT-PMP renew failed (%s); mapping may lapse", e)
+                # Back off half a lifetime before retrying.
+                self._renew_at = time.monotonic() + self.lifetime_s / 4
+                return None
 
     def release(self) -> None:
-        if self.mapping is not None and self.gateway is not None:
-            NatPmpClient(self.gateway, self._gw_port).unmap(
-                PROTO_TCP, self.internal_port)
-            self.mapping = None
+        # Takes the same lock as renew_if_due, so an in-flight renew
+        # finishes first and the delete below is the LAST gateway write.
+        with self._mu:
+            self._released = True
+            if self.mapping is not None and self.gateway is not None:
+                NatPmpClient(self.gateway, self._gw_port).unmap(
+                    PROTO_TCP, self.internal_port)
+                self.mapping = None

@@ -430,10 +430,12 @@ class BatchScheduler:
             lens, seeds = ints[0], ints[2]
             chunk_temps, chunk_tps = floats[0], floats[1]
             small = KVCache.create(config, R, S, dtype=self._dtype)
+            # last_only: the full [R,S,V] logits would materialise an
+            # R*S x vocab f32 temp (3.9 GB at 8B dims, 64x128 chunk) and
+            # pay S x the lm_head FLOPs for positions nobody samples.
             logits, small = model.prefill(params, config, tokens, lens,
-                                          small, mesh)
-            last = jnp.take_along_axis(
-                logits, (lens - 1)[:, None, None], axis=1)[:, 0, :]   # [R,V]
+                                          small, mesh, last_only=True)
+            last = logits[:, 0, :]                                    # [R,V]
             row_keys = jax.vmap(jax.random.PRNGKey)(seeds)
             toks, row_keys = sample_batched(last, row_keys, chunk_temps,
                                             ints[3], chunk_tps,
@@ -529,9 +531,9 @@ class BatchScheduler:
             positions = jnp.broadcast_to(P + jnp.arange(S)[None, :], (R, S))
             mask = causal_mask(S, P + S, P)
             logits, small = model.forward(params, config, tokens, positions,
-                                          small, mask, mesh)
-            last = jnp.take_along_axis(
-                logits, (suf_lens - 1)[:, None, None], axis=1)[:, 0, :]
+                                          small, mask, mesh,
+                                          last_idx=suf_lens - 1)
+            last = logits[:, 0, :]
             row_keys = jax.vmap(jax.random.PRNGKey)(seeds)
             toks, row_keys = sample_batched(last, row_keys, floats[0],
                                             ints[3], floats[1],

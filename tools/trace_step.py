@@ -26,7 +26,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tools.profile_step import step_variant  # noqa: E402
 from p2p_llm_chat_tpu.models import llama  # noqa: E402
 from p2p_llm_chat_tpu.models.configs import get_config  # noqa: E402
-from p2p_llm_chat_tpu.models.quant import quantize_params  # noqa: E402
 from p2p_llm_chat_tpu.ops.paged_kv import PagedKVCache  # noqa: E402
 
 
@@ -45,10 +44,9 @@ def main() -> None:
     pages = -(-window // page_size)
 
     config = get_config(cfg_name)
-    params = llama.init_params(config, jax.random.PRNGKey(0),
-                               dtype=jnp.bfloat16)
-    params = quantize_params(params)
-    params = llama.fuse_params(params)
+    # Streamed fused-int8 init: same layout fuse_params produces, but the
+    # bf16 tree never materialises — required for llama3.1-8b on one chip.
+    params = llama.init_params_quantized(config, jax.random.PRNGKey(0))
     jax.block_until_ready(params)
     mppr = pages
     num_pages = B * mppr + 1
