@@ -44,6 +44,17 @@ def is_native_checkpoint(ckpt_dir: str) -> bool:
     return os.path.exists(os.path.join(ckpt_dir, _META))
 
 
+def peek_config(ckpt_dir: str) -> ModelConfig:
+    """Resolve a native checkpoint's config from its metadata alone — no
+    tensor reads (callers that gate on model family must decide BEFORE
+    paying a multi-GB restore)."""
+    with open(os.path.join(ckpt_dir, _META)) as f:
+        meta = json.load(f)
+    if meta["config"] not in CONFIGS:
+        raise ValueError(f"unknown config {meta['config']!r} in {ckpt_dir}")
+    return CONFIGS[meta["config"]]
+
+
 def save_checkpoint(ckpt_dir: str, params: dict, config: ModelConfig) -> None:
     """Persist a param tree + config. The tree must be unquantized (see
     module docstring); sharded arrays are gathered/written per-shard by
@@ -66,7 +77,7 @@ def save_checkpoint(ckpt_dir: str, params: dict, config: ModelConfig) -> None:
 
 def load_checkpoint(ckpt_dir: str, mesh: Optional[Mesh] = None,
                     rules: LogicalRules = DEFAULT_RULES,
-                    ) -> tuple[dict, ModelConfig]:
+                    device=None) -> tuple[dict, ModelConfig]:
     """Restore a native checkpoint, placing each leaf with its logical
     sharding when a mesh is given — Orbax reads straight into the sharded
     buffers, so host memory never holds the full tree."""
@@ -97,8 +108,11 @@ def load_checkpoint(ckpt_dir: str, mesh: Optional[Mesh] = None,
     else:
         # Orbax requires CONCRETE shardings on some backends (observed on
         # the axon TPU plugin: "sharding passed to deserialization should
-        # be specified" with a bare ShapeDtypeStruct).
-        single = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        # be specified" with a bare ShapeDtypeStruct). ``device`` overrides
+        # the target — weights.load_checkpoint_quantized restores to a CPU
+        # device so a 16 GB bf16 tree never touches a 16 GB chip.
+        single = jax.sharding.SingleDeviceSharding(
+            device if device is not None else jax.devices()[0])
         abstract = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
                                            sharding=single),

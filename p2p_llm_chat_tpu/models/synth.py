@@ -61,7 +61,7 @@ def quote_params(config: ModelConfig, key: jax.Array,
     llama family streams straight to fused int8; other families quantize
     after init). Requires an untied lm_head."""
     from . import family_for, llama
-    from .quant import quantize, quantize_params
+    from .quant import quantize_params
 
     if config.tie_embeddings:
         raise ValueError("quote workload needs an untied lm_head")
@@ -115,7 +115,20 @@ def quote_params(config: ModelConfig, key: jax.Array,
     np.add.at(lm_t, succ, emb * weights[:, None])
     lm = lm_t.T * 4.0
     params = dict(params)
+    # Drop the init head before uploading the quote head: at 8B dims the
+    # pair is ~1.6 GB of HBM that must not coexist with the new leaves.
+    params.pop("embed", None)
+    old_head = params.pop("lm_head", None)
+    del old_head
     params["embed"] = jnp.asarray(emb, dtype)
-    params["lm_head"] = (quantize(jnp.asarray(lm, jnp.float32))
-                         if quantized else jnp.asarray(lm, dtype))
+    if quantized:
+        # Quantize HOST-side (exact mirror of quant.quantize, axis=-2):
+        # uploading lm as f32 to quantize on device is a 2.1 GB HBM spike
+        # at 8B dims that OOM'd the spec-enabled quote bench.
+        amax = np.abs(lm).max(axis=0, keepdims=True)
+        s = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+        q = np.clip(np.round(lm / s), -127, 127).astype(np.int8)
+        params["lm_head"] = QTensor(q=jnp.asarray(q), s=jnp.asarray(s))
+    else:
+        params["lm_head"] = jnp.asarray(lm, dtype)
     return params

@@ -316,3 +316,185 @@ def load_checkpoint(ckpt_dir: str, config: Optional[ModelConfig] = None,
     log.info("loaded %s: %.2fB params", config.name,
              sum(x.size for x in jax.tree.leaves(params)) / 1e9)
     return params, config
+
+
+def load_checkpoint_quantized(ckpt_dir: str,
+                              config: Optional[ModelConfig] = None,
+                              ) -> tuple[dict, ModelConfig]:
+    """Single-chip big-model load: stream a checkpoint (HF safetensors or
+    native Orbax) straight into the FUSED int8 stacked tree — the bf16
+    device tree never exists.
+
+    Why: ``load_checkpoint``/``load_checkpoint`` + ``quantize_params``
+    peaks at the full bf16 model on the chip (~16 GB for llama3.1-8B —
+    does not fit a 16 GB v5e), even though the int8 model (~8.6 GB) plus
+    an int8 KV pool does. This is the checkpoint-path twin of
+    ``llama.init_params_quantized`` (which solved the same problem for
+    random init): per layer, the host tensors are uploaded bf16
+    (~0.3 GB at 8B), quantized on device, and spliced into donated
+    stacked int8 buffers in ``fuse_params``' wqkv/wgu layout — so
+    quantize-then-fuse equivalence holds exactly (per-output-channel
+    scales concatenate with their columns).
+
+    Dense llama-family only (MoE checkpoints keep the sharded/mesh
+    paths); raises ValueError otherwise. Tied-embedding configs return
+    no ``lm_head`` leaf (forward uses ``embed.T``, kept bf16).
+    """
+    from . import family_for, llama
+    from .checkpoint import is_native_checkpoint, peek_config
+    from .checkpoint import load_checkpoint as load_native
+    from .quant import QTensor, quantize
+
+    dtype = jnp.bfloat16
+
+    # Family gate FIRST — from metadata alone. Checking after the tensor
+    # reads would load a rejected multi-GB checkpoint in full, only for
+    # the engine to re-load it through the standard path.
+    native = is_native_checkpoint(ckpt_dir)
+    if config is None:
+        config = (peek_config(ckpt_dir) if native else
+                  config_from_hf_json(os.path.join(ckpt_dir, "config.json")))
+    family = family_for(config)
+    if config.is_moe or family is not llama:
+        raise ValueError(
+            "load_checkpoint_quantized covers the dense llama family; "
+            f"{config.name} keeps the standard load paths")
+
+    # -- per-layer host-tensor iterator -------------------------------------
+    if native:
+        cpu = jax.devices("cpu")[0]
+        host_params, config = load_native(ckpt_dir, device=cpu)
+
+        def layer_host(li: int) -> dict[str, np.ndarray]:
+            lp = host_params["layers"]
+            return {k: np.asarray(lp[k][li]) for k in
+                    ("attn_norm", "wq", "wk", "wv", "wo",
+                     "mlp_norm", "w_gate", "w_up", "w_down")}
+
+        def top_host() -> dict[str, np.ndarray]:
+            out = {"embed": np.asarray(host_params["embed"]),
+                   "final_norm": np.asarray(host_params["final_norm"])}
+            if "lm_head" in host_params:
+                out["lm_head"] = np.asarray(host_params["lm_head"])
+            return out
+    else:
+        host_params = None
+
+        def _read_all() -> tuple[dict, dict]:
+            """One pass over the shards, grouped per layer. Host peak is
+            the full tree for HF dirs read this way — acceptable (host
+            RAM >> HBM); the DEVICE peak is what this loader bounds."""
+            from safetensors import safe_open
+            name_map = _reverse_name_map(config)
+            per_layer: dict[int, dict[str, np.ndarray]] = {}
+            top: dict[str, np.ndarray] = {}
+            missing = set(name_map)
+            shards = sorted(f for f in os.listdir(ckpt_dir)
+                            if f.endswith(".safetensors"))
+            if not shards:
+                raise FileNotFoundError(f"no .safetensors in {ckpt_dir}")
+            for shard in shards:
+                with safe_open(os.path.join(ckpt_dir, shard),
+                               framework="numpy") as f:
+                    for name in f.keys():
+                        entry = name_map.get(name)
+                        if entry is None:
+                            continue
+                        path, layer, _expert, transpose = entry
+                        t = f.get_tensor(name)
+                        if transpose:
+                            t = np.ascontiguousarray(t.T)
+                        if layer is None:
+                            top[path[-1]] = t
+                        else:
+                            per_layer.setdefault(layer, {})[path[-1]] = t
+                        missing.discard(name)
+            if missing:
+                raise KeyError(
+                    f"checkpoint {ckpt_dir} is missing {len(missing)} "
+                    f"tensor(s), e.g. {sorted(missing)[:3]}")
+            return per_layer, top
+
+        _layers_np, _top_np = _read_all()
+
+        def layer_host(li: int) -> dict[str, np.ndarray]:
+            return _layers_np[li]
+
+        def top_host() -> dict[str, np.ndarray]:
+            return _top_np
+
+    # -- per-layer host quantize + donated device splice --------------------
+    # Quantization happens in HOST numpy, mirroring quant.quantize's exact
+    # IEEE f32 ops (abs-max / 127 per output column, round-half-even) —
+    # in-jit quantization may fuse the divide/round and drift +-1 from the
+    # eager quantize_params path, breaking the bit-identity contract.
+    L, H = config.num_layers, config.hidden_size
+    dims = {
+        "wqkv": (H, config.q_dim + 2 * config.kv_dim),
+        "wo": (config.q_dim, H),
+        "wgu": (H, 2 * config.intermediate_size),
+        "w_down": (config.intermediate_size, H),
+    }
+    bufs = {name: QTensor(q=jnp.zeros((L, din, dout), jnp.int8),
+                          s=jnp.zeros((L, 1, dout), jnp.float32))
+            for name, (din, dout) in dims.items()}
+
+    import ml_dtypes
+
+    def host_quant(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        # Round through bf16 first: the reference path (load bf16 tree,
+        # then quantize_params) sees bf16-rounded weights, and HF shards
+        # are often f32 — skipping the rounding would drift the scales.
+        wf = (np.asarray(w).astype(ml_dtypes.bfloat16)
+              .astype(np.float32))
+        amax = np.abs(wf).max(axis=0, keepdims=True)
+        s = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+        q = np.clip(np.round(wf / s), -127, 127).astype(np.int8)
+        return q, s
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def splice_layer(bufs, qs, layer):
+        out = dict(bufs)
+        for name, (q, s) in qs.items():
+            out[name] = QTensor(q=bufs[name].q.at[layer].set(q),
+                                s=bufs[name].s.at[layer].set(s))
+        return out
+
+    attn_norms = np.zeros((L, H), np.float32)
+    mlp_norms = np.zeros((L, H), np.float32)
+    for li in range(L):
+        lt = layer_host(li)
+        attn_norms[li] = lt["attn_norm"].astype(np.float32)
+        mlp_norms[li] = lt["mlp_norm"].astype(np.float32)
+        fused = {
+            "wqkv": np.concatenate(
+                [lt["wq"], lt["wk"], lt["wv"]], axis=1),
+            "wo": lt["wo"],
+            "wgu": np.concatenate([lt["w_gate"], lt["w_up"]], axis=1),
+            "w_down": lt["w_down"],
+        }
+        qs = {}
+        for name, w in fused.items():
+            q, s = host_quant(w)
+            qs[name] = (jnp.asarray(q), jnp.asarray(s))
+        bufs = splice_layer(bufs, qs, jnp.asarray(li))
+
+    top = top_host()
+    layers: dict = {
+        "attn_norm": jnp.asarray(attn_norms, dtype),
+        "mlp_norm": jnp.asarray(mlp_norms, dtype),
+        **bufs,
+    }
+    params: dict = {
+        "embed": jnp.asarray(top["embed"], dtype),
+        "layers": layers,
+        "final_norm": jnp.asarray(top["final_norm"], dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = quantize(jnp.asarray(top["lm_head"], dtype))
+    jax.block_until_ready(params)
+    del host_params
+    log.info("loaded %s quantized+fused (streaming, single-chip): "
+             "%.2fB params int8", config.name,
+             sum(x.size for x in jax.tree.leaves(params)) / 1e9)
+    return params, config

@@ -294,19 +294,34 @@ def build_engine_from_env() -> Backend:
         so the format probe and quantization cannot drift between the
         single- and multi-model paths."""
         from ..models.checkpoint import is_native_checkpoint
-        if is_native_checkpoint(path):
-            from ..models.checkpoint import load_checkpoint as load_native
-            params, config = load_native(path, mesh=mesh)
-        elif mesh is not None:
-            # Mesh loads are the big-model path: stream tensors straight
-            # into the sharded device tree so host RAM never holds the
-            # checkpoint (the 70B memory-fit requirement).
-            from ..models.weights import load_checkpoint_streaming
-            params, config = load_checkpoint_streaming(path, mesh=mesh)
+        already_quantized = False
+        if quant and mesh is None:
+            # Single-chip int8: stream straight into the fused int8 tree
+            # so the bf16 model never touches the chip (what fits an 8B
+            # checkpoint on one 16 GB v5e). Dense-llama only; MoE falls
+            # through to the standard paths.
+            from ..models.weights import load_checkpoint_quantized
+            try:
+                params, config = load_checkpoint_quantized(path)
+                already_quantized = True
+            except ValueError:
+                params = None
         else:
-            params, config = load_checkpoint(path, mesh=mesh)
+            params = None
+        if params is None:
+            if is_native_checkpoint(path):
+                from ..models.checkpoint import load_checkpoint as load_native
+                params, config = load_native(path, mesh=mesh)
+            elif mesh is not None:
+                # Mesh loads are the big-model path: stream tensors
+                # straight into the sharded device tree so host RAM never
+                # holds the checkpoint (the 70B memory-fit requirement).
+                from ..models.weights import load_checkpoint_streaming
+                params, config = load_checkpoint_streaming(path, mesh=mesh)
+            else:
+                params, config = load_checkpoint(path, mesh=mesh)
         tokenizer = load_tokenizer(path, vocab_size=config.vocab_size)
-        if quant:
+        if quant and not already_quantized:
             from ..models.quant import quantize_params
             params = quantize_params(params, mesh=mesh)
             log.info("weights quantized to int8 (per-channel, w8a16)")

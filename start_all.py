@@ -21,6 +21,7 @@ sleeps (the reference uses ``sleep 5``).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import shutil
 import signal
@@ -124,6 +125,7 @@ def main() -> int:
         wait_http(f"{serve_url}/healthz",
                   timeout=300 if args.backend != "fake" else 30)
 
+        dht_seed = ""
         for i, user in enumerate(users):
             node_port = args.node_port_base + i
             ui_port = args.ui_port_base + i
@@ -134,8 +136,21 @@ def main() -> int:
             }
             if relay_addrs:
                 node_env["RELAY_ADDRS"] = relay_addrs
+            if dht_seed:
+                # Chain every later node's DHT off the first node, so a
+                # launched deployment resolves peers through a directory
+                # outage out of the box (node.py lookup ladder rung 3).
+                node_env["DHT_BOOTSTRAP"] = dht_seed
             spawn(f"node-{user}", "p2p_llm_chat_tpu.node", node_env, procs)
             wait_http(f"http://127.0.0.1:{node_port}/healthz")
+            if not dht_seed:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{node_port}/me",
+                            timeout=5) as r:
+                        dht_seed = json.loads(r.read()).get("dht_addr", "")
+                except Exception:  # noqa: BLE001 — DHT stays optional
+                    pass
             spawn(f"ui-{user}", "p2p_llm_chat_tpu.ui", {
                 "NODE_HTTP": f"http://127.0.0.1:{node_port}",
                 "OLLAMA_URL": serve_url,

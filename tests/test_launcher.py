@@ -67,9 +67,12 @@ def test_start_all_full_stack_roundtrip():
         assert ready, "stack never became ready"
 
         # Relay actually wired: both nodes advertise a circuit addr.
+        # DHT wired too: every node exposes its UDP addr, and the
+        # launcher chains later nodes' DHT_BOOTSTRAP off the first.
         for port in (node0, node1):
             me = _get(f"http://127.0.0.1:{port}/me")
             assert any("/p2p-circuit/" in a for a in me["addrs"]), me
+            assert me.get("dht_addr"), me
 
         # Message round-trip Najy -> Cannan.
         r = _post(f"http://127.0.0.1:{node0}/send",

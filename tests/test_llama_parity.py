@@ -211,3 +211,33 @@ def test_attend_gqa_auto_flash_dispatch_matches_dense(monkeypatch):
                                 layers.causal_mask(Sq, 1536, 100))
     np.testing.assert_allclose(np.asarray(got512), np.asarray(want512),
                                atol=1e-5, rtol=1e-5)   # 1536 -> chunk 512
+
+
+def test_prefill_last_only_matches_full():
+    """Admission's last_only path must produce exactly the full prefill's
+    logits at each row's last prompt position (same hidden states, same
+    lm_head — only the gather moves before the matmul)."""
+    from p2p_llm_chat_tpu.models.configs import get_config
+
+    config = get_config("tiny")
+    params = llama.init_params(config, __import__("jax").random.PRNGKey(0),
+                               dtype=jnp.float32)
+    B, S = 3, 12
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, config.vocab_size, (B, S)),
+                         jnp.int32)
+    lens = jnp.asarray([12, 7, 1], jnp.int32)
+
+    full, _ = llama.prefill(params, config, tokens, lens,
+                            KVCache.create(config, B, S, dtype=jnp.float32))
+    last, cache = llama.prefill(params, config, tokens, lens,
+                                KVCache.create(config, B, S,
+                                               dtype=jnp.float32),
+                                last_only=True)
+    assert last.shape == (B, 1, config.vocab_size)
+    want = np.take_along_axis(np.asarray(full),
+                              np.asarray(lens - 1)[:, None, None], axis=1)
+    np.testing.assert_allclose(np.asarray(last), want, rtol=1e-5, atol=1e-5)
+    # The cache is unaffected by the logits shape.
+    np.testing.assert_array_equal(np.asarray(cache.lengths),
+                                  np.asarray(lens))

@@ -195,3 +195,55 @@ def test_streaming_loader_tied_embeddings(tmp_path):
     got, _ = load_checkpoint_streaming(ckpt, dtype=jnp.float32)
     assert "lm_head" not in got
     _assert_trees_equal(got, want)
+
+
+def test_load_checkpoint_quantized_hf_matches_quantize_then_fuse(tmp_path):
+    """The single-chip streamed int8 loader must produce EXACTLY
+    fuse_params(quantize_params(load_checkpoint(...))) — quantization is
+    deterministic and per-output-channel scales concatenate with their
+    columns, so the trees are bit-identical."""
+    from p2p_llm_chat_tpu.models import llama
+    from p2p_llm_chat_tpu.models.quant import quantize_params
+    from p2p_llm_chat_tpu.models.weights import load_checkpoint_quantized
+
+    model, cfg = _tiny_llama()
+    ckpt = _write_ckpt(tmp_path, model)
+    got, got_cfg = load_checkpoint_quantized(ckpt)
+    assert got_cfg.name == cfg.name or got_cfg.hidden_size == cfg.hidden_size
+
+    base, _ = load_checkpoint(ckpt)         # bf16 (default dtype)
+    want = llama.fuse_params(quantize_params(base))
+    _assert_trees_equal(got, want)
+
+
+def test_load_checkpoint_quantized_native_matches(tmp_path):
+    """Same equivalence through a native Orbax checkpoint (the e2e quote
+    checkpoints and any natively-saved model take this path)."""
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from p2p_llm_chat_tpu.models import llama
+    from p2p_llm_chat_tpu.models.checkpoint import save_checkpoint
+    from p2p_llm_chat_tpu.models.configs import get_config
+    from p2p_llm_chat_tpu.models.quant import quantize_params
+    from p2p_llm_chat_tpu.models.weights import load_checkpoint_quantized
+
+    cfg = get_config("tiny")
+    params = llama.init_params(cfg, _jax.random.PRNGKey(3),
+                               dtype=_jnp.bfloat16)
+    ckpt = str(tmp_path / "native")
+    save_checkpoint(ckpt, params, cfg)
+
+    got, got_cfg = load_checkpoint_quantized(ckpt)
+    assert got_cfg.name == "tiny"
+    want = llama.fuse_params(quantize_params(params))
+    _assert_trees_equal(got, want)
+
+
+def test_load_checkpoint_quantized_rejects_moe(tmp_path):
+    from tests.test_mixtral_parity import make_hf_model as make_moe
+    from p2p_llm_chat_tpu.models.weights import load_checkpoint_quantized
+    model, cfg = make_moe()
+    ckpt = _write_ckpt(tmp_path, model)
+    with pytest.raises(ValueError, match="dense llama"):
+        load_checkpoint_quantized(ckpt)

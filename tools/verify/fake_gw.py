@@ -1,0 +1,31 @@
+"""Standalone fake NAT-PMP gateway for the verify drive."""
+import socket
+import struct
+import sys
+import time
+
+sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+sock.bind(("127.0.0.1", int(sys.argv[1])))
+print("ready", flush=True)
+mappings = {}
+t0 = time.monotonic()
+while True:
+    data, src = sock.recvfrom(64)
+    if len(data) < 2 or data[0] != 0:
+        continue
+    op = data[1]
+    epoch = int(time.monotonic() - t0)
+    if op == 0:
+        sock.sendto(struct.pack("!BBHI", 0, 128, 0, epoch)
+                    + socket.inet_aton("198.51.100.42"), src)
+    elif op in (1, 2) and len(data) >= 12:
+        _, _, _, iport, eport, lifetime = struct.unpack("!BBHHHI", data)
+        if lifetime == 0:
+            mappings.pop((op, iport), None)
+            ge, gl = 0, 0
+        else:
+            ge, gl = (eport or iport), lifetime
+            mappings[(op, iport)] = (ge, gl)
+        sock.sendto(struct.pack("!BBHIHHI", 0, 128 + op, 0, epoch,
+                                iport, ge, gl), src)
+        print("mappings", sorted(mappings), flush=True)
