@@ -88,6 +88,8 @@ def log(msg: str) -> None:
 
 
 def main() -> None:
+    from p2p_llm_chat_tpu.utils.jax_cache import enable_persistent_cache
+    enable_persistent_cache()
     t0 = time.monotonic()
     import jax
     import jax.numpy as jnp

@@ -68,10 +68,14 @@ def save_checkpoint(ckpt_dir: str, params: dict, config: ModelConfig) -> None:
     ckpt_dir = os.path.abspath(ckpt_dir)
     os.makedirs(ckpt_dir, exist_ok=True)
     dtype = jax.tree.leaves(params)[0].dtype
-    with open(os.path.join(ckpt_dir, _META), "w") as f:
-        json.dump({"config": config.name, "dtype": str(dtype)}, f)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(os.path.join(ckpt_dir, _TREE), params, force=True)
+    # Meta is written LAST: its presence is the completeness marker
+    # (is_native_checkpoint, cache-reuse checks) — writing it first
+    # would make an interrupted multi-GB save look like a valid
+    # checkpoint forever after.
+    with open(os.path.join(ckpt_dir, _META), "w") as f:
+        json.dump({"config": config.name, "dtype": str(dtype)}, f)
     log.info("saved %s (%s) to %s", config.name, dtype, ckpt_dir)
 
 

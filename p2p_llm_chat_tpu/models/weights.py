@@ -172,6 +172,44 @@ def _reverse_name_map(config: ModelConfig) -> dict[str, tuple]:
     return out
 
 
+def _iter_hf_tensors(ckpt_dir: str, config: ModelConfig):
+    """Yield ``(leaf_path, layer, expert, np_tensor)`` for every mapped
+    tensor across the dir's safetensors shards, transpose already applied
+    (host RAM holds one tensor at a time). Shared by the streaming and
+    streamed-int8 loaders so the shard walk / name map / missing-tensor
+    accounting cannot drift between them. Raises FileNotFoundError with
+    no shards; KeyError when mapped tensors are absent (zeros where
+    weights should be = garbage logits with no error — fail loudly)."""
+    from safetensors import safe_open
+
+    name_map = _reverse_name_map(config)
+    missing = set(name_map)
+    shards = sorted(f for f in os.listdir(ckpt_dir)
+                    if f.endswith(".safetensors"))
+    if not shards:
+        raise FileNotFoundError(f"no .safetensors files in {ckpt_dir}")
+    for shard in shards:
+        with safe_open(os.path.join(ckpt_dir, shard),
+                       framework="numpy") as f:
+            for name in f.keys():
+                entry = name_map.get(name)
+                if entry is None:
+                    continue
+                path, layer, expert, transpose = entry
+                t = f.get_tensor(name)
+                if transpose:
+                    t = np.ascontiguousarray(t.T)
+                missing.discard(name)
+                yield path, layer, expert, t
+        log.info("streamed shard %s (%d/%d tensors placed)", shard,
+                 len(name_map) - len(missing), len(name_map))
+    if missing:
+        raise KeyError(
+            f"checkpoint {ckpt_dir} is missing {len(missing)} expected "
+            f"tensor(s), e.g. {sorted(missing)[:3]} — truncated download "
+            "or wrong config?")
+
+
 def load_checkpoint_streaming(ckpt_dir: str,
                               config: Optional[ModelConfig] = None,
                               mesh: Optional[Mesh] = None,
@@ -191,8 +229,6 @@ def load_checkpoint_streaming(ckpt_dir: str,
     per leaf shape, reused across layers, so host peak stays at the
     largest single tensor and device memory at the final tree size.
     """
-    from safetensors import safe_open
-
     from . import family_for
 
     if config is None:
@@ -233,43 +269,18 @@ def load_checkpoint_streaming(ckpt_dir: str,
             node = node[p]
         node[path[-1]] = value
 
-    name_map = _reverse_name_map(config)
-    shards = sorted(f for f in os.listdir(ckpt_dir)
-                    if f.endswith(".safetensors"))
-    if not shards:
-        raise FileNotFoundError(f"no .safetensors files in {ckpt_dir}")
-    missing = set(name_map)
-    for shard in shards:
-        with safe_open(os.path.join(ckpt_dir, shard), framework="numpy") as f:
-            for name in f.keys():
-                entry = name_map.get(name)
-                if entry is None:
-                    continue
-                path, layer, expert, transpose = entry
-                t = f.get_tensor(name)
-                if transpose:
-                    t = np.ascontiguousarray(t.T)
-                leaf = get_leaf(path)
-                if layer is None:
-                    set_leaf(path, jax.device_put(
-                        jnp.asarray(t, dtype),
-                        leaf.sharding if mesh is not None else None))
-                else:
-                    idx = (jnp.asarray(layer, jnp.int32),
-                           jnp.asarray(0 if expert is None else expert,
-                                       jnp.int32))
-                    set_leaf(path, splice(leaf, jnp.asarray(t, dtype),
-                                          idx, expert is not None))
-                missing.discard(name)
-        log.info("streamed shard %s (%d tensors placed)", shard,
-                 len(name_map) - len(missing))
-    if missing:
-        # Zeros where weights should be = garbage logits with no error
-        # (the batch loader KeyErrors on the same input). Fail loudly.
-        raise KeyError(
-            f"checkpoint {ckpt_dir} is missing {len(missing)} expected "
-            f"tensor(s), e.g. {sorted(missing)[:3]} — truncated download "
-            "or wrong config?")
+    for path, layer, expert, t in _iter_hf_tensors(ckpt_dir, config):
+        leaf = get_leaf(path)
+        if layer is None:
+            set_leaf(path, jax.device_put(
+                jnp.asarray(t, dtype),
+                leaf.sharding if mesh is not None else None))
+        else:
+            idx = (jnp.asarray(layer, jnp.int32),
+                   jnp.asarray(0 if expert is None else expert,
+                               jnp.int32))
+            set_leaf(path, splice(leaf, jnp.asarray(t, dtype),
+                                  idx, expert is not None))
     log.info("loaded %s (streaming): %.2fB params", config.name,
              sum(x.size for x in jax.tree.leaves(params)) / 1e9)
     return params, config
@@ -318,6 +329,13 @@ def load_checkpoint(ckpt_dir: str, config: Optional[ModelConfig] = None,
     return params, config
 
 
+class UnsupportedForQuantizedLoad(ValueError):
+    """The checkpoint's family is outside load_checkpoint_quantized's
+    scope (dense llama only) — callers fall back to the standard paths.
+    A dedicated type so fallbacks cannot swallow REAL load errors
+    (corrupt shards etc.), which must propagate."""
+
+
 def load_checkpoint_quantized(ckpt_dir: str,
                               config: Optional[ModelConfig] = None,
                               ) -> tuple[dict, ModelConfig]:
@@ -325,25 +343,32 @@ def load_checkpoint_quantized(ckpt_dir: str,
     native Orbax) straight into the FUSED int8 stacked tree — the bf16
     device tree never exists.
 
-    Why: ``load_checkpoint``/``load_checkpoint`` + ``quantize_params``
-    peaks at the full bf16 model on the chip (~16 GB for llama3.1-8B —
-    does not fit a 16 GB v5e), even though the int8 model (~8.6 GB) plus
-    an int8 KV pool does. This is the checkpoint-path twin of
-    ``llama.init_params_quantized`` (which solved the same problem for
-    random init): per layer, the host tensors are uploaded bf16
-    (~0.3 GB at 8B), quantized on device, and spliced into donated
-    stacked int8 buffers in ``fuse_params``' wqkv/wgu layout — so
-    quantize-then-fuse equivalence holds exactly (per-output-channel
-    scales concatenate with their columns).
+    Why: ``load_checkpoint`` + ``quantize_params`` peaks at the full bf16
+    model on the chip (~16 GB for llama3.1-8B — does not fit a 16 GB
+    v5e), even though the int8 model (~8.6 GB) plus an int8 KV pool does.
+    This is the checkpoint-path twin of ``llama.init_params_quantized``
+    (which solved the same problem for random init): per layer, the host
+    tensors are quantized host-side and spliced into donated stacked int8
+    buffers in ``fuse_params``' wqkv/wgu layout — quantize-then-fuse
+    equivalence holds exactly (per-output-channel scales concatenate with
+    their columns).
+
+    Weights round through bf16 (the serving compute dtype) before
+    quantization, so the result is BIT-IDENTICAL to load-at-bf16 ->
+    quantize_params -> fuse_params (pinned by tests for both formats).
+    For f32-SAVED native checkpoints the old single-chip path would have
+    quantized unrounded f32 — that path cannot fit big models anyway, and
+    all in-tree saves default to bf16.
 
     Dense llama-family only (MoE checkpoints keep the sharded/mesh
-    paths); raises ValueError otherwise. Tied-embedding configs return
-    no ``lm_head`` leaf (forward uses ``embed.T``, kept bf16).
+    paths); raises :class:`UnsupportedForQuantizedLoad` otherwise.
+    Tied-embedding configs return no ``lm_head`` leaf (forward uses
+    ``embed.T``, kept bf16).
     """
     from . import family_for, llama
     from .checkpoint import is_native_checkpoint, peek_config
     from .checkpoint import load_checkpoint as load_native
-    from .quant import QTensor, quantize
+    from .quant import QTensor
 
     dtype = jnp.bfloat16
 
@@ -356,7 +381,7 @@ def load_checkpoint_quantized(ckpt_dir: str,
                   config_from_hf_json(os.path.join(ckpt_dir, "config.json")))
     family = family_for(config)
     if config.is_moe or family is not llama:
-        raise ValueError(
+        raise UnsupportedForQuantizedLoad(
             "load_checkpoint_quantized covers the dense llama family; "
             f"{config.name} keeps the standard load paths")
 
@@ -381,38 +406,18 @@ def load_checkpoint_quantized(ckpt_dir: str,
         host_params = None
 
         def _read_all() -> tuple[dict, dict]:
-            """One pass over the shards, grouped per layer. Host peak is
-            the full tree for HF dirs read this way — acceptable (host
-            RAM >> HBM); the DEVICE peak is what this loader bounds."""
-            from safetensors import safe_open
-            name_map = _reverse_name_map(config)
+            """One pass over the shards (shared iterator), grouped per
+            layer. Host peak is the full tree for HF dirs read this way —
+            acceptable (host RAM >> HBM); the DEVICE peak is what this
+            loader bounds."""
             per_layer: dict[int, dict[str, np.ndarray]] = {}
             top: dict[str, np.ndarray] = {}
-            missing = set(name_map)
-            shards = sorted(f for f in os.listdir(ckpt_dir)
-                            if f.endswith(".safetensors"))
-            if not shards:
-                raise FileNotFoundError(f"no .safetensors in {ckpt_dir}")
-            for shard in shards:
-                with safe_open(os.path.join(ckpt_dir, shard),
-                               framework="numpy") as f:
-                    for name in f.keys():
-                        entry = name_map.get(name)
-                        if entry is None:
-                            continue
-                        path, layer, _expert, transpose = entry
-                        t = f.get_tensor(name)
-                        if transpose:
-                            t = np.ascontiguousarray(t.T)
-                        if layer is None:
-                            top[path[-1]] = t
-                        else:
-                            per_layer.setdefault(layer, {})[path[-1]] = t
-                        missing.discard(name)
-            if missing:
-                raise KeyError(
-                    f"checkpoint {ckpt_dir} is missing {len(missing)} "
-                    f"tensor(s), e.g. {sorted(missing)[:3]}")
+            for path, layer, _expert, t in _iter_hf_tensors(ckpt_dir,
+                                                            config):
+                if layer is None:
+                    top[path[-1]] = t
+                else:
+                    per_layer.setdefault(layer, {})[path[-1]] = t
             return per_layer, top
 
         _layers_np, _top_np = _read_all()
@@ -491,7 +496,11 @@ def load_checkpoint_quantized(ckpt_dir: str,
         "final_norm": jnp.asarray(top["final_norm"], dtype),
     }
     if not config.tie_embeddings:
-        params["lm_head"] = quantize(jnp.asarray(top["lm_head"], dtype))
+        # Host-side too: a device quantize of the 8B lm_head would spike
+        # ~3 GB of bf16-upload + f32 temp on a chip already holding the
+        # int8 tree (the same spike removed from synth.py's quote head).
+        q, s = host_quant(top["lm_head"])
+        params["lm_head"] = QTensor(q=jnp.asarray(q), s=jnp.asarray(s))
     jax.block_until_ready(params)
     del host_params
     log.info("loaded %s quantized+fused (streaming, single-chip): "

@@ -579,12 +579,15 @@ class DHTNode:
         return out
 
     def _iterate(self, target: int,
-                 query: Callable[[Contact], tuple[Optional[SignedRecord],
-                                                  list[Contact]]],
+                 query: Callable[[Contact],
+                                 Optional[tuple[Optional[SignedRecord],
+                                                list[Contact]]]],
                  ) -> tuple[Optional[SignedRecord], list[Contact]]:
         """Shared iterative-lookup core: keep querying the alpha closest
         unqueried candidates until the k closest are all queried or a value
-        surfaces. Returns (best_record_or_None, k closest live contacts)."""
+        surfaces. ``query`` returns None when the peer gave NO answer (the
+        suspect/eviction path) vs ``(record_or_None, contacts)`` for any
+        answer. Returns (best_record_or_None, k closest live contacts)."""
         shortlist: dict[str, Contact] = {
             c.peer_id: c for c in self.table.closest(target, self.k)}
         queried: set[str] = set()

@@ -207,6 +207,8 @@ def build_engine_from_env() -> Backend:
     runtime and shards the model over the hybrid dp-over-DCN mesh;
     process 0 serves HTTP, the rest mirror its programs
     (serve/multihost.py — api.main() dispatches follower_loop)."""
+    from ..utils.jax_cache import enable_persistent_cache
+    enable_persistent_cache()   # 8B warmup: ~18 min cold -> cache reads
     coord = env_or("SERVE_COORDINATOR", "") or None
     if coord or env_or("JAX_COORDINATOR", ""):
         from .multihost import build_multihost_engine
@@ -300,11 +302,18 @@ def build_engine_from_env() -> Backend:
             # so the bf16 model never touches the chip (what fits an 8B
             # checkpoint on one 16 GB v5e). Dense-llama only; MoE falls
             # through to the standard paths.
-            from ..models.weights import load_checkpoint_quantized
+            from ..models.weights import (
+                UnsupportedForQuantizedLoad,
+                load_checkpoint_quantized,
+            )
             try:
                 params, config = load_checkpoint_quantized(path)
                 already_quantized = True
-            except ValueError:
+            except UnsupportedForQuantizedLoad:
+                # Family out of scope (MoE etc.) — standard paths below.
+                # Real load errors (corrupt shards) must PROPAGATE: the
+                # fallback would re-materialise the bf16 tree and OOM big
+                # models with a misleading error.
                 params = None
         else:
             params = None

@@ -842,6 +842,15 @@ class BatchScheduler:
         # readback the first real request queues behind all of them.
         steps.append(lambda: np.asarray(self._cache.lengths[:1]))
 
+        def _warmup_finished():
+            # Admission deadlines guard CAPACITY, not boot: requests that
+            # arrive while warmup still compiles (an 8B boot is minutes of
+            # compiles even with the persistent cache) start their
+            # deadline clock here, not at arrival (see _expired).
+            self._warmup_done_at = time.monotonic()
+        self._warmup_done_at = None
+        steps.append(_warmup_finished)
+
         jobs = [_WarmupJob(fn) for fn in steps]
         for j in jobs:
             self._admit_q.put(j)
@@ -1251,7 +1260,10 @@ class BatchScheduler:
         reached a row; the client has almost certainly given up)."""
         if self.queue_timeout_s is None:
             return False
-        age = time.monotonic() - slot.req.arrival_time
+        done_at = getattr(self, "_warmup_done_at", 0.0)
+        if done_at is None:
+            return False          # warmup still compiling: boot, not load
+        age = time.monotonic() - max(slot.req.arrival_time, done_at)
         if age <= self.queue_timeout_s:
             return False
         log.warning("request waited %.1fs for admission (deadline %.1fs); "

@@ -155,8 +155,17 @@ class HttpServer:
             def log_message(self, fmt: str, *args: Any) -> None:
                 log.debug("%s %s", self.address_string(), fmt % args)
 
-        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
-        self._httpd.daemon_threads = True
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # The socketserver default backlog (5) RESETS most of a
+            # 32-peer simultaneous suggestion burst before accept() ever
+            # sees it — observed as "(LLM unavailable: Connection reset
+            # by peer)" at every UI when 8B decode holds connections open
+            # for seconds. One co-pilot burst = one connection per peer,
+            # so size the backlog to hundreds of peers.
+            request_queue_size = 256
+
+        self._httpd = _Server((host, int(port)), _Handler)
         self._thread: Optional[threading.Thread] = None
 
     @property

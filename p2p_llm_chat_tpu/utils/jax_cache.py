@@ -1,0 +1,45 @@
+"""Persistent XLA compilation cache for the serving/bench planes.
+
+An 8B serve boot compiles ~10 programs (admit chunk-sizes x buckets,
+decode windows, prefix splices); through the tunnel's remote compiler
+that measured ~18 minutes of warmup on a cold process. The JAX
+persistent cache keys compiled executables by HLO fingerprint on local
+disk, so every boot after the first reuses them — warmup drops to cache
+reads. Tests set their own cache (tests/conftest.py); this helper covers
+the production entrypoints (serve engine, bench, launcher children).
+
+``JAX_CACHE_DIR`` overrides the location; ``0``/``off`` disables.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .env import env_or
+from .log import get_logger
+
+log = get_logger("jax_cache")
+
+_DEFAULT = "~/.cache/p2pchat-jax"
+_enabled = False
+
+
+def enable_persistent_cache() -> None:
+    """Idempotent; call before the first jit compilation."""
+    global _enabled
+    if _enabled:
+        return
+    raw = env_or("JAX_CACHE_DIR", _DEFAULT)
+    if raw.lower() in ("0", "off", ""):
+        return
+    path = os.path.abspath(os.path.expanduser(raw))
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _enabled = True
+        log.info("persistent compile cache at %s", path)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        log.warning("compile cache disabled (%s)", e)

@@ -122,8 +122,12 @@ def main() -> int:
             shutil.rmtree(os.path.dirname(addr_file), ignore_errors=True)
             print(f"  relay multiaddr: {relay_addrs}")
         wait_http(f"{dir_url}/healthz")
-        wait_http(f"{serve_url}/healthz",
-                  timeout=300 if args.backend != "fake" else 30)
+        # Big-model TPU boots (8B checkpoint restore + streamed int8
+        # quantize + warmup compile) legitimately take many minutes;
+        # SERVE_WAIT_S widens the readiness budget.
+        serve_wait = float(os.environ.get(
+            "SERVE_WAIT_S", "300" if args.backend != "fake" else "30"))
+        wait_http(f"{serve_url}/healthz", timeout=serve_wait)
 
         dht_seed = ""
         for i, user in enumerate(users):
@@ -142,7 +146,9 @@ def main() -> int:
                 # outage out of the box (node.py lookup ladder rung 3).
                 node_env["DHT_BOOTSTRAP"] = dht_seed
             spawn(f"node-{user}", "p2p_llm_chat_tpu.node", node_env, procs)
-            wait_http(f"http://127.0.0.1:{node_port}/healthz")
+            # 60 s: a loaded host (32-node boots alongside a TPU serve)
+            # can starve a fresh interpreter's startup past 30 s.
+            wait_http(f"http://127.0.0.1:{node_port}/healthz", timeout=60)
             if not dht_seed:
                 try:
                     with urllib.request.urlopen(

@@ -39,6 +39,10 @@ _cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "..", ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_cache_dir))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# Keep the production cache helper (utils/jax_cache.py) pointed at the
+# SAME dir: in-process engine builds call it, and it must not re-point
+# the cache away from the test cache mid-run.
+os.environ.setdefault("JAX_CACHE_DIR", os.path.abspath(_cache_dir))
 # XLA:CPU's async dispatch runs eager ops on a background thread; with
 # the serving suites' heavy buffer donation it has produced sporadic
 # heap-corruption segfaults in long multi-suite processes (three crash

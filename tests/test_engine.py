@@ -333,6 +333,36 @@ def test_queue_timeout_fails_overdue_request():
         eng.stop()
 
 
+def test_queue_timeout_guards_capacity_not_boot():
+    """The admission deadline must not fire while warmup is still
+    compiling (an 8B boot is minutes of compiles): a request that
+    arrives mid-warmup starts its deadline clock at warmup COMPLETION,
+    and while warmup is in progress nothing expires at all."""
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=128,
+                    queue_timeout_s=5.0)
+    try:
+        import queue as queue_mod
+
+        sched = eng.scheduler
+        from p2p_llm_chat_tpu.serve.scheduler import _Slot
+
+        overdue = GenerateRequest(
+            prompt="x", arrival_time=time.monotonic() - 100,
+            options=GenerateOptions(max_tokens=1))
+        slot = _Slot(overdue, RequestStats(), queue_mod.Queue(), seed=0)
+        # Warmup in progress: never expired.
+        sched._warmup_done_at = None
+        assert not sched._expired(slot)
+        # Warmup JUST finished: the clock starts now, not at arrival.
+        sched._warmup_done_at = time.monotonic()
+        assert not sched._expired(slot)
+        # Warmup finished long ago: the capacity deadline applies again.
+        sched._warmup_done_at = time.monotonic() - 50
+        assert sched._expired(slot)
+    finally:
+        eng.stop()
+
+
 def test_moe_family_serves_through_same_scheduler():
     """tiny-moe through the continuous-batching loop must match a solo
     mixtral prefill+decode oracle — the scheduler dispatches the model

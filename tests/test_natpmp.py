@@ -70,7 +70,7 @@ class FakeGateway:
                 resp += socket.inet_aton(self.external_ip)
                 self.sock.sendto(resp, src)
             elif op in (1, 2) and len(data) >= 12:
-                _, _, _, iport, eport, lifetime = struct.unpack("!BBHHHI", data)
+                _, _, _, iport, eport, lifetime = struct.unpack_from("!BBHHHI", data)
                 if lifetime == 0:            # delete (§3.4)
                     self.mappings.pop((op, iport), None)
                     granted_e, granted_l = 0, 0

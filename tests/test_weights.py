@@ -209,7 +209,9 @@ def test_load_checkpoint_quantized_hf_matches_quantize_then_fuse(tmp_path):
     model, cfg = _tiny_llama()
     ckpt = _write_ckpt(tmp_path, model)
     got, got_cfg = load_checkpoint_quantized(ckpt)
-    assert got_cfg.name == cfg.name or got_cfg.hidden_size == cfg.hidden_size
+    for f in ("vocab_size", "hidden_size", "intermediate_size",
+              "num_layers", "num_heads", "num_kv_heads", "tie_embeddings"):
+        assert getattr(got_cfg, f) == getattr(cfg, f), f
 
     base, _ = load_checkpoint(ckpt)         # bf16 (default dtype)
     want = llama.fuse_params(quantize_params(base))

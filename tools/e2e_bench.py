@@ -46,7 +46,17 @@ def post(url: str, body: dict, timeout: float = 120.0):
     req = urllib.request.Request(
         url, data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"})
-    return urllib.request.urlopen(req, timeout=timeout)
+    try:
+        return urllib.request.urlopen(req, timeout=timeout)
+    except urllib.error.HTTPError as e:
+        # Surface the error BODY (the per-request reason) and the
+        # launcher tail — a bare "HTTP 500" is undebuggable after the
+        # stack is torn down.
+        detail = e.read()[:500]
+        tail = b"".join(globals().get("_TAIL", []))[-1500:]
+        raise RuntimeError(
+            f"{url} -> HTTP {e.code}: {detail!r} (launcher tail: "
+            f"{tail!r})") from None
 
 
 def main() -> None:
@@ -81,6 +91,9 @@ def main() -> None:
         SERVE_QUANT="int8",
         SERVE_KV_QUANT="int8",
         SERVE_WARMUP="64,128,256",
+        # 8B-scale checkpoint boots (16 GB restore + streamed int8 +
+        # warmup compiles) take ~10 min; the launcher waits this long.
+        SERVE_WAIT_S="1800",
         # PREPEND to PYTHONPATH: clobbering it drops /root/.axon_site,
         # where the axon TPU PJRT plugin lives, and the serve subprocess
         # silently loses the chip.
@@ -89,23 +102,41 @@ def main() -> None:
     if args.workload == "quote":
         # Build the quote checkpoint in a CPU subprocess (importing jax
         # HERE would grab the axon TPU tunnel away from the serve).
-        ckpt_dir = tempfile.mkdtemp(prefix="e2e_quote_")
-        build = (
-            "import os\n"
-            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
-            "import jax\n"
-            "jax.config.update('jax_platforms', 'cpu')\n"
-            "import jax.numpy as jnp\n"
-            "from p2p_llm_chat_tpu.models.synth import quote_params\n"
-            "from p2p_llm_chat_tpu.models.configs import get_config\n"
-            "from p2p_llm_chat_tpu.models.checkpoint import save_checkpoint\n"
-            f"cfg = get_config({args.config!r})\n"
-            "params = quote_params(cfg, jax.random.PRNGKey(0), "
-            "dtype=jnp.bfloat16)\n"
-            f"save_checkpoint({ckpt_dir!r}, params, cfg)\n")
-        subprocess.run([sys.executable, "-c", build], env=env, check=True)
-        env["CKPT_DIR"] = ckpt_dir
-        env["LLM_MODEL"] = args.config
+        # E2E_CKPT_DIR reuses a previous build — at 8B dims the build +
+        # save is ~16 GB and ~15 minutes, far too slow to repeat per run.
+        cache = os.environ.get("E2E_CKPT_DIR", "")
+        meta_path = os.path.join(cache, "native_meta.json") if cache else ""
+        cached_cfg = None
+        if meta_path and os.path.exists(meta_path):
+            with open(meta_path) as f:
+                cached_cfg = json.load(f).get("config")
+        if cached_cfg == args.config:
+            env["CKPT_DIR"] = cache
+            env["LLM_MODEL"] = args.config
+            ckpt_dir = None
+        else:
+            if cached_cfg is not None:
+                print(f"E2E_CKPT_DIR holds {cached_cfg!r}, need "
+                      f"{args.config!r}; rebuilding")
+            ckpt_dir = cache or tempfile.mkdtemp(prefix="e2e_quote_")
+            os.makedirs(ckpt_dir, exist_ok=True)
+        if ckpt_dir is not None:
+            build = (
+                "import os\n"
+                "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+                "import jax\n"
+                "jax.config.update('jax_platforms', 'cpu')\n"
+                "import jax.numpy as jnp\n"
+                "from p2p_llm_chat_tpu.models.synth import quote_params\n"
+                "from p2p_llm_chat_tpu.models.configs import get_config\n"
+                "from p2p_llm_chat_tpu.models.checkpoint import save_checkpoint\n"
+                f"cfg = get_config({args.config!r})\n"
+                "params = quote_params(cfg, jax.random.PRNGKey(0), "
+                "dtype=jnp.bfloat16)\n"
+                f"save_checkpoint({ckpt_dir!r}, params, cfg)\n")
+            subprocess.run([sys.executable, "-c", build], env=env, check=True)
+            env["CKPT_DIR"] = ckpt_dir
+            env["LLM_MODEL"] = args.config
 
     launcher = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "start_all.py"),
@@ -130,19 +161,19 @@ def main() -> None:
         # The launcher boots the serve front FIRST (model init + warmup on
         # the chip can take minutes) and only then the nodes/UIs.
         wait_http(f"http://127.0.0.1:{args.serve_port}/api/tags",
-                  deadline_s=600.0)
+                  deadline_s=1800.0)   # 8B checkpoint boots take ~10 min
         for i in range(n):
             wait_http(f"http://127.0.0.1:{args.node_base + i}/healthz")
             wait_http(f"http://127.0.0.1:{args.ui_base + i}/")
         post(f"http://127.0.0.1:{args.serve_port}/api/generate",
              {"model": args.config, "prompt": "warm", "stream": False,
-              "options": {"num_predict": 4}}, timeout=240).read()
+              "options": {"num_predict": 4}}, timeout=900).read()
         # Practice suggestion through one UI: compiles any admission/
         # decode program the warmup ladder missed, so the measured burst
         # sees the steady-state TTFT (bench.py does the same).
         post(f"http://127.0.0.1:{args.ui_base}/api/suggest",
              {"content": "warmup message, please ignore"},
-             timeout=240).read()
+             timeout=900).read()
 
         # Each peer i sends a message to peer (i+1) % n over the real
         # node path; the recipient's UI then has an inbox message to

@@ -19,7 +19,7 @@ while True:
         sock.sendto(struct.pack("!BBHI", 0, 128, 0, epoch)
                     + socket.inet_aton("198.51.100.42"), src)
     elif op in (1, 2) and len(data) >= 12:
-        _, _, _, iport, eport, lifetime = struct.unpack("!BBHHHI", data)
+        _, _, _, iport, eport, lifetime = struct.unpack_from("!BBHHHI", data)
         if lifetime == 0:
             mappings.pop((op, iport), None)
             ge, gl = 0, 0

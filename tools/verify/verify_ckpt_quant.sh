@@ -7,6 +7,7 @@ cd /root/repo
 mkdir -p /tmp/v
 
 fail() { echo "FAIL: $1"; exit 1; }
+trap 'kill "$(cat /tmp/v/serve_q.pid 2>/dev/null)" 2>/dev/null; true' EXIT
 
 CKPT=/tmp/v/ckpt_tiny
 rm -rf "$CKPT"

@@ -6,6 +6,7 @@ mkdir -p /tmp/v  # scratch for logs/pids
 rm -f /tmp/v/*.log /tmp/v/*.pid
 
 fail() { echo "FAIL: $1"; exit 1; }
+trap 'kill "$(cat /tmp/v/dir.pid 2>/dev/null)" 2>/dev/null; kill "$(cat /tmp/v/a.pid 2>/dev/null)" 2>/dev/null; kill "$(cat /tmp/v/b.pid 2>/dev/null)" 2>/dev/null; kill "$(cat /tmp/v/c.pid 2>/dev/null)" 2>/dev/null; true' EXIT
 
 ADDR=127.0.0.1:18080 python -m p2p_llm_chat_tpu.directory >/tmp/v/dir.log 2>&1 &
 echo $! > /tmp/v/dir.pid

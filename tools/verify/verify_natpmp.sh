@@ -5,6 +5,7 @@ cd /root/repo
 mkdir -p /tmp/v  # scratch for logs/pids
 
 fail() { echo "FAIL: $1"; exit 1; }
+trap 'kill "$(cat /tmp/v/gw.pid 2>/dev/null)" 2>/dev/null; kill "$(cat /tmp/v/dir2.pid 2>/dev/null)" 2>/dev/null; kill "$(cat /tmp/v/n.pid 2>/dev/null)" 2>/dev/null; true' EXIT
 
 python "$(dirname "$0")/fake_gw.py" 18351 >/tmp/v/gw.log 2>&1 &
 echo $! > /tmp/v/gw.pid

@@ -6,6 +6,7 @@ cd /root/repo
 mkdir -p /tmp/v  # scratch for logs/pids
 
 fail() { echo "FAIL: $1"; exit 1; }
+trap 'kill "$(cat /tmp/v/serve.pid 2>/dev/null)" 2>/dev/null; true' EXIT
 
 SERVE_ADDR=127.0.0.1:18411 SERVE_BACKEND=tpu MODEL_CONFIG=tiny \
   SERVE_KV=paged SERVE_QUANT=int8 SERVE_SPEC=3 \
