@@ -101,6 +101,43 @@ def collect(backend: Backend, req: GenerateRequest,
     return "".join(backend.generate_stream(req, stats))
 
 
+def normalize_request(tokenizer, vocab_size: int, max_seq: int,
+                      req: GenerateRequest,
+                      min_bucket: int = 16) -> tuple[list, int, int]:
+    """Shared admission normalization for every serving engine — the
+    Ollama request contract in one place so the single-host scheduler and
+    the multihost lockstep front cannot drift (they once did: num_predict
+    <= 0 and the num_ctx floor diverged between the two copies).
+
+    - ``context`` ids are untrusted client input: out-of-vocab raises
+      ValueError (callers map it to a per-request failure, never batch
+      corruption). They prepend verbatim — they already carry their own
+      BOS — and the new prompt follows without a second BOS.
+    - Ollama ``num_ctx`` caps this request's context below the server
+      max; truncation keeps the prompt TAIL (recent context wins, the
+      same direction Ollama truncates).
+    - Ollama ``num_predict <= 0`` means "until EOS / context full", not
+      "almost nothing".
+
+    Returns (ids, max_new, ctx_limit).
+    """
+    ctx = [int(t) for t in req.context]
+    if ctx and not all(0 <= t < vocab_size for t in ctx):
+        raise ValueError("context contains token ids outside the model's "
+                         f"vocabulary (size {vocab_size})")
+    ids = ctx + tokenizer.encode(req.prompt, add_bos=not ctx)
+    ctx_limit = max_seq
+    opts = req.options
+    if opts.num_ctx > 0:
+        ctx_limit = max(min_bucket, min(ctx_limit, opts.num_ctx))
+    max_prompt = ctx_limit - 2
+    if len(ids) > max_prompt:
+        ids = ids[-max_prompt:]
+    budget = ctx_limit - 1 - len(ids)
+    want = opts.max_tokens if opts.max_tokens > 0 else budget
+    return ids, max(1, min(want, budget)), ctx_limit
+
+
 class FakeLLM:
     """Canned-response backend.
 

@@ -59,7 +59,7 @@ from ..models.llama import KVCache
 from ..models.sampling import sample_batched
 from ..tokenizer import Tokenizer
 from ..utils.log import get_logger
-from .backend import GenerateRequest, RequestStats
+from .backend import GenerateRequest, RequestStats, normalize_request
 from .prefix import PrefixEntry, PrefixStore
 
 log = get_logger("serve.scheduler")
@@ -1183,36 +1183,21 @@ class BatchScheduler:
             if self._expired(slot):
                 continue
             opts = slot.req.options
-            # Ollama "context": prior-exchange ids are prepended verbatim
-            # (they already carry their own BOS), the new prompt follows
-            # without a second BOS. Ids are untrusted client input: an
-            # out-of-vocab id must fail THIS request cleanly, not corrupt
-            # logits (XLA clamps silently) or blow up the whole admission
-            # chunk it gets batched into.
-            ctx = [int(t) for t in slot.req.context]
-            if ctx and not all(0 <= t < self.config.vocab_size
-                               for t in ctx):
-                slot.fail("context contains token ids outside the model's "
-                          f"vocabulary (size {self.config.vocab_size})")
-                continue
-            ids = ctx + self.tokenizer.encode(slot.req.prompt,
-                                              add_bos=not ctx)
-            # Context budget: keep the prompt tail (recent context wins, the
-            # same truncation direction Ollama applies), leave room to
-            # generate. Ollama num_ctx caps a request below the server max.
+            # Shared Ollama admission contract (context prepend/BOS rules,
+            # num_ctx clamp, tail truncation, num_predict<=0 semantics) —
+            # backend.normalize_request, one copy for every engine. An
+            # out-of-vocab context id must fail THIS request cleanly, not
+            # corrupt logits (XLA clamps silently) or blow up the whole
+            # admission chunk it gets batched into.
             # (NB: must not shadow ``limit`` — doing so once made a >limit
             # burst over-collect past the free rows and crash admission.)
-            ctx_limit = self.max_seq
-            if opts.num_ctx > 0:
-                ctx_limit = max(_MIN_BUCKET, min(ctx_limit, opts.num_ctx))
-            max_prompt = ctx_limit - 2
-            if len(ids) > max_prompt:
-                ids = ids[-max_prompt:]
-            budget = ctx_limit - 1 - len(ids)
-            # Ollama semantics: num_predict <= 0 means "until EOS / context
-            # full", not "almost nothing".
-            want = opts.max_tokens if opts.max_tokens > 0 else budget
-            slot.max_new = max(1, min(want, budget))
+            try:
+                ids, slot.max_new, ctx_limit = normalize_request(
+                    self.tokenizer, self.config.vocab_size, self.max_seq,
+                    slot.req, min_bucket=_MIN_BUCKET)
+            except ValueError as e:
+                slot.fail(str(e))
+                continue
             slot.prompt_ids = ids
             slot.ctx_budget = ctx_limit
             if slot.stats is not None:

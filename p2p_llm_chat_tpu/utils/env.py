@@ -28,6 +28,13 @@ def env_int(key: str, default: int) -> int:
     return int(v)
 
 
+def env_float(key: str, default: float) -> float:
+    v = os.environ.get(key, "")
+    if v == "":
+        return default
+    return float(v)
+
+
 def env_bool(key: str, default: bool = False) -> bool:
     v = os.environ.get(key, "").strip().lower()
     if v == "":
