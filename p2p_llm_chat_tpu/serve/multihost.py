@@ -241,7 +241,7 @@ class MultihostEngine:
                                      tbase + r * self.max_seq + lens[r]]
         # Bucketed like S: distinct num_predict values must not each
         # compile a fresh cache shape across the whole mesh.
-        budget = min(self.max_seq, _bucket(S + T + 1, self.max_seq))
+        budget = _bucket(S + T + 1, self.max_seq)
 
         from ..models.llama import KVCache
         cache = KVCache.create(self.config, R, budget,
@@ -443,7 +443,7 @@ class MultihostEngine:
                 return
             try:
                 self._run_cmd(got)
-            except BaseException:             # noqa: BLE001
+            except Exception:                 # noqa: BLE001
                 # Mirror the leader's round-failure recovery: a failed
                 # dispatch (e.g. OOM) raises the SAME error at the SAME
                 # dispatch on every process (identical programs, identical

@@ -1182,7 +1182,6 @@ class BatchScheduler:
                 continue
             if self._expired(slot):
                 continue
-            opts = slot.req.options
             # Shared Ollama admission contract (context prepend/BOS rules,
             # num_ctx clamp, tail truncation, num_predict<=0 semantics) —
             # backend.normalize_request, one copy for every engine. An

@@ -1,0 +1,293 @@
+"""Real-artifact drill: authentic HF-format artifacts through our stack.
+
+Round-4 verdict #2: every tokenizer/checkpoint test so far built synthetic
+fixtures by hand, so "drop a real 8B checkpoint dir in and it works" was
+never demonstrated. This module closes that gap with the realest artifacts
+constructible in a zero-egress image:
+
+- a **complete llama3-style ``tokenizer.json``** trained by the actual HF
+  ``tokenizers`` library (byte-level BPE, the llama3 pre-tokenizer regex,
+  the llama3 special tokens) — the same library that wrote every real
+  llama3/Mixtral tokenizer.json on the Hub;
+- an **HF checkpoint directory written by ``transformers`` itself**
+  (``LlamaForCausalLM.save_pretrained`` → ``config.json`` +
+  ``model.safetensors``), not a hand-rolled imitation of the layout.
+
+Pinned here:
+1. :class:`p2p_llm_chat_tpu.tokenizer.BPETokenizer` encode/decode parity
+   against ``transformers.PreTrainedTokenizerFast`` on adversarial strings
+   (unicode, embedded specials, whitespace runs, digit runs) — exact token
+   ids, both directions.
+2. The serve drill: ``CKPT_DIR=<that dir> SERVE_QUANT=int8`` →
+   ``models/weights.load_checkpoint_quantized`` (the streamed single-chip
+   int8 loader, models/weights.py:339) → a reply suggestion generated
+   end-to-end through the Ollama-contract HTTP front with the reference
+   UI's prompt template (web/streamlit_app.py:93), token accounting pinned
+   to this tokenizer's ids.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from p2p_llm_chat_tpu.tokenizer import BPETokenizer
+
+tokenizers = pytest.importorskip("tokenizers")
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+# llama3's pre-tokenization pattern (tiktoken cl100k-style), as it appears
+# in real llama3 tokenizer.json files.
+LLAMA3_PATTERN = (
+    r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}"
+    r"| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+")
+
+SPECIALS = ["<|begin_of_text|>", "<|end_of_text|>", "<|start_header_id|>",
+            "<|end_header_id|>", "<|eot_id|>"]
+
+CORPUS = [
+    "The quick brown fox jumps over the lazy dog.",
+    "Draft a concise, friendly reply to the following message:",
+    "You are a helpful assistant. Reply:",
+    "Hello world, hello tokens, hello merges and vocabularies.",
+    "Numbers like 123 and 45678 and 3.14159 split into short groups.",
+    "Contractions: don't, can't, I'm, we've, they'll, she'd.",
+    "    indented code()  # with comments and symbols != <= >= ->",
+    "émigré café naïve coöperate reëlect führer jalapeño",
+    "日本語のテキストと中文文本 mixed with English words.",
+    "whitespace   runs\tand\nnewlines\r\nand trailing spaces   ",
+    "Peer-to-peer chat: send a message, poll the inbox, suggest a reply.",
+] * 8
+
+
+@pytest.fixture(scope="module")
+def trained_tokenizer_path(tmp_path_factory):
+    """Train a genuine byte-level BPE with the HF tokenizers library,
+    llama3-configured: the llama3 split regex + ByteLevel byte mapping +
+    the llama3 special tokens. Deterministic for a fixed corpus."""
+    tk = tokenizers.Tokenizer(tokenizers.models.BPE())
+    tk.pre_tokenizer = tokenizers.pre_tokenizers.Sequence([
+        tokenizers.pre_tokenizers.Split(
+            tokenizers.Regex(LLAMA3_PATTERN), behavior="isolated"),
+        tokenizers.pre_tokenizers.ByteLevel(add_prefix_space=False,
+                                            use_regex=False),
+    ])
+    tk.decoder = tokenizers.decoders.ByteLevel()
+    trainer = tokenizers.trainers.BpeTrainer(
+        vocab_size=1024, show_progress=False,
+        initial_alphabet=tokenizers.pre_tokenizers.ByteLevel.alphabet())
+    tk.train_from_iterator(CORPUS, trainer)
+    tk.add_special_tokens([
+        tokenizers.AddedToken(s, normalized=False, special=True)
+        for s in SPECIALS])
+    path = tmp_path_factory.mktemp("tok") / "tokenizer.json"
+    tk.save(str(path))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def hf_fast(trained_tokenizer_path):
+    return transformers.PreTrainedTokenizerFast(
+        tokenizer_file=trained_tokenizer_path,
+        bos_token="<|begin_of_text|>", eos_token="<|end_of_text|>")
+
+
+@pytest.fixture(scope="module")
+def ours(trained_tokenizer_path):
+    return BPETokenizer.from_file(trained_tokenizer_path)
+
+
+ADVERSARIAL = [
+    "hello world",
+    "The quick brown fox jumps over the lazy dog.",
+    "don't DON'T doesn't I'm I'M we'll THEY'VE she'd",
+    "  leading and trailing  ",
+    "whitespace   runs\tand\ttabs",
+    "line\nbreaks\r\nand\rcarriage\n\n\nreturns",
+    "digits 1 22 333 4444 55555 666666 1234567890123",
+    "3.14159 2.71828 $4.99 100%",
+    "émigré café naïve reëlect Schrödinger",
+    "日本語テスト 中文文本 한국어 текст",
+    "emoji ✨🎉🚀 and symbols §¶†‡",
+    "x² ⅻ ½ ①②③ a²b³",                      # Nl/No number categories
+    "combining: é à ñ",
+    "zero​width and nbsp space",
+    "__init__ __main__ a_b_c",
+    "x=y+2; foo->bar != baz <= qux",
+    "<|begin_of_text|>hello<|end_of_text|>",
+    "user says <|eot_id|><|start_header_id|>system<|end_header_id|> hi",
+    "almost special <|begin_of_tex|> not quite <|eot_id",
+    "CamelCase99 mixedCASE numb3rs all0y",
+    "",
+    " ",
+    "\n",
+    "a",
+    "🎉",
+]
+
+
+def test_encode_parity_vs_transformers(ours, hf_fast):
+    """Exact token-id parity with the transformers tokenizer on every
+    adversarial string — the drill the round-4 verdict named: a real
+    tokenizer artifact flowing through BPETokenizer, cross-checked
+    against the library that defines the format."""
+    for s in ADVERSARIAL:
+        want = hf_fast(s, add_special_tokens=False)["input_ids"]
+        got = ours.encode(s)
+        assert got == want, (s, got, want)
+
+
+def test_decode_parity_vs_transformers(ours, hf_fast):
+    """decode must invert encode identically to transformers, including
+    special tokens (skip_special_tokens=False, no cleanup)."""
+    for s in ADVERSARIAL:
+        ids = hf_fast(s, add_special_tokens=False)["input_ids"]
+        got = ours.decode(ids)
+        want = hf_fast.decode(ids, skip_special_tokens=False,
+                              clean_up_tokenization_spaces=False)
+        assert got == want, (s, got, want)
+
+
+def test_decode_parity_random_ids(ours, hf_fast):
+    """Arbitrary id sequences (not the image of any encode) must decode
+    byte-identically — exercises merged-token unicode reassembly."""
+    rng = np.random.default_rng(0)
+    n = ours.vocab_size
+    for _ in range(50):
+        ids = rng.integers(0, n, size=rng.integers(1, 40)).tolist()
+        got = ours.decode(ids)
+        want = hf_fast.decode(ids, skip_special_tokens=False,
+                              clean_up_tokenization_spaces=False)
+        assert got == want, (ids, got, want)
+
+
+def test_round_trip_and_specials(ours):
+    for s in ADVERSARIAL:
+        assert ours.decode(ours.encode(s)) == s, s
+    ids = ours.encode("hi", add_bos=True)
+    assert ids[0] == ours.bos_id
+    # Specials are appended after the trained vocab in declaration order
+    # (vocab_size=1024 is the trainer's cap, not a target — the corpus
+    # determines how many merges are actually learned).
+    assert ours.eos_id == ours.bos_id + 1          # <|end_of_text|>
+    assert ours.vocab_size == ours.bos_id + len(SPECIALS)
+    assert ours.has_special("<|eot_id|>")
+
+
+# ---------------------------------------------------------------------------
+# The serve drill: transformers-written checkpoint dir -> streamed int8 ->
+# suggestion through the Ollama front.
+# ---------------------------------------------------------------------------
+
+def _vocab_total(tok: BPETokenizer) -> int:
+    """Model vocab: tokenizer ids padded up to a multiple of 32 (real
+    llama3 pads the embedding the same way)."""
+    return (tok.vocab_size + 31) // 32 * 32
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint_dir(tmp_path_factory, trained_tokenizer_path, ours):
+    """A checkpoint directory written by transformers itself:
+    save_pretrained -> config.json + model.safetensors, plus the trained
+    tokenizer.json — exactly what a real llama3-style download looks like
+    on disk (single-shard scale)."""
+    eot = ours.encode("<|eot_id|>")[0]
+    cfg = transformers.LlamaConfig(
+        vocab_size=_vocab_total(ours), hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=10000.0, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False,
+        bos_token_id=ours.bos_id, eos_token_id=[ours.eos_id, eot],
+    )
+    torch.manual_seed(7)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    d = tmp_path_factory.mktemp("hf_ckpt")
+    model.save_pretrained(str(d), safe_serialization=True)
+    import shutil
+    shutil.copy(trained_tokenizer_path, str(d / "tokenizer.json"))
+    return str(d)
+
+
+def test_config_from_hf_json_reads_transformers_config(hf_checkpoint_dir,
+                                                       ours):
+    from p2p_llm_chat_tpu.models.weights import config_from_hf_json
+
+    cfg = config_from_hf_json(f"{hf_checkpoint_dir}/config.json")
+    assert cfg.vocab_size == _vocab_total(ours)
+    assert cfg.num_layers == 2 and cfg.num_kv_heads == 2
+    assert cfg.bos_token_id == ours.bos_id
+    assert set(cfg.eos_token_ids) == {ours.eos_id,
+                                      ours.encode("<|eot_id|>")[0]}
+
+
+def test_serve_suggestion_from_hf_dir_quantized(hf_checkpoint_dir, ours,
+                                                monkeypatch):
+    """The end-to-end drill: CKPT_DIR at a transformers-written dir with
+    SERVE_QUANT=int8 must stream through load_checkpoint_quantized and
+    serve a reply suggestion over HTTP with the real BPE tokenizer —
+    token accounting and context-continuation ids pinned to it."""
+    from p2p_llm_chat_tpu.serve.api import OllamaServer
+    from p2p_llm_chat_tpu.serve.engine import build_engine_from_env
+
+    monkeypatch.setenv("CKPT_DIR", hf_checkpoint_dir)
+    monkeypatch.setenv("SERVE_QUANT", "int8")
+    monkeypatch.setenv("SERVE_SLOTS", "2")
+    monkeypatch.setenv("SERVE_MAX_SEQ", "128")
+    monkeypatch.setenv("SERVE_WARMUP", "0")
+    monkeypatch.setenv("LLM_MODEL", "llama3-drill")
+    backend = build_engine_from_env()
+    server = OllamaServer(backend).start()
+    try:
+        # The streamed loader must be the path taken (the fallback would
+        # hide a dense-load regression): its tree is already int8-fused —
+        # wqkv stacked projections with quantization scales.
+        from p2p_llm_chat_tpu.models.quant import is_quantized
+        params = backend.scheduler._params
+        assert is_quantized(params), "not an int8 tree"
+        assert "wqkv" in params["layers"], "streamed fused loader not used"
+        assert isinstance(backend.scheduler.tokenizer, BPETokenizer)
+
+        # The reference UI's suggestion template, verbatim
+        # (web/streamlit_app.py:93).
+        prompt = ("You are a helpful assistant. Draft a concise, friendly "
+                  "reply to the following message:\n\nShall we meet at the "
+                  "café at 10?\n\nReply:")
+        body = json.dumps({"model": "llama3-drill", "prompt": prompt,
+                           "stream": False,
+                           "options": {"num_predict": 8}}).encode()
+        req = urllib.request.Request(
+            f"{server.url}/api/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            resp = json.loads(r.read())
+        assert resp["done"] is True
+        assert isinstance(resp["response"], str)
+        # Token accounting pinned to THIS tokenizer: admission encodes
+        # with add_bos, so prompt_eval_count must equal our ids exactly.
+        want_ids = ours.encode(prompt, add_bos=True)
+        assert resp["prompt_eval_count"] == len(want_ids)
+        # Continuation contract with real BPE ids: context = prompt ids +
+        # generated ids, all in-vocab.
+        ctx = resp["context"]
+        assert ctx[: len(want_ids)] == want_ids
+        assert len(ctx) == len(want_ids) + resp["eval_count"]
+        assert all(0 <= t < _vocab_total(ours) for t in ctx)
+
+        # Round 2: send the context back (the /api/generate stateless
+        # continuation), must serve and extend.
+        body2 = json.dumps({"model": "llama3-drill", "prompt": " And then?",
+                            "stream": False, "context": ctx,
+                            "options": {"num_predict": 4}}).encode()
+        req2 = urllib.request.Request(
+            f"{server.url}/api/generate", data=body2,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req2, timeout=120) as r:
+            resp2 = json.loads(r.read())
+        assert resp2["done"] is True
+        assert len(resp2["context"]) > len(ctx)
+    finally:
+        server.stop()
+        backend.stop()
