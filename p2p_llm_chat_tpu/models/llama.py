@@ -241,14 +241,22 @@ def fuse_params(params: dict, tp: int = 1, mesh: Optional[Mesh] = None,
                            s=icat([w.s for w in ws]))
         return icat(ws)
 
-    fuse_mlp = layers["w_gate"].ndim == 3   # dense [L,H,E]; the MoE
-    # family's 4-D per-expert ffn leaves stay separate (models/mixtral.py
-    # moe_mlp reads them by name; its attention still gains fused qkv).
-    drop = ("wq", "wk", "wv") + (("w_gate", "w_up") if fuse_mlp else ())
+    fuse_mlp = layers["w_gate"].ndim == 3   # dense [L,H,E]
+    # MoE 4-D per-expert ffn leaves fuse into "wgu_e" [L,NE,H,2F] on the
+    # single-chip path only (models/mixtral.moe_mlp runs gate+up as one
+    # batched einsum). Under a mesh they stay separate: the expert axis
+    # shards over ("ep","tp") and the ring path (parallel/ring.py
+    # moe_ring_mlp_fn) reads w_gate/w_up by name from its local shard.
+    fuse_moe = (not fuse_mlp and layers["w_gate"].ndim == 4
+                and tp == 1 and mesh is None)
+    drop = ("wq", "wk", "wv") + (("w_gate", "w_up")
+                                 if (fuse_mlp or fuse_moe) else ())
     fused = {k: v for k, v in layers.items() if k not in drop}
     fused["wqkv"] = cat([layers["wq"], layers["wk"], layers["wv"]])
     if fuse_mlp:
         fused["wgu"] = cat([layers["w_gate"], layers["w_up"]])
+    if fuse_moe:
+        fused["wgu_e"] = cat([layers["w_gate"], layers["w_up"]])
     if mesh is not None and tp > 1:
         from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -42,9 +42,10 @@ from .layers import DEFAULT_COMPUTE_DTYPE, causal_mask, length_mask
 from .quant import q_einsum
 from . import llama
 from .llama import KVCache  # same cache layout/contract as the dense family
-# Fused-qkv transform: the attention projections fuse exactly as the
-# dense family's do; the 4-D per-expert ffn leaves are left separate
-# (fuse_params checks w_gate.ndim).
+# Fused transform: attention projections fuse exactly as the dense
+# family's do; the 4-D per-expert ffn leaves fuse into "wgu_e" on the
+# single-chip path and stay separate under a mesh (fuse_params checks
+# w_gate.ndim / tp / mesh).
 from .llama import fuse_params  # noqa: F401  (re-export, serve scheduler)
 
 # Sentinel: "derive capacity from config.moe_capacity_factor".
@@ -87,6 +88,77 @@ def init_params(config: ModelConfig, key: jax.Array,
     return params
 
 
+def init_params_quantized(config: ModelConfig, key: jax.Array,
+                          dtype=DEFAULT_COMPUTE_DTYPE) -> dict:
+    """Random init streamed straight into the FUSED int8 tree — the MoE
+    twin of ``llama.init_params_quantized`` (same why: the bf16 tree
+    cannot exist on a single chip at big-model scale, the int8 one can).
+
+    Per layer, a donated write loop quantizes wqkv (attention fused),
+    wo, the per-expert fused ``wgu_e`` [NE,H,2F], and w_down [NE,F,H];
+    the router stays bf16 (tiny, and routing math is f32 anyway — HF
+    parity). ``fuse_params`` is a no-op on the result. Synthetic-bench /
+    random-init serving only — real checkpoints stream through
+    models/weights.load_checkpoint_quantized.
+    """
+    import functools
+
+    from .quant import QTensor, quantize
+
+    assert config.is_moe, "mixtral.init_params_quantized needs experts"
+    L, H, E = config.num_layers, config.hidden_size, config.intermediate_size
+    NE = config.num_experts
+    std = H ** -0.5
+    key, k_embed, k_head = jax.random.split(key, 3)
+
+    def normal(k, shape, scale=std, dt=dtype):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dt)
+
+    dims = {
+        "wqkv": (H, config.q_dim + 2 * config.kv_dim),
+        "wo": (config.q_dim, H),
+        "wgu_e": (NE, H, 2 * E),
+        "w_down": (NE, E, H),
+    }
+    layers: dict = {
+        "attn_norm": jnp.ones((L, H), dtype),
+        "mlp_norm": jnp.ones((L, H), dtype),
+    }
+    bufs = {name: QTensor(q=jnp.zeros((L, *shape), jnp.int8),
+                          s=jnp.zeros((L, *shape[:-2], 1, shape[-1]),
+                                      jnp.float32))
+            for name, shape in dims.items()}
+    router = jnp.zeros((L, H, NE), dtype)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def write_layer(bufs: dict, router: jax.Array, k: jax.Array,
+                    layer: jax.Array) -> tuple[dict, jax.Array]:
+        ks = jax.random.split(k, len(dims) + 1)
+        out = dict(bufs)
+        for i, (name, shape) in enumerate(dims.items()):
+            qt = quantize(normal(ks[i], shape))
+            out[name] = QTensor(q=bufs[name].q.at[layer].set(qt.q),
+                                s=bufs[name].s.at[layer].set(qt.s))
+        router2 = router.at[layer].set(normal(ks[-1], (H, NE)))
+        return out, router2
+
+    layer_keys = jax.random.split(key, L)
+    for li in range(L):
+        bufs, router = write_layer(bufs, router, layer_keys[li],
+                                   jnp.asarray(li))
+    layers.update(bufs)
+    layers["router"] = router
+
+    params = {
+        "embed": normal(k_embed, (config.vocab_size, H), scale=1.0),
+        "layers": layers,
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = quantize(normal(k_head, (H, config.vocab_size)))
+    return params
+
+
 def param_axes(config: ModelConfig) -> dict:
     """Logical-axis tree matching init_params. The expert-stacked FFN
     weights shard over "experts" -> ("ep","tp") (parallel/sharding.py), so
@@ -118,13 +190,22 @@ def moe_mlp(x: jax.Array, router: jax.Array, w_gate: jax.Array,
             w_up: jax.Array, w_down: jax.Array, num_experts_per_tok: int,
             mesh: Optional[Mesh] = None,
             rules: LogicalRules = DEFAULT_RULES,
-            capacity: Optional[int] = None) -> jax.Array:
+            capacity: Optional[int] = None,
+            w_gu: Optional[jax.Array] = None) -> jax.Array:
     """Sparse-MoE SwiGLU via scatter/gather dispatch into capacity buckets.
 
     x: [B,S,H]; router: [H,NE]; w_gate/w_up: [NE,H,F]; w_down: [NE,F,H].
     ``capacity`` is the per-expert bucket size C (None = T = exact).
     All memory is linear in tokens: the scatter index vector is [T*k] and
     the bucket array [NE*C, H]; the expert FFN is one batched MXU matmul.
+
+    ``w_gu`` ([NE,H,2F], gate|up columns concatenated — the expert twin
+    of llama.fuse_params' dense ``wgu``): when given, gate and up run as
+    ONE batched einsum and w_gate/w_up are ignored (may be None). Decode
+    is bandwidth-bound with a per-matmul fixed cost, so halving the
+    expert projection dispatches pays exactly like the dense fusion did
+    (BASELINE.md round-3 notes); per-output-channel int8 scales
+    concatenate with their columns, so the math is identical.
     """
     B, S, H = x.shape
     NE = router.shape[-1]
@@ -155,8 +236,14 @@ def moe_mlp(x: jax.Array, router: jax.Array, w_gate: jax.Array,
     xin = jnp.zeros((NE * C, H), xt.dtype).at[idx].set(x_rep, mode="drop")
     xin = constrain(xin.reshape(NE, C, H), mesh,
                     ("experts", None, "act_embed"), rules)
-    g = jax.nn.silu(q_einsum("ech,ehf->ecf", xin, w_gate))
-    u = q_einsum("ech,ehf->ecf", xin, w_up)
+    if w_gu is not None:
+        gu = q_einsum("ech,ehf->ecf", xin, w_gu)                   # [NE,C,2F]
+        F = gu.shape[-1] // 2
+        g = jax.nn.silu(gu[..., :F])
+        u = gu[..., F:]
+    else:
+        g = jax.nn.silu(q_einsum("ech,ehf->ecf", xin, w_gate))
+        u = q_einsum("ech,ehf->ecf", xin, w_up)
     y = q_einsum("ecf,efh->ech", g * u, w_down)                    # [NE,C,H]
     y = constrain(y, mesh, ("experts", None, "act_embed"), rules)
 
@@ -184,9 +271,9 @@ def _capacity_for(config: ModelConfig, tokens: int,
 
 def _mlp_fn(config: ModelConfig, capacity: Optional[int]):
     def fn(x, lp, mesh, rules):
-        return moe_mlp(x, lp["router"], lp["w_gate"], lp["w_up"],
+        return moe_mlp(x, lp["router"], lp.get("w_gate"), lp.get("w_up"),
                        lp["w_down"], config.num_experts_per_tok, mesh,
-                       rules, capacity)
+                       rules, capacity, w_gu=lp.get("wgu_e"))
     return fn
 
 

@@ -192,7 +192,7 @@ def q_einsum(spec: str, x: jax.Array, w) -> jax.Array:
 # models/mixtral.py init_params). All store the contraction at axis -2.
 _QUANT_LEAVES = frozenset({
     "wq", "wk", "wv", "wo",            # attention projections
-    "wqkv", "wgu",                     # fused forms (llama.fuse_params)
+    "wqkv", "wgu", "wgu_e",            # fused forms (llama.fuse_params)
     "w_gate", "w_up", "w_down",        # SwiGLU / expert FFNs
     "lm_head",                         # output projection
 })

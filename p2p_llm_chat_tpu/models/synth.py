@@ -60,14 +60,16 @@ def quote_params(config: ModelConfig, key: jax.Array,
     embed/lm_head. ``quantized=True`` returns int8 matmul leaves (the
     llama family streams straight to fused int8; other families quantize
     after init). Requires an untied lm_head."""
-    from . import family_for, llama
+    from . import family_for
     from .quant import quantize_params
 
     if config.tie_embeddings:
         raise ValueError("quote workload needs an untied lm_head")
     family = family_for(config)
-    if quantized and family is llama:
-        params = llama.init_params_quantized(config, key, dtype=dtype)
+    if quantized and hasattr(family, "init_params_quantized"):
+        # Both families stream straight to fused int8 now (llama and
+        # mixtral expose init_params_quantized).
+        params = family.init_params_quantized(config, key, dtype=dtype)
     else:
         params = dict(family.init_params(config, key, dtype=dtype))
         if quantized:

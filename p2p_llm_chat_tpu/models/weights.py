@@ -331,9 +331,9 @@ def load_checkpoint(ckpt_dir: str, config: Optional[ModelConfig] = None,
 
 class UnsupportedForQuantizedLoad(ValueError):
     """The checkpoint's family is outside load_checkpoint_quantized's
-    scope (dense llama only) — callers fall back to the standard paths.
-    A dedicated type so fallbacks cannot swallow REAL load errors
-    (corrupt shards etc.), which must propagate."""
+    scope — callers fall back to the standard paths. A dedicated type so
+    fallbacks cannot swallow REAL load errors (corrupt shards etc.),
+    which must propagate."""
 
 
 def load_checkpoint_quantized(ckpt_dir: str,
@@ -360,12 +360,15 @@ def load_checkpoint_quantized(ckpt_dir: str,
     quantized unrounded f32 — that path cannot fit big models anyway, and
     all in-tree saves default to bf16.
 
-    Dense llama-family only (MoE checkpoints keep the sharded/mesh
-    paths); raises :class:`UnsupportedForQuantizedLoad` otherwise.
-    Tied-embedding configs return no ``lm_head`` leaf (forward uses
-    ``embed.T``, kept bf16).
+    MoE (mixtral-family) checkpoints stream the same way: attention
+    fuses to wqkv exactly like dense, and the per-expert ffn leaves
+    quantize into the fused ``wgu_e`` [L,NE,H,2F] + ``w_down``
+    [L,NE,F,H] stacks (mixtral.moe_mlp's single-einsum layout); the
+    router stays bf16 (tiny, f32 routing math). Unknown families raise
+    :class:`UnsupportedForQuantizedLoad`. Tied-embedding configs return
+    no ``lm_head`` leaf (forward uses ``embed.T``, kept bf16).
     """
-    from . import family_for, llama
+    from . import family_for, llama, mixtral
     from .checkpoint import is_native_checkpoint, peek_config
     from .checkpoint import load_checkpoint as load_native
     from .quant import QTensor
@@ -380,10 +383,15 @@ def load_checkpoint_quantized(ckpt_dir: str,
         config = (peek_config(ckpt_dir) if native else
                   config_from_hf_json(os.path.join(ckpt_dir, "config.json")))
     family = family_for(config)
-    if config.is_moe or family is not llama:
+    if family not in (llama, mixtral):
         raise UnsupportedForQuantizedLoad(
-            "load_checkpoint_quantized covers the dense llama family; "
-            f"{config.name} keeps the standard load paths")
+            "load_checkpoint_quantized covers the llama and mixtral "
+            f"families; {config.name} keeps the standard load paths")
+    moe = config.is_moe
+    layer_keys = (("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm",
+                   "router", "w_gate", "w_up", "w_down") if moe else
+                  ("attn_norm", "wq", "wk", "wv", "wo",
+                   "mlp_norm", "w_gate", "w_up", "w_down"))
 
     # -- per-layer host-tensor iterator -------------------------------------
     if native:
@@ -392,9 +400,7 @@ def load_checkpoint_quantized(ckpt_dir: str,
 
         def layer_host(li: int) -> dict[str, np.ndarray]:
             lp = host_params["layers"]
-            return {k: np.asarray(lp[k][li]) for k in
-                    ("attn_norm", "wq", "wk", "wv", "wo",
-                     "mlp_norm", "w_gate", "w_up", "w_down")}
+            return {k: np.asarray(lp[k][li]) for k in layer_keys}
 
         def top_host() -> dict[str, np.ndarray]:
             out = {"embed": np.asarray(host_params["embed"]),
@@ -409,15 +415,23 @@ def load_checkpoint_quantized(ckpt_dir: str,
             """One pass over the shards (shared iterator), grouped per
             layer. Host peak is the full tree for HF dirs read this way —
             acceptable (host RAM >> HBM); the DEVICE peak is what this
-            loader bounds."""
-            per_layer: dict[int, dict[str, np.ndarray]] = {}
+            loader bounds. Per-expert tensors stack into [NE, ...] host
+            arrays in expert order."""
+            per_layer: dict[int, dict] = {}
             top: dict[str, np.ndarray] = {}
-            for path, layer, _expert, t in _iter_hf_tensors(ckpt_dir,
-                                                            config):
+            for path, layer, expert, t in _iter_hf_tensors(ckpt_dir,
+                                                           config):
                 if layer is None:
                     top[path[-1]] = t
-                else:
+                elif expert is None:
                     per_layer.setdefault(layer, {})[path[-1]] = t
+                else:
+                    per_layer.setdefault(layer, {}).setdefault(
+                        path[-1], {})[expert] = t
+            for lt in per_layer.values():
+                for k, v in lt.items():
+                    if isinstance(v, dict):
+                        lt[k] = np.stack([v[e] for e in range(len(v))])
             return per_layer, top
 
         _layers_np, _top_np = _read_all()
@@ -434,15 +448,25 @@ def load_checkpoint_quantized(ckpt_dir: str,
     # in-jit quantization may fuse the divide/round and drift +-1 from the
     # eager quantize_params path, breaking the bit-identity contract.
     L, H = config.num_layers, config.hidden_size
-    dims = {
-        "wqkv": (H, config.q_dim + 2 * config.kv_dim),
-        "wo": (config.q_dim, H),
-        "wgu": (H, 2 * config.intermediate_size),
-        "w_down": (config.intermediate_size, H),
-    }
-    bufs = {name: QTensor(q=jnp.zeros((L, din, dout), jnp.int8),
-                          s=jnp.zeros((L, 1, dout), jnp.float32))
-            for name, (din, dout) in dims.items()}
+    E, NE = config.intermediate_size, config.num_experts
+    if moe:
+        dims: dict[str, tuple] = {
+            "wqkv": (H, config.q_dim + 2 * config.kv_dim),
+            "wo": (config.q_dim, H),
+            "wgu_e": (NE, H, 2 * E),
+            "w_down": (NE, E, H),
+        }
+    else:
+        dims = {
+            "wqkv": (H, config.q_dim + 2 * config.kv_dim),
+            "wo": (config.q_dim, H),
+            "wgu": (H, 2 * E),
+            "w_down": (E, H),
+        }
+    bufs = {name: QTensor(q=jnp.zeros((L, *shape), jnp.int8),
+                          s=jnp.zeros((L, *shape[:-2], 1, shape[-1]),
+                                      jnp.float32))
+            for name, shape in dims.items()}
 
     import ml_dtypes
 
@@ -450,9 +474,11 @@ def load_checkpoint_quantized(ckpt_dir: str,
         # Round through bf16 first: the reference path (load bf16 tree,
         # then quantize_params) sees bf16-rounded weights, and HF shards
         # are often f32 — skipping the rounding would drift the scales.
+        # axis=-2 is the contraction axis for 2-D projections and the
+        # [NE, H, F] expert stacks alike (quant.quantize's axis).
         wf = (np.asarray(w).astype(ml_dtypes.bfloat16)
               .astype(np.float32))
-        amax = np.abs(wf).max(axis=0, keepdims=True)
+        amax = np.abs(wf).max(axis=-2, keepdims=True)
         s = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
         q = np.clip(np.round(wf / s), -127, 127).astype(np.int8)
         return q, s
@@ -467,6 +493,7 @@ def load_checkpoint_quantized(ckpt_dir: str,
 
     attn_norms = np.zeros((L, H), np.float32)
     mlp_norms = np.zeros((L, H), np.float32)
+    routers = np.zeros((L, H, NE), np.float32) if moe else None
     for li in range(L):
         lt = layer_host(li)
         attn_norms[li] = lt["attn_norm"].astype(np.float32)
@@ -475,9 +502,18 @@ def load_checkpoint_quantized(ckpt_dir: str,
             "wqkv": np.concatenate(
                 [lt["wq"], lt["wk"], lt["wv"]], axis=1),
             "wo": lt["wo"],
-            "wgu": np.concatenate([lt["w_gate"], lt["w_up"]], axis=1),
-            "w_down": lt["w_down"],
         }
+        if moe:
+            routers[li] = lt["router"].astype(np.float32)
+            # Per-expert gate|up columns concatenate on the out axis —
+            # scales concatenate with them (fused-quantize equivalence).
+            fused["wgu_e"] = np.concatenate(
+                [lt["w_gate"], lt["w_up"]], axis=-1)
+            fused["w_down"] = lt["w_down"]
+        else:
+            fused["wgu"] = np.concatenate(
+                [lt["w_gate"], lt["w_up"]], axis=1)
+            fused["w_down"] = lt["w_down"]
         qs = {}
         for name, w in fused.items():
             q, s = host_quant(w)
@@ -490,6 +526,8 @@ def load_checkpoint_quantized(ckpt_dir: str,
         "mlp_norm": jnp.asarray(mlp_norms, dtype),
         **bufs,
     }
+    if moe:
+        layers["router"] = jnp.asarray(routers, dtype)
     params: dict = {
         "embed": jnp.asarray(top["embed"], dtype),
         "layers": layers,

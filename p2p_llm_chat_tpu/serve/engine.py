@@ -300,8 +300,8 @@ def build_engine_from_env() -> Backend:
         if quant and mesh is None:
             # Single-chip int8: stream straight into the fused int8 tree
             # so the bf16 model never touches the chip (what fits an 8B
-            # checkpoint on one 16 GB v5e). Dense-llama only; MoE falls
-            # through to the standard paths.
+            # checkpoint on one 16 GB v5e). Llama and mixtral families;
+            # anything else falls through to the standard paths.
             from ..models.weights import (
                 UnsupportedForQuantizedLoad,
                 load_checkpoint_quantized,

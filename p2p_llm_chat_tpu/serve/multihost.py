@@ -332,7 +332,7 @@ class MultihostEngine:
                 try:
                     cmd = np.zeros((self._cmd_size,), np.int32)
                     self._broadcast(cmd)      # _OP_SHUTDOWN
-                except BaseException:         # noqa: BLE001
+                except Exception:             # noqa: BLE001
                     # A dead follower must not leave _stopped unset —
                     # every waiting _gen() would spin forever.
                     log.exception("shutdown broadcast failed")
@@ -368,7 +368,10 @@ class MultihostEngine:
                 results = self._run_cmd(self._broadcast(self._pack(batch)))
                 self._batched_rounds += 1
                 self._rows_served_total += len(batch)
-            except BaseException as e:        # deliver, don't kill the loop
+            except Exception as e:            # deliver, don't kill the loop
+                # Exception (not BaseException), mirroring follower_loop:
+                # a BaseException-class fatal kills BOTH sides of the
+                # lockstep symmetrically instead of wedging one.
                 log.exception("multihost round failed")
                 for p in batch:
                     p.error = e
@@ -381,7 +384,7 @@ class MultihostEngine:
                     p.out_ids, p.text = self._truncate_at_stop(
                         ids, [s for s in p.req.options.stop if s])
                     self._requests_served += 1
-                except BaseException as e:    # noqa: BLE001
+                except Exception as e:        # noqa: BLE001
                     log.exception("row post-processing failed")
                     p.error = e
                 finally:

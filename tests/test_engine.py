@@ -414,6 +414,66 @@ def test_moe_family_serves_through_same_scheduler():
         eng.stop()
 
 
+def test_moe_full_stack_composition_matches_oracle():
+    """Round-4 verdict #3 'done' bar: MoE × paged KV × int8 KV × int8
+    weights (streamed fused init) × prefix cache × speculation through
+    the engine must be oracle-exact. Every feature in the stack is
+    exactness-preserving under greedy decoding, so the composed output
+    must equal a solo dense-cache loop on the SAME quantized tree."""
+    from p2p_llm_chat_tpu.models import mixtral
+
+    mcfg = get_config("tiny-moe")
+    qparams = mixtral.init_params_quantized(mcfg, jax.random.PRNGKey(9))
+    stop_ids = set(mcfg.eos_token_ids) | {TOK.eos_id}
+
+    def moe_oracle(prompt: str, max_new: int) -> str:
+        ids = TOK.encode(prompt, add_bos=True)
+        cache = KVCache.create(mcfg, 1, 128)
+        logits, cache = mixtral.prefill(qparams, mcfg, jnp.asarray([ids]),
+                                        jnp.asarray([len(ids)]), cache)
+        last = np.asarray(logits[0, len(ids) - 1], np.float32)
+        out = []
+        for _ in range(max_new):
+            t = int(last.argmax())
+            if t in stop_ids:
+                break
+            out.append(t)
+            lg, cache = mixtral.decode_step(qparams, mcfg,
+                                            jnp.asarray([[t]]), cache)
+            last = np.asarray(lg[0, 0], np.float32)
+        return TOK.decode(out)
+
+    eng = TPUEngine(qparams, mcfg, TOK, num_slots=3, max_seq=128,
+                    kv_mode="paged", page_size=16, kv_quant=True,
+                    spec_k=2, prefix_cache=True,
+                    prefix_texts=("moe prefix ",))
+    try:
+        prompts = ["moe prefix alpha", "moe prefix bravo",
+                   "unrelated charlie"]
+        want = {p: moe_oracle(p, 8) for p in prompts}
+        got, errs = {}, []
+
+        def worker(p):
+            try:
+                got[p] = run(eng, p, max_tokens=8)[0]
+            except Exception as e:   # noqa: BLE001
+                errs.append((p, e))
+
+        threads = [threading.Thread(target=worker, args=(p,))
+                   for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not errs, errs
+        assert got == want
+        # Speculation was live in the composed stack (spec_k=2 publishes
+        # its acceptance counters).
+        assert "serve_spec_accepted_total" in eng.metrics_snapshot()
+    finally:
+        eng.stop()
+
+
 def test_long_prompt_truncated_to_context():
     eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=64)
     try:

@@ -34,8 +34,9 @@ def test_fused_forward_matches_unfused(family, cfg_name, quant):
     assert "wqkv" in fused["layers"] and "wq" not in fused["layers"]
     if family is llama:
         assert "wgu" in fused["layers"]
-    else:   # MoE: per-expert ffn leaves must stay separate
-        assert "w_gate" in fused["layers"]
+    else:   # MoE single-chip: per-expert gate|up fuse into wgu_e
+        assert "wgu_e" in fused["layers"]
+        assert "w_gate" not in fused["layers"]
     # Idempotent.
     assert family.fuse_params(fused) is fused
 
@@ -72,3 +73,50 @@ def test_fused_quantize_order_equivalent():
     np.testing.assert_array_equal(np.asarray(qa.q), np.asarray(qb.q))
     np.testing.assert_allclose(np.asarray(qa.s), np.asarray(qb.s),
                                rtol=1e-7)
+
+
+def test_fused_quantize_order_equivalent_moe():
+    """Same order-equivalence for the per-expert wgu_e fusion: the 4-D
+    gate|up concat commutes with per-output-channel quantization."""
+    config = get_config("tiny-moe")
+    params = mixtral.init_params(config, jax.random.PRNGKey(2),
+                                 dtype=jnp.float32)
+    a = mixtral.fuse_params(quantize_params(params))
+    b = quantize_params(mixtral.fuse_params(params))
+    for leaf in ("wqkv", "wgu_e"):
+        qa, qb = a["layers"][leaf], b["layers"][leaf]
+        assert isinstance(qa, QTensor) and isinstance(qb, QTensor)
+        np.testing.assert_array_equal(np.asarray(qa.q), np.asarray(qb.q))
+        np.testing.assert_allclose(np.asarray(qa.s), np.asarray(qb.s),
+                                   rtol=1e-7)
+
+
+def test_moe_init_quantized_matches_fused_layout():
+    """mixtral.init_params_quantized streams the fused int8 tree: same
+    leaf names/shapes as fuse_params(quantize_params(init_params)) and
+    fuse_params is a no-op on it."""
+    config = get_config("tiny-moe")
+    qp = mixtral.init_params_quantized(config, jax.random.PRNGKey(3))
+    ref = mixtral.fuse_params(quantize_params(
+        mixtral.init_params(config, jax.random.PRNGKey(3))))
+    assert set(qp["layers"]) == set(ref["layers"])
+    for k, v in ref["layers"].items():
+        got = qp["layers"][k]
+        if isinstance(v, QTensor):
+            assert isinstance(got, QTensor)
+            assert got.q.shape == v.q.shape and got.s.shape == v.s.shape
+        else:
+            assert got.shape == v.shape
+    assert mixtral.fuse_params(qp) is qp
+
+    # And it serves: prefill + a greedy decode step run without error.
+    B, S = 2, 8
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, config.vocab_size, (B, S)),
+                         jnp.int32)
+    cache = KVCache.create(config, B, 32)
+    logits, cache = mixtral.prefill(qp, config, tokens,
+                                    jnp.full((B,), S, jnp.int32), cache)
+    dl, _ = mixtral.decode_step(
+        qp, config, jnp.argmax(logits[:, -1:], -1).astype(jnp.int32), cache)
+    assert np.isfinite(np.asarray(dl, np.float32)).all()

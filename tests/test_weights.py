@@ -242,10 +242,51 @@ def test_load_checkpoint_quantized_native_matches(tmp_path):
     _assert_trees_equal(got, want)
 
 
-def test_load_checkpoint_quantized_rejects_moe(tmp_path):
+def test_load_checkpoint_quantized_moe_matches_quantize_then_fuse(tmp_path):
+    """Round-4 verdict #3: the streamed int8 loader now covers the MoE
+    family. Must produce EXACTLY
+    fuse_params(quantize_params(load_checkpoint(...))) — the same
+    bit-identity contract the dense path carries, with the per-expert
+    gate|up fused into wgu_e [L,NE,H,2F]."""
     from tests.test_mixtral_parity import make_hf_model as make_moe
+    from p2p_llm_chat_tpu.models import mixtral
+    from p2p_llm_chat_tpu.models.quant import quantize_params
     from p2p_llm_chat_tpu.models.weights import load_checkpoint_quantized
+
     model, cfg = make_moe()
-    ckpt = _write_ckpt(tmp_path, model)
-    with pytest.raises(ValueError, match="dense llama"):
-        load_checkpoint_quantized(ckpt)
+    ckpt = _write_ckpt(tmp_path, model, n_shards=3)
+    got, got_cfg = load_checkpoint_quantized(ckpt)
+    assert got_cfg.is_moe and got_cfg.num_experts == cfg.num_experts
+
+    base, _ = load_checkpoint(ckpt)         # bf16 (default dtype)
+    want = mixtral.fuse_params(quantize_params(base))
+    assert "wgu_e" in want["layers"]        # expert fusion engaged
+    assert want["layers"]["wgu_e"].q.shape == (
+        cfg.num_layers, cfg.num_experts, cfg.hidden_size,
+        2 * cfg.intermediate_size)
+    _assert_trees_equal(got, want)
+
+
+def test_load_checkpoint_quantized_moe_native_matches(tmp_path):
+    """Same MoE equivalence through a native Orbax checkpoint."""
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from p2p_llm_chat_tpu.models import mixtral
+    from p2p_llm_chat_tpu.models.checkpoint import save_checkpoint
+    from p2p_llm_chat_tpu.models.configs import get_config
+    from p2p_llm_chat_tpu.models.quant import quantize_params
+    from p2p_llm_chat_tpu.models.weights import load_checkpoint_quantized
+
+    cfg = get_config("tiny-moe")
+    params = mixtral.init_params(cfg, _jax.random.PRNGKey(5),
+                                 dtype=_jnp.bfloat16)
+    ckpt = str(tmp_path / "native-moe")
+    save_checkpoint(ckpt, params, cfg)
+
+    got, got_cfg = load_checkpoint_quantized(ckpt)
+    assert got_cfg.is_moe
+    want = mixtral.fuse_params(quantize_params(params))
+    _assert_trees_equal(got, want)
+
+
