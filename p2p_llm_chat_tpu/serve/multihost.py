@@ -325,7 +325,19 @@ class MultihostEngine:
     def _dispatch_loop(self) -> None:
         """Single owner of every broadcast on the leader: accumulates up
         to R requests inside the admission window, runs one lockstep
-        round, delivers per-row results to the waiting HTTP threads."""
+        round, delivers per-row results to the waiting HTTP threads.
+
+        The whole loop is wrapped so an escaped BaseException (the
+        Exception-only catches below deliberately let fatals through for
+        symmetric death with the followers) still sets ``_stopped`` on
+        the way out — otherwise every waiting ``_gen()`` would spin on
+        its event forever with no dispatcher left to serve it."""
+        try:
+            self._dispatch_loop_inner()
+        finally:
+            self._stopped.set()
+
+    def _dispatch_loop_inner(self) -> None:
         while True:
             item = self._q.get()
             if item is _SHUTDOWN:
