@@ -396,7 +396,16 @@ def load_checkpoint_quantized(ckpt_dir: str,
     # -- per-layer host-tensor iterator -------------------------------------
     if native:
         cpu = jax.devices("cpu")[0]
-        host_params, config = load_native(ckpt_dir, device=cpu)
+        host_params, loaded_cfg = load_native(ckpt_dir, device=cpu)
+        # A caller-supplied config must agree with the checkpoint's own —
+        # silently overwriting it made the native path inconsistent with
+        # the HF branch, which honors the parameter (ADVICE r4).
+        if config != loaded_cfg:
+            raise ValueError(
+                f"config mismatch: caller passed {config.name!r} but the "
+                f"native checkpoint at {ckpt_dir} carries "
+                f"{loaded_cfg.name!r}")
+        config = loaded_cfg
 
         def layer_host(li: int) -> dict[str, np.ndarray]:
             lp = host_params["layers"]

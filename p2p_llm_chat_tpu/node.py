@@ -186,8 +186,12 @@ class ChatNode:
             elif self.dht is not None:
                 # Third rung: never-paired peers resolve via the DHT's
                 # signed records while the directory is down (the cache
-                # rung only covers peers we've already talked to).
-                rec = self.dht.get_record(to_username)
+                # rung only covers peers we've already talked to). The
+                # lookup runs inline on the /send handler thread, so it
+                # carries a wall-time budget: with a routing table full
+                # of dead contacts the alpha-rounds of UDP timeouts must
+                # not hold the UI's send for many seconds (ADVICE r4).
+                rec = self.dht.get_record(to_username, budget_s=3.0)
                 if rec is not None:
                     log.warning("directory lookup for %s failed (%s); "
                                 "resolved via DHT", to_username, e)
@@ -212,7 +216,9 @@ class ChatNode:
         # addrs, try those before giving up — it is republished every
         # re-register tick, so it tracks moves the cache cannot.
         if from_cache and self.dht is not None:
-            fresh = self.dht.get_record(to_username)
+            # Same wall-time budget as the third-rung lookup above: this
+            # retry also runs inline on the /send handler thread.
+            fresh = self.dht.get_record(to_username, budget_s=3.0)
             if fresh is not None and fresh.peer_id != rec.peer_id:
                 # Identity pinning: for a peer we already hold a binding
                 # for, a DHT record signed by a DIFFERENT identity is a

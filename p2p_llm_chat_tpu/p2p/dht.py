@@ -288,7 +288,9 @@ class DHTNode:
         self.table = RoutingTable(self.node_id, k=k)
         self._store: dict[int, SignedRecord] = {}
         self._store_mu = threading.Lock()
-        self._pending: dict[str, tuple[threading.Event, list]] = {}
+        # rid -> (event, hits, resolved dst addr the RPC was sent to)
+        self._pending: dict[str, tuple[threading.Event, list,
+                                       tuple[str, int]]] = {}
         self._pending_mu = threading.Lock()
         self._evicting: set[str] = set()
         self._evict_mu = threading.Lock()
@@ -338,15 +340,26 @@ class DHTNode:
     # -- wire ----------------------------------------------------------------
 
     def _recv_loop(self) -> None:
+        import errno as _errno
+
         while not self._closed.is_set():
             try:
                 data, src = self.sock.recvfrom(_MAX_DGRAM)
-            except OSError:
+            except OSError as e:
                 # Transient errors (e.g. ICMP port-unreachable surfacing as
                 # ConnectionResetError on some stacks) must not kill the rx
-                # thread — only a real close should end the loop.
+                # thread — only a real close should end the loop. An fd
+                # invalidated without _closed being set (EBADF/ENOTSOCK)
+                # is unrecoverable: exit instead of busy-spinning, and a
+                # short sleep paces any other persistent error state
+                # (ADVICE r4).
                 if self._closed.is_set():
                     return
+                if e.errno in (_errno.EBADF, _errno.ENOTSOCK):
+                    log.warning("dht rx socket invalidated (%s); rx "
+                                "thread exiting", e)
+                    return
+                time.sleep(0.01)
                 continue
             try:
                 msg = json.loads(data.decode())
@@ -372,8 +385,15 @@ class DHTNode:
                 # A signed response to OUR nonce proves the key holder is
                 # reachable at src — the only path that updates the table
                 # directly. (The reply address IS the contact address:
-                # single-socket UDP.)
-                self._note_contact(Contact(sender_pid, src[0], src[1]))
+                # single-socket UDP.) Table update only when src matches
+                # the address the RPC was SENT to: a challenged peer that
+                # can spoof UDP sources must not re-point its own contact
+                # entry at a victim address (ADVICE r4 reflection vector).
+                # The response itself still delivers either way — rid
+                # possession plus the signature prove it's the peer we
+                # asked.
+                if src == ent[2]:
+                    self._note_contact(Contact(sender_pid, src[0], src[1]))
                 ent[1].append((msg, src))
                 ent[0].set()
             return
@@ -425,8 +445,21 @@ class DHTNode:
         msg = dict(msg, rid=rid, **{"from": self.ident.peer_id})
         ev = threading.Event()
         hits: list = []
+        # dst rides the entry so the response path can require the reply
+        # to come from the address we actually queried before it may
+        # update the routing table. Resolve hostname dsts first:
+        # recvfrom reports the numeric source IP, so a literal hostname
+        # tuple would never match its own replies and seed bootstrap
+        # (DHT_BOOTSTRAP=host:port) would silently never table the seed.
+        # (A multihomed peer replying from a different interface IP is
+        # still skipped for the table update — the response itself
+        # delivers; the peer enters the table on a later direct answer.)
+        try:
+            dst_ip = socket.gethostbyname(dst[0])
+        except OSError:
+            dst_ip = dst[0]
         with self._pending_mu:
-            self._pending[rid] = (ev, hits)
+            self._pending[rid] = (ev, hits, (dst_ip, dst[1]))
         try:
             per_try = self.rpc_timeout_s if timeout_s is None else timeout_s
             for _ in range(max(1, attempts)):
@@ -555,10 +588,12 @@ class DHTNode:
     # -- iterative lookups ---------------------------------------------------
 
     def _fan_out(self, contacts: list[Contact],
-                 fn: Callable[[Contact], object]) -> dict[Contact, object]:
+                 fn: Callable[[Contact], object],
+                 max_wait_s: Optional[float] = None) -> dict[Contact, object]:
         """Run ``fn`` over contacts on the shared pool; drop stragglers and
         raised calls (a missing key = no answer). Bounded: fn is an _rpc
-        wrapper, itself capped at attempts*timeout."""
+        wrapper, itself capped at attempts*timeout; ``max_wait_s``
+        additionally clamps the collect window (lookup deadlines)."""
         if not contacts:
             return {}
         out: dict[Contact, object] = {}
@@ -566,8 +601,11 @@ class DHTNode:
             futs = {self._pool.submit(fn, c): c for c in contacts}
         except RuntimeError:      # pool shut down: node closing
             return {}
+        wait = 2 * self.rpc_timeout_s + 0.5
+        if max_wait_s is not None:
+            wait = min(wait, max_wait_s)
         try:
-            for f in as_completed(futs, timeout=2 * self.rpc_timeout_s + 0.5):
+            for f in as_completed(futs, timeout=wait):
                 try:
                     out[futs[f]] = f.result()
                 except Exception:  # noqa: BLE001 — treat as no answer
@@ -582,12 +620,16 @@ class DHTNode:
                  query: Callable[[Contact],
                                  Optional[tuple[Optional[SignedRecord],
                                                 list[Contact]]]],
+                 deadline: Optional[float] = None,
                  ) -> tuple[Optional[SignedRecord], list[Contact]]:
         """Shared iterative-lookup core: keep querying the alpha closest
         unqueried candidates until the k closest are all queried or a value
         surfaces. ``query`` returns None when the peer gave NO answer (the
         suspect/eviction path) vs ``(record_or_None, contacts)`` for any
-        answer. Returns (best_record_or_None, k closest live contacts)."""
+        answer. Returns (best_record_or_None, k closest live contacts).
+        ``deadline`` (time.monotonic()) bounds total wall time: a table
+        full of dead contacts otherwise costs multiple alpha-rounds of
+        UDP timeouts (ADVICE r4 — the /send handler runs this inline)."""
         shortlist: dict[str, Contact] = {
             c.peer_id: c for c in self.table.closest(target, self.k)}
         queried: set[str] = set()
@@ -597,10 +639,18 @@ class DHTNode:
                              key=lambda c: _distance(c.node_id, target))
             batch = [c for c in ordered[:self.k]
                      if c.peer_id not in queried][:ALPHA]
-            if not batch:
+            past = (deadline is not None
+                    and time.monotonic() >= deadline)
+            if past or not batch:
                 live = [c for c in ordered if c.peer_id in queried]
                 return best, live[:self.k]
-            results = self._fan_out(batch, query)
+            # Clamp the round's collect window to the remaining budget:
+            # without this, a deadline that lands mid-round still waits
+            # _fan_out's full as_completed timeout (~1.7 s) past it.
+            wait = None
+            if deadline is not None:
+                wait = max(0.05, deadline - time.monotonic())
+            results = self._fan_out(batch, query, max_wait_s=wait)
             for c in batch:
                 queried.add(c.peer_id)
                 res = results.get(c)
@@ -656,9 +706,11 @@ class DHTNode:
         return sum(1 for resp in results.values()
                    if resp is not None and resp.get("ok"))
 
-    def get_record(self, username: str) -> Optional[SignedRecord]:
+    def get_record(self, username: str,
+                   budget_s: Optional[float] = None) -> Optional[SignedRecord]:
         """Iterative value lookup; validates locally before returning (a
-        malicious responder cannot shortcut the signature check)."""
+        malicious responder cannot shortcut the signature check).
+        ``budget_s`` caps total lookup wall time (see _iterate)."""
         key = key_for_username(username)
         local = self._load(key)
 
@@ -679,7 +731,9 @@ class DHTNode:
                               for d in resp.get("nodes", [])]
             return (None, [])
 
-        best, _ = self._iterate(key, q)
+        deadline = (time.monotonic() + budget_s
+                    if budget_s is not None else None)
+        best, _ = self._iterate(key, q, deadline=deadline)
         if local is not None and (best is None or local.seq > best.seq):
             best = local
         return best

@@ -75,7 +75,12 @@ class Mapping:
 
 def discover_gateway() -> Optional[str]:
     """Default-route gateway from /proc/net/route (Linux). Returns None
-    when there is no default route (e.g. isolated containers)."""
+    when there is no default route (e.g. isolated containers).
+
+    Linux-only by design: on other platforms this returns None and
+    NAT-PMP silently disables (the node falls back to hole punching /
+    relay). Set ``NATPMP_GATEWAY`` explicitly to use NAT-PMP elsewhere.
+    """
     try:
         with open("/proc/net/route") as f:
             next(f)  # header
@@ -223,7 +228,11 @@ class PortMapper:
                     or time.monotonic() < self._renew_at):
                 return None
             prev = (self.mapping.external_ip, self.mapping.external_port)
-            client = NatPmpClient(self.gateway, self._gw_port)
+            # Fewer retransmits than the initial map: renew runs under
+            # self._mu, which node.stop() -> release() also takes, so the
+            # worst-case blocking window here directly delays shutdown
+            # (ADVICE r4). A missed renew retries at lifetime/4 anyway.
+            client = NatPmpClient(self.gateway, self._gw_port, tries=2)
             try:
                 ext_ip = client.external_address()
                 m = client.map_port(PROTO_TCP, self.internal_port,
