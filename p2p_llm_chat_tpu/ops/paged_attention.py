@@ -36,6 +36,23 @@ the decode carry (together ~0.7 ms off a 3.9 ms step).
 ``PAGED_ATTN_IMPL`` selects the process-wide default; ``interpret=True``
 runs the kernel on CPU for hardware-free tests (SURVEY.md §4);
 :func:`paged_attention_reference` is the jnp oracle.
+
+Round-5 closure of the short-window kernel question (the round-4
+verdict's "(B x Hkv)-grid with rep folded into the dot"): the shape is
+settled by launch arithmetic derived from the kernels already measured
+here. Attention must run inside the per-layer scan (layer i+1's q
+depends on layer i's output), so ANY kernel pays 22 launches per step;
+the flash kernel's measured overhead is ~1 us per program (32 programs
+x 22 calls = 704 programs, 1.4 ms total vs its 0.7 ms byte bound). A
+(B x Hkv) grid is B*Hkv = 256 programs x 22 calls = 5,632 programs
+~= 5.6 ms of program overhead alone — 2x the ENTIRE 2.97 ms step. The
+gather path's only waste is the materialise round trip of the bf16
+window (~0.5 ms/step at W=192), strictly smaller than any per-program
+overhead a Pallas grid can reach at these shapes. The calculus flips
+at long windows, where the materialise waste grows linearly with W
+(~33 ms of the 40 ms step at W=4096) and per-program overhead does
+not — the long-context kernel is real headroom (BASELINE.md round-5);
+the short-window step is at its floor (roofline in BASELINE.md).
 """
 
 from __future__ import annotations
