@@ -176,6 +176,30 @@ def _paged_attention_gather(q, k_pages, v_pages, page_table, lengths, layer,
     return attend_gqa(q[:, None], k, v, mask)[:, 0]
 
 
+def _gqa_selection_matrices(Hq: int, Hkv: int, D: int, rep: int):
+    """Constant 0/1 selection matrices built from in-register iotas
+    (shared by _append_kernel and the flash-append kernel): SEL tiles /
+    collapses per-head D-blocks, BLOCKM masks q columns to their own kv
+    block (built both ways — Mosaic cannot transpose i1), EXPT expands
+    kv-head rows to query-head columns. Returns
+    (sel bf16 [HD, D], blockm bool [HD, Hq], blockm_t bool [Hq, HD],
+    expt f32 [Hq, Hkv])."""
+    HD = Hkv * D
+    cmod = jax.lax.broadcasted_iota(jnp.int32, (HD, D), 0) % D
+    drng = jax.lax.broadcasted_iota(jnp.int32, (HD, D), 1)
+    sel = (cmod == drng).astype(jnp.bfloat16)
+    cdiv = jax.lax.broadcasted_iota(jnp.int32, (HD, Hq), 0) // D
+    hdiv = jax.lax.broadcasted_iota(jnp.int32, (HD, Hq), 1) // rep
+    blockm = cdiv == hdiv
+    cdiv2 = jax.lax.broadcasted_iota(jnp.int32, (Hq, HD), 1) // D
+    hdiv2 = jax.lax.broadcasted_iota(jnp.int32, (Hq, HD), 0) // rep
+    blockm_t = cdiv2 == hdiv2
+    hh = jax.lax.broadcasted_iota(jnp.int32, (Hq, Hkv), 0) // rep
+    gg = jax.lax.broadcasted_iota(jnp.int32, (Hq, Hkv), 1)
+    expt = (hh == gg).astype(jnp.float32)
+    return sel, blockm, blockm_t, expt
+
+
 def _append_kernel(len_ref, q_ref, kc_ref, vc_ref, kwin_ref, vwin_ref,
                    skw_ref, svw_ref, o_ref, *, page_size: int,
                    pages: int, rep: int, rows: int, scale: float,
@@ -208,23 +232,8 @@ def _append_kernel(len_ref, q_ref, kc_ref, vc_ref, kwin_ref, vwin_ref,
     D = kc_ref.shape[2]
     HD = Hkv * D
     pos_col = jax.lax.broadcasted_iota(jnp.int32, (W, 1), dimension=0)
-
-    # SEL[c, d] = 1 iff c % D == d  (block-diag tiler / output collapser)
-    cmod = jax.lax.broadcasted_iota(jnp.int32, (HD, D), 0) % D
-    drng = jax.lax.broadcasted_iota(jnp.int32, (HD, D), 1)
-    sel = (cmod == drng).astype(jnp.bfloat16)                   # [HD, D]
-    # blockm[c, h] = 1 iff c // D == h // rep  (head <-> its kv block);
-    # the [Hq, HD] twin is built directly — Mosaic cannot transpose i1.
-    cdiv = jax.lax.broadcasted_iota(jnp.int32, (HD, Hq), 0) // D
-    hdiv = jax.lax.broadcasted_iota(jnp.int32, (HD, Hq), 1) // rep
-    blockm = cdiv == hdiv                                       # [HD, Hq]
-    cdiv2 = jax.lax.broadcasted_iota(jnp.int32, (Hq, HD), 1) // D
-    hdiv2 = jax.lax.broadcasted_iota(jnp.int32, (Hq, HD), 0) // rep
-    blockm_t = cdiv2 == hdiv2                                   # [Hq, HD]
-    # EXPT[h, g] = 1 iff h // rep == g  (kv-head -> query-head expander)
-    hh = jax.lax.broadcasted_iota(jnp.int32, (Hq, Hkv), 0) // rep
-    gg = jax.lax.broadcasted_iota(jnp.int32, (Hq, Hkv), 1)
-    expt = (hh == gg).astype(jnp.bfloat16)                      # [Hq, Hkv]
+    sel, blockm, blockm_t, expt = _gqa_selection_matrices(Hq, Hkv, D, rep)
+    expt = expt.astype(jnp.bfloat16)
 
     g0 = pl.program_id(0)
     for r in range(rows):
@@ -385,10 +394,16 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
     [B, Hq, D] in q.dtype.
 
     The XLA gather+merge below is the DEFAULT everywhere (it measured
-    fastest at serving shapes — see the module docstring's round-4
-    history); ``PAGED_APPEND_IMPL=kernel`` opts into the Pallas append
-    kernel (_append_kernel). Both compute the same f32 softmax over the
-    same score set.
+    fastest at short serving windows — see the module docstring's
+    round-4 history). Opt-ins, TPU only: ``PAGED_APPEND_IMPL=kernel``
+    selects the round-4 gathered-window Pallas kernel (_append_kernel);
+    ``PAGED_APPEND_IMPL=flash`` or ``PAGED_APPEND_FLASH_MIN_W=<tokens>``
+    selects the round-5 flash-append kernel
+    (_paged_attention_flash_append) — outright or above a window
+    threshold — which skips the gathered-window materialisation
+    (measured +13-18% at W=2048; see _FLASH_APPEND_MIN_W for its
+    regime and caveats). All paths compute the same f32 softmax over
+    the same score set.
     """
     B, Hq, D = q.shape
     Hkv = k_cur.shape[1]
@@ -398,6 +413,23 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
             q, k_cur, v_cur, cache.k, cache.v, cache.k_scale,
             cache.v_scale, cache.page_table, lengths, layer, pages=pages,
             quantized=cache.k_scale is not None, interpret=interpret)
+    W = pages * cache.k.shape[2]
+    single_chunk = W <= max(cache.k.shape[2],
+                            _FLASH_CHUNK_TOK_BYTES
+                            // cache.k.dtype.itemsize)
+    if not interpret and single_chunk and _flash_append_wanted(W):
+        # Round-5 opt-in: one HBM pass over the pages instead of the
+        # gather's materialise-then-attend. Engaged ONLY in the
+        # single-chunk regime — the measured win regime; multi-chunk
+        # pipelines are either chunk-loop-bound or exceed the VMEM
+        # stack (see _FLASH_APPEND_MIN_W) — so deeper windows fall
+        # back to gather instead of regressing or failing to compile.
+        # Explicit interpret=True callers (CPU tests) drive the kernel
+        # directly.
+        return _paged_attention_flash_append(
+            q, k_cur, v_cur, cache.k, cache.v, cache.k_scale,
+            cache.v_scale, cache.page_table, lengths, layer, pages=pages,
+            quantized=cache.k_scale is not None)
     scores, v, sv = _gather_window_scores(
         q[:, None], cache.k, cache.v, cache.k_scale, cache.v_scale,
         cache.page_table, lengths, layer, pages=pages)
@@ -617,6 +649,252 @@ def paged_attention_verify_append(q_blk, k_blk, v_blk, cache, lengths,
 # up to 8 pages x 64 slots x Hkv x D. At bench shapes (8 heads, D=128)
 # that is 1 MB per buffer side — 4 MB total with double buffering.
 _FLASH_CHUNK_PAGES = 8
+
+# Engage the flash APPEND kernel at windows >= this many tokens (TPU
+# only; <=0 = off, the DEFAULT; the dispatch additionally restricts it
+# to the single-chunk regime, so with the 2048-byte chunk budget the
+# effective window band is [MIN_W, 2048] for int8 pools). Round-5
+# status, measured at B=32 bench-1b int8, W=2048, vs the gather path's
+# 16.5 ms step: the kernel runs 13.5-14.4 ms (+13-18%, session/
+# page-size spread) with whole-window (single-chunk) DMAs, and loses
+# or cannot compile in every multi-chunk shape tried — 1024-token
+# chunks are chunk-loop-bound (21.5 ms), ps=64 pipelines are
+# DMA-descriptor-bound (the _append_kernel lesson), and 2048-token
+# double-buffered chunks exceed the 16 MB VMEM stack (20.7 MB
+# measured). Opt-in via PAGED_APPEND_FLASH_MIN_W=2048; the gather path
+# stays default and the deep-window materialise waste stays the
+# recorded headroom (BASELINE.md round-5).
+_FLASH_APPEND_MIN_W = int(os.environ.get("PAGED_APPEND_FLASH_MIN_W",
+                                         "0"))
+
+# Per-dtype chunk sizing for the flash-append DMA pipeline (bytes of
+# one buffer side per token unit; see chunk_pages below).
+_FLASH_CHUNK_TOK_BYTES = 2048
+
+
+def _flash_append_wanted(window: int) -> bool:
+    if jax.devices()[0].platform != "tpu":
+        return False            # non-interpret pallas_call needs the TPU
+    if _APPEND_IMPL == "flash":
+        return True
+    if _APPEND_IMPL == "kernel":
+        return False
+    return _FLASH_APPEND_MIN_W > 0 and window >= _FLASH_APPEND_MIN_W
+
+
+def _flash_append_kernel_body(quantized: bool, page_size: int, pages: int,
+                              chunk_pages: int, rep: int, scale: float):
+    """Build the flash-append kernel body (see _flash_kernel for the DMA
+    structure). Differences from the plain flash kernel:
+
+    - **append semantics**: the online-softmax state INITIALISES with the
+      current token's term (m = s_cur, l = 1, acc = v_cur) — exactly the
+      extra softmax term paged_attention_append's gather path merges, so
+      pool writes still batch after the layer scan.
+    - **int8 pools** (``quantized``): the per-page scale rows
+      ([Hkv, ps_pad] f32, the head-major layout paged_kv.py stores for
+      kernel DMAs) ride the same double-buffered chunk pipeline; k
+      scales fold into the scores, v scales into the probabilities —
+      the same fold-outside-the-dots contract as the gather path, so
+      HBM sees int8 KV only.
+    - **selection-matmul GQA math** (from _append_kernel, the round-4
+      VPU win): scores run as ONE [Ct, HD] x [HD, Hq] dot per chunk and
+      the softmax chain on full-width [Ct, Hq] arrays — per-kv-head
+      [rep=2, Ct] dots waste 6/8 sublanes on the VPU and measured ~2x
+      slower at long windows. The scale folds become one [Ct, Hkv] x
+      [Hkv, Hq] expander dot each instead of per-page segment concats.
+    """
+    def body(*refs):
+        if quantized:
+            (pt_ref, len_ref, layer_ref, q_ref, kc_ref, vc_ref, k_hbm,
+             v_hbm, ks_hbm, vs_hbm, o_ref, kbuf, vbuf, ksbuf, vsbuf,
+             sems) = refs
+        else:
+            (pt_ref, len_ref, layer_ref, q_ref, kc_ref, vc_ref, k_hbm,
+             v_hbm, o_ref, kbuf, vbuf, sems) = refs
+            ksbuf = vsbuf = ks_hbm = vs_hbm = None
+        b = pl.program_id(0)
+        ly = layer_ref[0]
+        length = len_ref[b]
+        num_chunks = -(-pages // chunk_pages)
+
+        def dma(slot: int, c: int, i: int):
+            page = pt_ref[b, c * chunk_pages + i]
+            copies = [
+                pltpu.make_async_copy(k_hbm.at[ly, page], kbuf.at[slot, i],
+                                      sems.at[0, slot, i]),
+                pltpu.make_async_copy(v_hbm.at[ly, page], vbuf.at[slot, i],
+                                      sems.at[1, slot, i]),
+            ]
+            if quantized:
+                copies += [
+                    pltpu.make_async_copy(ks_hbm.at[ly, page],
+                                          ksbuf.at[slot, i],
+                                          sems.at[2, slot, i]),
+                    pltpu.make_async_copy(vs_hbm.at[ly, page],
+                                          vsbuf.at[slot, i],
+                                          sems.at[3, slot, i]),
+                ]
+            return copies
+
+        def start_chunk(slot: int, c: int) -> None:
+            for i in range(min(chunk_pages, pages - c * chunk_pages)):
+                for d in dma(slot, c, i):
+                    d.start()
+
+        start_chunk(0, 0)
+        q = q_ref[0].astype(jnp.float32)                 # [Hq, D]
+        Hq, D = q.shape
+        Hkv = Hq // rep
+        HD = Hkv * D
+
+        # Constant selection matrices — shared with _append_kernel
+        # (_gqa_selection_matrices): the round-4 VPU win's machinery.
+        sel, blockm, blockm_t, expt = _gqa_selection_matrices(
+            Hq, Hkv, D, rep)
+
+        # Q stacked into its kv block: [HD, Hq].
+        q_cols = jax.lax.dot(sel, q.T.astype(jnp.bfloat16),
+                             preferred_element_type=jnp.float32)
+        q_blk = jnp.where(blockm, q_cols.astype(jnp.bfloat16),
+                          jnp.zeros((), jnp.bfloat16))           # [HD, Hq]
+
+        # Append init: state = the current token's softmax term at full
+        # precision (p_cur = exp(s_cur - m) = 1 at m = s_cur). State
+        # layout matches the chunk math: m/l [1, Hq], acc [Hq, D].
+        kcur = jax.lax.dot(expt, kc_ref[0].astype(jnp.float32),
+                           preferred_element_type=jnp.float32)   # [Hq, D]
+        vcur = jax.lax.dot(expt, vc_ref[0].astype(jnp.float32),
+                           preferred_element_type=jnp.float32)
+        m = jnp.sum(q * kcur, axis=-1, keepdims=True).T * scale  # [1, Hq]
+        l = jnp.ones((1, Hq), jnp.float32)
+        acc = vcur                                               # [Hq, D]
+
+        for c in range(num_chunks):
+            slot = c % 2
+            if c + 1 < num_chunks:
+                start_chunk((c + 1) % 2, c + 1)
+            n_pages = min(chunk_pages, pages - c * chunk_pages)
+            for i in range(n_pages):
+                for d in dma(slot, c, i):
+                    d.wait()
+            # bf16 dot inputs: int8 -> bf16 is the cheap unpack and the
+            # MXU's preferred operand dtype; accumulation stays f32.
+            Ct = n_pages * page_size
+            kflat = kbuf[slot][:n_pages].reshape(
+                Ct, HD).astype(jnp.bfloat16)
+            vflat = vbuf[slot][:n_pages].reshape(
+                Ct, HD).astype(jnp.bfloat16)
+            s = jax.lax.dot(kflat, q_blk,
+                            preferred_element_type=jnp.float32) * scale
+            if quantized:
+                # [Ct, Hkv] scale columns -> [Ct, Hq] via the expander
+                # dot (one MXU op; per-page segment concats measured
+                # overhead-bound on the VPU).
+                sk = jnp.concatenate(
+                    [ksbuf[slot][i, :, :page_size].T
+                     for i in range(n_pages)], axis=0)           # [Ct, Hkv]
+                s = s * jax.lax.dot(sk, expt.T,
+                                    preferred_element_type=jnp.float32)
+            pos = c * chunk_pages * page_size + jax.lax.broadcasted_iota(
+                jnp.int32, (Ct, 1), dimension=0)
+            s = jnp.where(pos < length, s, NEG_INF)              # [Ct, Hq]
+
+            m_cur = jnp.maximum(m, jnp.max(s, axis=0, keepdims=True))
+            alpha = jnp.exp(m - m_cur)                           # [1, Hq]
+            probs = jnp.exp(s - m_cur)                           # [Ct, Hq]
+            # Denominator sums the UNSCALED probabilities (v scales fold
+            # into the p.v dot only — the gather path's contract).
+            l = l * alpha + jnp.sum(probs, axis=0, keepdims=True)
+            if quantized:
+                sv = jnp.concatenate(
+                    [vsbuf[slot][i, :, :page_size].T
+                     for i in range(n_pages)], axis=0)           # [Ct, Hkv]
+                probs = probs * jax.lax.dot(
+                    sv, expt.T, preferred_element_type=jnp.float32)
+            out_full = jax.lax.dot(probs.T.astype(jnp.bfloat16), vflat,
+                                   preferred_element_type=jnp.float32)
+            out_full = jnp.where(blockm_t, out_full, 0.0)        # [Hq, HD]
+            acc = acc * alpha.T + jax.lax.dot(
+                out_full.astype(jnp.bfloat16), sel,
+                preferred_element_type=jnp.float32)              # [Hq, D]
+            m = m_cur
+
+        o_ref[0] = (acc / l.T).astype(o_ref.dtype)
+
+    return body
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("pages", "quantized", "interpret"))
+def _paged_attention_flash_append(q, k_cur, v_cur, k_pages, v_pages,
+                                  k_scale, v_scale, page_table, lengths,
+                                  layer, *, pages: int, quantized: bool,
+                                  interpret: bool = False):
+    """Flash-append dispatch: grid (B,), manual double-buffered page (and
+    scale-row) DMAs, online softmax seeded with the current token. HBM
+    reads each page exactly once per (layer, step) — no gathered-window
+    materialisation — which is what makes it the long-window win
+    (BASELINE.md round-5); below _FLASH_APPEND_MIN_W the gather path's
+    XLA fusion amortises better and stays default."""
+    B, Hq, D = q.shape
+    L, N, page_size, Hkv, _ = k_pages.shape
+    rep = Hq // Hkv
+    scale = 1.0 / (D ** 0.5)
+    pt = page_table[:, :pages].astype(jnp.int32)
+    layer = jnp.asarray(layer, jnp.int32).reshape(1)
+    # Chunk budget in TOKENS, not pages, and as LARGE as VMEM allows:
+    # measured at W=2048/B=32 the chunk-loop iteration cost dominates —
+    # 512-token chunks ran 21.5 ms where whole-window chunks ran
+    # 13.5-14.4 ms. The byte budget (~2048 int8-token-equivalents,
+    # 8.4 MB double-buffered k+v at bench shapes) derives per dtype;
+    # module-level so tests can shrink it to exercise multi-chunk
+    # pipelines in interpret mode.
+    tok_budget = max(page_size,
+                     _FLASH_CHUNK_TOK_BYTES // k_pages.dtype.itemsize)
+    chunk_pages = max(1, min(pages, tok_budget // page_size))
+
+    in_specs = [
+        pl.BlockSpec((1, Hq, D), lambda b, pt, ln, ly: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda b, pt, ln, ly: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda b, pt, ln, ly: (b, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),      # k pool stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),      # v pool stays in HBM
+    ]
+    operands = [q, k_cur, v_cur, k_pages, v_pages]
+    scratch = [
+        pltpu.VMEM((2, chunk_pages, page_size, Hkv, D), k_pages.dtype),
+        pltpu.VMEM((2, chunk_pages, page_size, Hkv, D), v_pages.dtype),
+    ]
+    n_sem = 2
+    if quantized:
+        ps_pad = k_scale.shape[-1]
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),  # k scales stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # v scales stay in HBM
+        ]
+        operands += [k_scale, v_scale]
+        scratch += [
+            pltpu.VMEM((2, chunk_pages, Hkv, ps_pad), jnp.float32),
+            pltpu.VMEM((2, chunk_pages, Hkv, ps_pad), jnp.float32),
+        ]
+        n_sem = 4
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,       # page_table, lengths, layer
+        grid=(B,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, Hq, D), lambda b, pt, ln, ly: (b, 0, 0)),
+        scratch_shapes=scratch + [
+            pltpu.SemaphoreType.DMA((n_sem, 2, chunk_pages))],
+    )
+    return pl.pallas_call(
+        _flash_append_kernel_body(quantized, page_size, pages, chunk_pages,
+                                  rep, scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
+        interpret=interpret,
+    )(pt, lengths.astype(jnp.int32), layer, *operands)
 
 
 @functools.partial(jax.jit, static_argnames=("pages", "interpret"))

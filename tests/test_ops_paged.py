@@ -387,3 +387,56 @@ def test_append_kernel_interpret_matches_gather():
             pa._APPEND_IMPL = saved
         np.testing.assert_allclose(np.asarray(kern), np.asarray(ref),
                                    atol=2e-2, rtol=2e-2)
+
+
+def test_flash_append_kernel_interpret_matches_gather(monkeypatch):
+    """The round-5 long-window flash-append kernel (manual page + scale
+    DMAs, online softmax seeded with the current token) agrees with the
+    gather append path in interpret mode — bf16 and int8 pools, ragged
+    lengths. The chunk byte budget is shrunk so pages=3 runs as THREE
+    chunks: the cross-chunk online-softmax rescale, double-buffer slot
+    alternation, and partial-final-chunk scale concat (the riskiest
+    logic) all execute hardware-free."""
+    import importlib
+
+    pa = importlib.import_module("p2p_llm_chat_tpu.ops.paged_attention")
+    monkeypatch.setattr(pa, "_FLASH_CHUNK_TOK_BYTES", 64)  # 16 f32 tokens
+    cfg = get_config("tiny-tp")     # 4 kv heads, head_dim 32
+    rng = np.random.default_rng(7)
+    B, pages, ps = 4, 3, 16
+    mppr = pages
+    for quantized in (False, True):
+        cache = paged_kv.PagedKVCache.create(
+            cfg, B, B * mppr + 1, ps, max_pages_per_row=mppr,
+            dtype=jnp.float32, quantized=quantized)
+        lens = []
+        for b in range(B):
+            n = int(rng.integers(1, pages * ps - 1))
+            lens.append(n)
+            table = jnp.asarray(1 + b * mppr + np.arange(mppr), jnp.int32)
+            rk = jnp.asarray(rng.normal(size=(cfg.num_layers, pages * ps,
+                                              cfg.num_kv_heads,
+                                              cfg.head_dim)), jnp.float32)
+            rv = jnp.asarray(rng.normal(size=rk.shape), jnp.float32)
+            cache = paged_kv.write_prefill_row(cache, rk, rv,
+                                               jnp.asarray(b),
+                                               jnp.asarray(n), table)
+        lens = jnp.asarray(lens, jnp.int32)
+        q = jnp.asarray(rng.normal(size=(B, cfg.num_heads, cfg.head_dim)),
+                        jnp.float32)
+        kc = jnp.asarray(rng.normal(size=(B, cfg.num_kv_heads,
+                                          cfg.head_dim)), jnp.float32)
+        vc = jnp.asarray(rng.normal(size=kc.shape), jnp.float32)
+        kern = pa._paged_attention_flash_append(
+            q, kc, vc, cache.k, cache.v, cache.k_scale, cache.v_scale,
+            cache.page_table, lens, jnp.asarray(0), pages=pages,
+            quantized=quantized, interpret=True)
+        saved = pa._APPEND_IMPL
+        pa._APPEND_IMPL = "gather"      # pin the reference path
+        try:
+            ref = pa.paged_attention_append(q, kc, vc, cache, lens,
+                                            jnp.asarray(0), pages=pages)
+        finally:
+            pa._APPEND_IMPL = saved
+        np.testing.assert_allclose(np.asarray(kern), np.asarray(ref),
+                                   atol=2e-2, rtol=2e-2, err_msg=str(quantized))
