@@ -75,6 +75,7 @@ log = get_logger("serve.multihost")
 # Command ops broadcast from the leader (int32 header slot 0).
 _OP_SHUTDOWN = 0
 _OP_GENERATE = 1
+_OP_EMBED = 2
 _HDR = 2          # [op, n_active]
 # Per-row int32 fields (quantised floats carry milli-units):
 #   [len, max_new, temp_milli, top_k, top_p_milli, repeat_milli, seed]
@@ -139,6 +140,16 @@ def _row_fields(options) -> tuple:
     )
 
 
+@dataclass
+class _PendingEmbed:
+    """A leader-side embedding group (<= R texts) awaiting its round."""
+
+    ids_list: list
+    event: threading.Event = field(default_factory=threading.Event)
+    vecs: list = field(default_factory=list)
+    error: Optional[BaseException] = None
+
+
 _SHUTDOWN = object()
 
 
@@ -191,6 +202,12 @@ class MultihostEngine:
             return logits.astype(jnp.float32), cache
 
         self._decode_j = _decode
+
+        def _embed(params, tokens, lens):
+            return model.embed_pooled(params, config_, tokens, lens, mesh_)
+
+        self._embed_j = jax.jit(
+            _embed, out_shardings=NamedSharding(mesh, P()))
         # Leader-side admission machinery (followers never touch it).
         self._q: "queue.Queue" = queue.Queue()
         self._dispatcher: Optional[threading.Thread] = None
@@ -216,6 +233,15 @@ class MultihostEngine:
             cmd[toff: toff + len(p.ids)] = p.ids
         return cmd
 
+    def _pack_embed(self, ids_list: list) -> np.ndarray:
+        cmd = np.zeros((self._cmd_size,), np.int32)
+        cmd[0], cmd[1] = _OP_EMBED, len(ids_list)
+        for r, ids in enumerate(ids_list):
+            cmd[_HDR + r * _ROW_FIELDS] = len(ids)
+            toff = _HDR + _ROW_FIELDS * self._rows + r * self.max_seq
+            cmd[toff: toff + len(ids)] = ids
+        return cmd
+
     # -- lockstep core (every process executes this identically) -----------
 
     def _run_cmd(self, cmd: np.ndarray) -> Optional[list]:
@@ -230,15 +256,28 @@ class MultihostEngine:
         R = self._rows
         rows = np.zeros((R, _ROW_FIELDS), np.int32)
         rows[:] = cmd[_HDR: _HDR + _ROW_FIELDS * R].reshape(R, _ROW_FIELDS)
-        lens = np.maximum(rows[:, 0], 1)      # padding rows prefill 1 token
+        lens = np.maximum(rows[:, 0], 1)      # padding rows hold 1 token
+
+        def unpack_tokens(S: int) -> np.ndarray:
+            toks = np.zeros((R, S), np.int32)
+            tbase = _HDR + _ROW_FIELDS * R
+            for r in range(R):
+                toks[r, : lens[r]] = cmd[tbase + r * self.max_seq:
+                                         tbase + r * self.max_seq
+                                         + lens[r]]
+            return toks
+
+        if op == _OP_EMBED:
+            toks = unpack_tokens(_bucket(int(lens.max()), self.max_seq))
+            vecs = np.asarray(self._embed_j(self._params,
+                                            jnp.asarray(toks),
+                                            jnp.asarray(lens)),
+                              np.float32)
+            return [vecs[r] for r in range(n_active)]
         max_new = rows[:, 1]
         T = int(max_new.max()) if n_active else 0
         S = _bucket(int(lens.max()) + 1, self.max_seq)
-        toks = np.zeros((R, S), np.int32)
-        tbase = _HDR + _ROW_FIELDS * R
-        for r in range(R):
-            toks[r, : lens[r]] = cmd[tbase + r * self.max_seq:
-                                     tbase + r * self.max_seq + lens[r]]
+        toks = unpack_tokens(S)
         # Bucketed like S: distinct num_predict values must not each
         # compile a fresh cache shape across the whole mesh.
         budget = _bucket(S + T + 1, self.max_seq)
@@ -362,6 +401,19 @@ class MultihostEngine:
                                 "server shutting down")
                             late.event.set()
                 return
+            if isinstance(item, _PendingEmbed):
+                # Embeddings run one group per lockstep round (a distinct
+                # program — never co-batched with generate rows).
+                try:
+                    res = self._run_cmd(self._broadcast(
+                        self._pack_embed(item.ids_list)))
+                    item.vecs = [v.tolist() for v in res]
+                except Exception as e:        # noqa: BLE001
+                    log.exception("multihost embed round failed")
+                    item.error = e
+                finally:
+                    item.event.set()
+                continue
             batch = [item]
             deadline = time.monotonic() + self.window_s
             while len(batch) < self._rows:
@@ -372,8 +424,10 @@ class MultihostEngine:
                     nxt = self._q.get(timeout=left)
                 except queue.Empty:
                     break
-                if nxt is _SHUTDOWN:
-                    self._q.put(_SHUTDOWN)    # run this batch, then exit
+                if nxt is _SHUTDOWN or isinstance(nxt, _PendingEmbed):
+                    # Different program (or exit): never co-batched with
+                    # generate rows — re-queue and run this batch first.
+                    self._q.put(nxt)
                     break
                 batch.append(nxt)
             try:
@@ -481,8 +535,25 @@ class MultihostEngine:
 
         return default_chat_prompt(messages)
 
-    def embed(self, texts: list[str]):
-        raise NotImplementedError("embeddings are single-host serving")
+    def embed(self, texts: list[str]) -> tuple[list[list[float]], int]:
+        """Sequence embeddings over the multi-host mesh: groups of up to
+        R texts ride one lockstep round each (model.embed_pooled, output
+        replicated) — closes the last single-host-only surface."""
+        assert jax.process_index() == 0, "only the leader serves HTTP"
+        ids = [self.tokenizer.encode(t, add_bos=True)[: self.max_seq]
+               for t in texts]
+        n_tokens = sum(len(i) for i in ids)
+        out: list[list[float]] = []
+        for start in range(0, len(ids), self._rows):
+            p = _PendingEmbed(ids_list=ids[start: start + self._rows])
+            self._q.put(p)
+            while not p.event.wait(timeout=0.5):
+                if self._stopped.is_set():
+                    raise RuntimeError("server shutting down")
+            if p.error is not None:
+                raise p.error
+            out.extend(p.vecs)
+        return out, n_tokens
 
     def warmup(self, buckets=(), background: bool = False) -> None:
         return None

@@ -179,6 +179,67 @@ def test_two_process_dp_serving_matches_oracle():
         _shutdown(procs)
 
 
+def _embed_oracle(texts):
+    """Single-process oracle mirroring MultihostEngine.embed's shapes:
+    groups of R=2 rows, padding rows len=1 token 0, length-bucketed."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from p2p_llm_chat_tpu.models import llama
+    from p2p_llm_chat_tpu.models.configs import get_config
+    from p2p_llm_chat_tpu.serve.multihost import _bucket
+    from p2p_llm_chat_tpu.tokenizer import ByteTokenizer
+
+    config = get_config("tiny")
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    tok = ByteTokenizer(vocab_size=config.vocab_size)
+    ids = [tok.encode(t, add_bos=True)[:128] for t in texts]
+    R = 2
+    out = []
+    for start in range(0, len(ids), R):
+        group = ids[start: start + R]
+        lens = np.ones((R,), np.int32)
+        for r, seq in enumerate(group):
+            lens[r] = max(1, len(seq))
+        S = _bucket(int(lens.max()), 128)
+        toks = np.zeros((R, S), np.int32)
+        for r, seq in enumerate(group):
+            toks[r, : len(seq)] = seq
+        vecs = np.asarray(llama.embed_pooled(
+            params, config, jnp.asarray(toks), jnp.asarray(lens)),
+            np.float32)
+        out.extend(vecs[r].tolist() for r in range(len(group)))
+    return out
+
+
+def test_two_process_embed_matches_oracle():
+    """/api/embed over the multi-host mesh (the last single-host-only
+    surface): groups of dp-axis texts per lockstep round, output equal
+    to the single-process pooled-embedding oracle."""
+    coord = f"127.0.0.1:{_free_port()}"
+    serve_port = _free_port()
+    procs = [_spawn(0, coord, serve_port), _spawn(1, coord, serve_port)]
+    try:
+        url = f"http://127.0.0.1:{serve_port}"
+        _wait_up(url, procs)
+        texts = ["alpha embedding", "bravo text", "charlie third"]
+        req = urllib.request.Request(
+            f"{url}/api/embed",
+            data=json.dumps({"model": "tiny", "input": texts}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            resp = json.loads(r.read())
+        got = resp["embeddings"]
+        assert len(got) == 3
+        want = _embed_oracle(texts)
+        import numpy as np
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        _shutdown(procs)
+
+
 def test_two_process_batched_distinct_requests():
     """The round-4 verdict's 'done' bar: 4+ concurrent distinct requests
     at dp=2 across two OS processes, outputs oracle-exact, and a
@@ -211,6 +272,7 @@ def test_two_process_batched_distinct_requests():
         ]
         results = [None] * len(reqs)
         errors = []
+        embed_resp = {}
 
         def worker(i):
             try:
@@ -219,14 +281,31 @@ def test_two_process_batched_distinct_requests():
             except Exception as e:          # noqa: BLE001
                 errors.append((i, e))
 
+        def embed_worker():
+            # Regression: an embed landing inside a generate admission
+            # window must not poison the batch (it once AttributeError'd
+            # the whole round) — it defers to its own lockstep round.
+            try:
+                req = urllib.request.Request(
+                    f"{url}/api/embed",
+                    data=json.dumps({"model": "tiny",
+                                     "input": ["raced embed"]}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    embed_resp.update(json.loads(r.read()))
+            except Exception as e:          # noqa: BLE001
+                errors.append(("embed", e))
+
         threads = [threading.Thread(target=worker, args=(i,))
                    for i in range(len(reqs))]
+        threads.append(threading.Thread(target=embed_worker))
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=180)
         assert not errors, errors
         assert all(r is not None for r in results)
+        assert len(embed_resp.get("embeddings", [])) == 1
 
         for i, r in enumerate(results):
             o = reqs[i]["options"]
