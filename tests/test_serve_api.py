@@ -341,3 +341,50 @@ def test_multi_model_show_falls_back_like_generate():
         assert status == 200 and "details" in body
     finally:
         srv.stop()
+
+
+def test_normalize_request_contract():
+    """Unit pins for the shared admission helper (backend.normalize_request)
+    — the one copy of the Ollama request contract both the single-host
+    scheduler and the multihost engine consume. The drifts it was
+    extracted to prevent (num_predict<=0 semantics, the num_ctx floor)
+    are each pinned directly."""
+    from p2p_llm_chat_tpu.serve.backend import (GenerateOptions,
+                                                GenerateRequest,
+                                                normalize_request)
+    from p2p_llm_chat_tpu.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer(vocab_size=512)
+
+    def norm(prompt="hi", ctx=(), **opts):
+        req = GenerateRequest(prompt=prompt, context=tuple(ctx),
+                              options=GenerateOptions(**opts))
+        return normalize_request(tok, 512, 128, req)
+
+    # Plain prompt: BOS + bytes; default num_predict budgeted to fit.
+    ids, max_new, limit = norm()
+    assert ids[0] == tok.bos_id and len(ids) == 3
+    assert limit == 128 and max_new == 127 - len(ids)
+
+    # num_predict <= 0 means "until EOS / context full", never "0".
+    for npredict in (0, -1):
+        _, max_new, _ = norm(max_tokens=npredict)
+        assert max_new > 1
+
+    # Context ids prepend verbatim (no second BOS).
+    ids, _, _ = norm(prompt="x", ctx=[tok.bos_id, 104, 105])
+    assert ids == [tok.bos_id, 104, 105, ord("x")]
+
+    # Out-of-vocab context fails THIS request cleanly.
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="vocabulary"):
+        norm(ctx=[100000])
+
+    # num_ctx caps below the server max, floored at the min bucket;
+    # truncation keeps the TAIL (recent context wins).
+    long_prompt = "a" * 200
+    ids, max_new, limit = norm(prompt=long_prompt, num_ctx=32)
+    assert limit == 32 and len(ids) == 30
+    assert bytes(ids[-5:]).decode() == "aaaaa"
+    _, _, limit = norm(num_ctx=4)          # floored, not zero/negative
+    assert limit == 16
