@@ -376,8 +376,7 @@ def test_normalize_request_contract():
     assert ids == [tok.bos_id, 104, 105, ord("x")]
 
     # Out-of-vocab context fails THIS request cleanly.
-    import pytest as _pytest
-    with _pytest.raises(ValueError, match="vocabulary"):
+    with pytest.raises(ValueError, match="vocabulary"):
         norm(ctx=[100000])
 
     # num_ctx caps below the server max, floored at the min bucket;
