@@ -46,6 +46,14 @@ Env knobs (all optional):
                         halves KV read traffic, doubles pool capacity;
                         1.5x step at 1024-token windows and the best
                         measured short-window step too — empty disables)
+- ``BENCH_FUSE``        fused multi-step decode: up to K decode steps per
+                        device dispatch (lax.scan over the decode step,
+                        sampling on device — serve/scheduler.py
+                        decode_fuse_max). Default 4; 1 disables. The raw
+                        phase measures the fused program's wall AND
+                        device step so the wall/device gap the fusion
+                        closes is reported explicitly
+                        (``wall_over_device`` in the JSON row)
 - ``BENCH_SPEC``        K>0 = speculative decoding with K drafts/tick
                         (default 0: prompt-lookup drafts cannot match a
                         RANDOM-INIT model's continuations, so on the
@@ -177,6 +185,18 @@ def main() -> None:
     # matching the selected kv_mode). The serve scheduler fuses the
     # projection pairs on single-chip engines (models/llama.fuse_params),
     # so the raw step measures the same fused program.
+    # Loop lengths for the plain and fused measurement phases are fixed
+    # up front so the paged pool below can be sized to the DEEPEST loop:
+    # the plain loop writes n2+1 tokens per measure call; the fused loop
+    # writes (f2+1)*K (the 1/K dispatch scaling has max() floors, so at
+    # large K its token count can EXCEED the plain loop's — an
+    # under-sized pool would silently drop the tail writes past the page
+    # table and publish numbers from a truncated window).
+    fuse_k = max(1, int(os.environ.get("BENCH_FUSE", "4")))
+    n1 = max(16, decode_steps // 4)
+    n2 = max(decode_steps, 2 * n1)      # strictly > n1, or the solve is 0/0
+    f1 = max(4, n1 // fuse_k)
+    f2 = max(2 * f1, n2 // fuse_k)
     raw_params = family.fuse_params(params)
     if kv_mode == "paged":
         from p2p_llm_chat_tpu.ops.paged_kv import PagedKVCache
@@ -187,7 +207,9 @@ def main() -> None:
         # is sized to that actual context — NOT slots x max_seq, which at
         # long BENCH_MAX_SEQ would reserve more HBM than the chip has
         # (the exact failure paging exists to avoid).
-        window_pages = -(-(64 + decode_steps + 1) // page_size)
+        deepest = max(n2 + 1,
+                      (f2 + 1) * fuse_k if fuse_k > 1 else 0)
+        window_pages = -(-(64 + deepest + 1) // page_size)
         mppr = window_pages
         num_pages = slots * mppr + 1
 
@@ -233,8 +255,6 @@ def main() -> None:
         np.asarray(logits[:1, 0, :1])                          # forced sync
         return (time.monotonic() - t) / steps
 
-    n1 = max(16, decode_steps // 4)
-    n2 = max(decode_steps, 2 * n1)      # strictly > n1, or the solve is 0/0
     w1 = min(measure_loop(n1) for _ in range(2))
     w2 = min(measure_loop(n2) for _ in range(2))
     dev_step = (n2 * w2 - n1 * w1) / (n2 - n1)
@@ -245,10 +265,62 @@ def main() -> None:
         dev_step = w2
     rtt_ms = max(0.0, (w1 - dev_step) * n1 * 1e3)
     step_ms = dev_step * 1e3
-    raw_tok_s = slots / dev_step if dev_step > 0 else float("inf")
-    log(f"raw decode: {raw_tok_s:,.0f} tok/s/chip at B={slots} "
+    wall_step_ms = w2 * 1e3
+    log(f"raw decode: {slots / dev_step:,.0f} tok/s/chip at B={slots} "
         f"({step_ms:.2f} ms/step device; wall {w2*1e3:.2f} ms/step at "
         f"N={n2}, tunnel RTT ~{rtt_ms:.0f} ms)")
+
+    # -- fused multi-step decode: K steps per dispatch (the tentpole of
+    # the wall/device-gap work). Same greedy feed as serving's fused
+    # path but sampling reduced to on-device argmax — the raw number
+    # isolates model + dispatch, not sampling options. Loop lengths
+    # (f1/f2 above) scale ~1/K so both measurements cover a comparable
+    # token count and attention growth (fair wall comparison; the pool
+    # is sized for whichever loop runs deeper).
+    fused_step_ms = fused_wall_step_ms = None
+    if fuse_k > 1:
+        def _fused(params, tokens, cache, active):
+            def sample_fn(lg, state, emit_pos, act):
+                return jnp.argmax(lg, axis=-1).astype(jnp.int32), state
+            kw = (dict(pages=window_pages) if kv_mode == "paged" else {})
+            toks_all, _, nxt, cache, _, _ = family.decode_fused(
+                params, config, tokens, cache, active=active,
+                num_steps=fuse_k, sample_fn=sample_fn, sample_state=(),
+                stop_ids=np.zeros((0,), np.int32), **kw)
+            return toks_all, nxt, cache
+
+        fused_j = jax.jit(_fused, donate_argnums=(2,))
+
+        def measure_loop_fused(n_disp: int) -> float:
+            cache = make_raw_cache()
+            toks_all, nxt, cache = fused_j(raw_params, toks, cache, active)
+            np.asarray(toks_all[:1, :1])
+            t = time.monotonic()
+            for _ in range(n_disp):
+                toks_all, nxt, cache = fused_j(raw_params, nxt, cache,
+                                               active)
+            np.asarray(toks_all[:1, :1])
+            return (time.monotonic() - t) / n_disp
+
+        fw1 = min(measure_loop_fused(f1) for _ in range(2))
+        fw2 = min(measure_loop_fused(f2) for _ in range(2))
+        fdev = (f2 * fw2 - f1 * fw1) / (f2 - f1)
+        if fdev < 0.05 * fw2:
+            fdev = fw2
+        fused_step_ms = fdev / fuse_k * 1e3
+        fused_wall_step_ms = fw2 / fuse_k * 1e3
+        log(f"fused decode (K={fuse_k}): "
+            f"{slots / (fdev / fuse_k):,.0f} tok/s/chip device-basis "
+            f"({fused_step_ms:.2f} ms/step device; wall "
+            f"{fused_wall_step_ms:.2f} ms/step at N={f2}x{fuse_k}; "
+            f"wall/device {fused_wall_step_ms / step_ms:.2f}x vs plain "
+            f"{wall_step_ms / step_ms:.2f}x)")
+
+    # Raw tok/s, device basis (r05's definition — slots / device step):
+    # the fused program's per-token device step when fusion is on (the
+    # scan drops per-step dispatch work the plain loop still pays).
+    best_dev_ms = min(step_ms, fused_step_ms or step_ms)
+    raw_tok_s = slots / (best_dev_ms / 1e3)
     # Free the fused weight copy before the serving phase allocates its
     # own fused params + KV pool — three copies of the projection
     # weights would shrink the HBM headroom the serving numbers measure.
@@ -285,7 +357,7 @@ def main() -> None:
                            page_size=page_size, num_pages=serve_pages,
                            admit_chunk=admit_chunk,
                            spec_k=spec_k, prefix_cache=use_prefix,
-                           kv_quant=kv_quant)
+                           kv_quant=kv_quant, decode_fuse_max=fuse_k)
     # BENCH_TEMP=0 (greedy) is the honest speculative-decoding workload:
     # prompt-lookup drafts only land when the model's continuation repeats
     # earlier n-grams, which greedy decoding does and temperature-0.7
@@ -313,7 +385,9 @@ def main() -> None:
     pbucket = _bucket(min(plen, eff_max - 2), eff_max)
     buckets = tuple(sorted({64, 128, pbucket} if use_prefix
                            else {128, pbucket}))
-    need = min(plen + new_tokens + spec_k + 2, eff_max)
+    # Fused ticks read up to (pipelined + fused) steps past the context;
+    # cover them so no decode window compiles lazily mid-bench.
+    need = min(plen + new_tokens + spec_k + 2 * fuse_k + 2, eff_max)
     ws, w = [], 128
     while True:
         ws.append(w)
@@ -346,7 +420,8 @@ def main() -> None:
             th.join()
     wall = time.monotonic() - t
     spec_stats = {k: v for k, v in sched.metrics_snapshot().items()
-                  if ("spec" in k and spec_k) or ("prefix" in k and use_prefix)}
+                  if ("spec" in k and spec_k) or ("prefix" in k and use_prefix)
+                  or k.startswith("decode_")}
     ttfts = sorted(s.ttft_s * 1e3 for s in all_stats if s.ttft_s is not None)
     done_tokens = sum(s.completion_tokens for s in all_stats)
     p50 = statistics.median(ttfts)
@@ -381,6 +456,18 @@ def main() -> None:
             "max_seq": max_seq,
             "raw_decode_tok_s_per_chip": round(raw_tok_s, 1),
             "decode_step_ms": round(step_ms, 3),
+            "decode_wall_step_ms": round(wall_step_ms, 3),
+            # Fused multi-step decode (BENCH_FUSE): per-token device and
+            # wall step of the K-step scan program, and the wall/device
+            # ratio the fusion is meant to close (target <= 1.15 at
+            # B=32; 1.56 in BENCH_r05 before fusion).
+            "decode_fused_k": fuse_k if fuse_k > 1 else None,
+            "decode_fused_step_ms": (round(fused_step_ms, 3)
+                                     if fused_step_ms else None),
+            "decode_fused_wall_step_ms": (round(fused_wall_step_ms, 3)
+                                          if fused_wall_step_ms else None),
+            "wall_over_device": round(
+                (fused_wall_step_ms or wall_step_ms) / step_ms, 3),
             "ttft_single_ms": round(ttft_single_ms, 2),
             # TTFT pays at least one dispatch+readback of tunnel RTT
             # that a local v5e host would not; this subtracts the

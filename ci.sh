@@ -31,8 +31,17 @@ if [ "${1:-}" = "full" ]; then
   echo "== full test suite"
   python -m pytest tests/ -q || rc=1
 else
+  # Fused-decode parity pinned explicitly on CPU: the K-fused-steps ≡
+  # K-plain-ticks bit-identity contract (serve/scheduler.py
+  # decode_fuse_max) must hold on the hermetic platform regardless of
+  # what accelerator the host exposes. Runs here, excluded from the
+  # generic sweep below so it executes exactly once.
+  echo "== fused-decode parity (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_fused_decode.py -q -x || rc=1
+
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
+    --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_stress.py \
     --ignore=tests/test_serve_tp.py \
     --ignore=tests/test_mixtral_parity.py \

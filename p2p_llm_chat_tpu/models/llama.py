@@ -611,6 +611,76 @@ def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
     return logits, cache._replace(lengths=cache.lengths + inc)
 
 
+def decode_fused(params: dict, config: ModelConfig, tokens: jax.Array,
+                 cache, mesh: Optional[Mesh] = None,
+                 rules: LogicalRules = DEFAULT_RULES,
+                 active: Optional[jax.Array] = None, *,
+                 num_steps: int, sample_fn, sample_state, stop_ids,
+                 kv_window: Optional[int] = None,
+                 pages: Optional[int] = None,
+                 interpret: Optional[bool] = None,
+                 step_fn=None):
+    """``num_steps`` autoregressive steps in ONE dispatch: a ``lax.scan``
+    over :func:`decode_step` (dense) / :func:`decode_step_paged`
+    (``pages`` set) carrying the cache, the sampled next-token feed, the
+    active mask, and the caller's sampling state — so K decode steps cost
+    one host dispatch/readback instead of K (the host-side per-dispatch
+    overhead was ~a third of every decode tick at B=32; BENCH_r05).
+
+    Each scan step IS the plain step — the same ``decode_step[_paged]``
+    call, then ``sample_fn(logits [B,V], state, emit_pos [B], active
+    [B]) -> (tokens [B] int32, state)`` (the scheduler passes
+    models/sampling.sample_step_batched, the shared sample+penalty-ring
+    implementation) — so the emitted stream is bit-identical to K
+    sequential plain ticks: same logits, same key splits, same ring
+    updates (pinned by tests/test_fused_decode.py).
+
+    **EOS parks inside the scan**: a row whose sampled token is in
+    ``stop_ids`` ([n] int32; () disables) retires mid-fusion — its
+    length stops advancing, its ring writes drop, and its next-token
+    feed freezes, exactly the state the host-side release would have
+    produced between two plain ticks. Later positions of a retired row
+    are garbage the caller discards (the host stops consuming a row's
+    burst at its stop token). The caller guarantees every active row can
+    absorb ``num_steps`` tokens of KV budget (the scheduler's adaptive-K
+    guard); EOS is the only mid-scan retirement.
+
+    Returns (tokens [num_steps, B] int32, emitted [num_steps, B] bool —
+    whether the row was live when that step sampled, next_tokens [B,1],
+    cache, active [B], sample_state).
+    """
+    if step_fn is None:
+        step_fn = decode_step if pages is None else decode_step_paged
+    B = tokens.shape[0]
+    if active is None:
+        active = jnp.ones((B,), bool)
+    stop = jnp.asarray(stop_ids, jnp.int32).reshape(-1)
+
+    def step(carry, _):
+        tokens, cache, act, state = carry
+        emit_pos = cache.lengths + 1       # emitted token's context slot
+        if pages is None:
+            logits, cache = step_fn(params, config, tokens, cache, mesh,
+                                    rules, active=act, kv_window=kv_window)
+        else:
+            logits, cache = step_fn(params, config, tokens, cache, mesh,
+                                    rules, active=act, pages=pages,
+                                    interpret=interpret)
+        toks, state = sample_fn(logits[:, 0, :], state, emit_pos, act)
+        # Parked rows keep their previous input token (the plain
+        # program's exact next-token rule).
+        next_tokens = jnp.where(act[:, None], toks[:, None], tokens)
+        emitted = act
+        if stop.shape[0]:
+            act = act & jnp.all(toks[:, None] != stop[None, :], axis=1)
+        return (next_tokens, cache, act, state), (toks, emitted)
+
+    (tokens, cache, active, sample_state), (toks_all, emitted) = \
+        jax.lax.scan(step, (tokens, cache, active, sample_state), None,
+                     length=num_steps)
+    return toks_all, emitted, tokens, cache, active, sample_state
+
+
 def verify_step(params: dict, config: ModelConfig, tokens: jax.Array,
                 cache: KVCache, mesh: Optional[Mesh] = None,
                 rules: LogicalRules = DEFAULT_RULES,
@@ -783,8 +853,7 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     ordering (their kernels read the pool for every position).
     """
     from ..ops import paged_attention
-    from ..ops.paged_kv import (PagedKVCache, write_decode,
-                                write_decode_all_layers)
+    from ..ops.paged_kv import PagedKVCache, write_decode, write_decode_burst
     from ..ops.paged_attention import _DEFAULT_IMPL, paged_attention_append
 
     if interpret is None:
@@ -819,8 +888,7 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
 
         h, (k_all, v_all) = jax.lax.scan(
             body, h, jnp.arange(config.num_layers))
-        cache = write_decode_all_layers(cache, k_all, v_all)
-        return finish(h), cache._replace(lengths=cache.lengths + inc)
+        return finish(h), write_decode_burst(cache, k_all, v_all, inc)
 
     def body(carry, layer):
         h, pk, pv, sk, sv = carry

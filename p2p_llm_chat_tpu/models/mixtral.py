@@ -326,6 +326,26 @@ def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
     return logits, cache._replace(lengths=cache.lengths + inc)
 
 
+def decode_fused(params: dict, config: ModelConfig, tokens: jax.Array,
+                 cache, mesh: Optional[Mesh] = None,
+                 rules: LogicalRules = DEFAULT_RULES,
+                 active: Optional[jax.Array] = None, *,
+                 num_steps: int, sample_fn, sample_state, stop_ids,
+                 kv_window: Optional[int] = None,
+                 pages: Optional[int] = None,
+                 interpret: Optional[bool] = None):
+    """llama.decode_fused over the MoE step functions (same contract:
+    K steps, one dispatch, in-scan EOS parking, bit-identical to K
+    sequential plain ticks)."""
+    step_fn = decode_step if pages is None else decode_step_paged
+    return llama.decode_fused(params, config, tokens, cache, mesh, rules,
+                              active, num_steps=num_steps,
+                              sample_fn=sample_fn,
+                              sample_state=sample_state, stop_ids=stop_ids,
+                              kv_window=kv_window, pages=pages,
+                              interpret=interpret, step_fn=step_fn)
+
+
 def verify_step(params: dict, config: ModelConfig, tokens: jax.Array,
                 cache: KVCache, mesh: Optional[Mesh] = None,
                 rules: LogicalRules = DEFAULT_RULES,

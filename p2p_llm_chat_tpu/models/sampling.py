@@ -154,6 +154,40 @@ def sample_batched(logits: jax.Array, keys: jax.Array, temperature: jax.Array,
     return tok, new_keys
 
 
+def sample_step_batched(logits: jax.Array, keys: jax.Array,
+                        temperature: jax.Array, top_k: jax.Array,
+                        top_p: jax.Array, *, ring: jax.Array, rp: jax.Array,
+                        emit_pos: jax.Array, active: jax.Array,
+                        top_c: int = 64
+                        ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode tick's sample + penalty-ring update, scan-carry shaped.
+
+    The fused multi-step decode path (models/llama.decode_fused) carries
+    (keys, ring) through a ``lax.scan`` and the plain one-step decode
+    program applies the identical ops once — both MUST route through this
+    single implementation, or the fused path's bit-identity-to-K-plain-
+    ticks contract (serve/scheduler.py) silently breaks the first time
+    one copy drifts.
+
+    logits: [B,V] f32; keys/temperature/top_k/top_p/rp: [B] per-row
+    state; ring: [B,R] recent-token penalty window; emit_pos: [B]
+    absolute context position of the emitted token (pre-advance lengths
+    + 1 — the caller computes it BEFORE the decode step advances
+    lengths); active: [B] — parked rows' ring writes drop via the
+    out-of-range column sentinel, and their key still splits (the same
+    unconditional split the plain program always did, so fused and
+    plain key streams agree row-for-row).
+
+    Returns (tokens [B] int32, advanced keys [B,2], updated ring [B,R]).
+    """
+    toks, keys = sample_batched(logits, keys, temperature, top_k, top_p,
+                                top_c=top_c, ring=ring, rp=rp)
+    B, R = ring.shape
+    idx = jnp.where(active, emit_pos % R, R)
+    ring = ring.at[jnp.arange(B), idx].set(toks, mode="drop")
+    return toks, keys, ring
+
+
 def spec_verify_batched(logits: jax.Array, drafts: jax.Array,
                         keys: jax.Array, temperature: jax.Array,
                         top_k: jax.Array, top_p: jax.Array,

@@ -336,6 +336,43 @@ class UnsupportedForQuantizedLoad(ValueError):
     which must propagate."""
 
 
+# Fields that determine whether a caller-supplied config names the SAME
+# MODEL as a checkpoint: every tensor-shape-bearing field (plus the
+# registry name, native checkpoints only — see _check_config_identity).
+# Deliberately excluded: max_seq_len, rope_theta/rope_scaling, eps,
+# token-id defaults, moe_capacity_factor — serving/runtime knobs that
+# registry bumps legitimately change without re-saving weights (e.g. the
+# bench-1b max_seq_len 2048 -> 16384 bump for long-context rows, which
+# the old whole-dataclass equality would have rejected for every
+# pre-existing native checkpoint).
+_CONFIG_IDENTITY_FIELDS = (
+    "vocab_size", "hidden_size", "intermediate_size", "num_layers",
+    "num_heads", "num_kv_heads", "head_dim", "tie_embeddings",
+    "num_experts", "num_experts_per_tok",
+)
+
+
+def _check_config_identity(supplied: ModelConfig, stored: ModelConfig,
+                           ckpt_dir: str, check_name: bool = True) -> None:
+    """Raise unless ``supplied`` names the same model as the checkpoint's
+    own ``stored`` config — identity-relevant fields only (see
+    _CONFIG_IDENTITY_FIELDS). On agreement the SUPPLIED config wins:
+    honoring its benign (non-shape) field bumps is the point.
+
+    ``check_name``: native checkpoints carry the registry name they were
+    saved under, so name disagreement means a different model; HF dirs
+    derive ``name`` from config.json's ``_name_or_path`` (or the literal
+    "hf-model"), which can NEVER equal a registry name — the HF branch
+    passes False and lets the shape fields alone establish identity."""
+    fields = _CONFIG_IDENTITY_FIELDS + (("name",) if check_name else ())
+    bad = [f for f in fields if getattr(supplied, f) != getattr(stored, f)]
+    if bad:
+        raise ValueError(
+            f"config mismatch: caller passed {supplied.name!r} but the "
+            f"checkpoint at {ckpt_dir} carries {stored.name!r} "
+            f"(differing identity fields: {', '.join(bad)})")
+
+
 def load_checkpoint_quantized(ckpt_dir: str,
                               config: Optional[ModelConfig] = None,
                               ) -> tuple[dict, ModelConfig]:
@@ -382,6 +419,17 @@ def load_checkpoint_quantized(ckpt_dir: str,
     if config is None:
         config = (peek_config(ckpt_dir) if native else
                   config_from_hf_json(os.path.join(ckpt_dir, "config.json")))
+    else:
+        # A caller-supplied config must name the same MODEL as the
+        # checkpoint — identity fields only, so benign registry bumps
+        # (max_seq_len, rope knobs) survive pre-existing checkpoints.
+        # Applied to BOTH branches: the HF path used to skip the check
+        # entirely (silently trusting the caller), the native one used
+        # whole-dataclass equality (rejecting every benign bump).
+        stored = (peek_config(ckpt_dir) if native else
+                  config_from_hf_json(os.path.join(ckpt_dir,
+                                                   "config.json")))
+        _check_config_identity(config, stored, ckpt_dir, check_name=native)
     family = family_for(config)
     if family not in (llama, mixtral):
         raise UnsupportedForQuantizedLoad(
@@ -397,15 +445,13 @@ def load_checkpoint_quantized(ckpt_dir: str,
     if native:
         cpu = jax.devices("cpu")[0]
         host_params, loaded_cfg = load_native(ckpt_dir, device=cpu)
-        # A caller-supplied config must agree with the checkpoint's own —
-        # silently overwriting it made the native path inconsistent with
-        # the HF branch, which honors the parameter (ADVICE r4).
-        if config != loaded_cfg:
-            raise ValueError(
-                f"config mismatch: caller passed {config.name!r} but the "
-                f"native checkpoint at {ckpt_dir} carries "
-                f"{loaded_cfg.name!r}")
-        config = loaded_cfg
+        # Identity agreement with the caller's config was checked above
+        # (relaxed to _CONFIG_IDENTITY_FIELDS — ADVICE r4's consistency
+        # point, minus the whole-dataclass equality that rejected benign
+        # runtime-field bumps); re-verify against the ACTUALLY-loaded
+        # config in case peek and load ever disagree. The supplied
+        # config stays authoritative for non-identity fields.
+        _check_config_identity(config, loaded_cfg, ckpt_dir)
 
         def layer_host(li: int) -> dict[str, np.ndarray]:
             lp = host_params["layers"]

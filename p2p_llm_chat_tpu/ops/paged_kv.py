@@ -412,6 +412,36 @@ def write_decode_all_layers(cache: PagedKVCache, k_all: jax.Array,
                            upd.transpose(1, 0, 2), mode="drop"))
 
 
+def write_decode_burst(cache: PagedKVCache, k_all: jax.Array,
+                       v_all: jax.Array, inc: jax.Array) -> PagedKVCache:
+    """Land one decode step for the whole stack and advance: scatter
+    every layer's k/v at each row's current slot
+    (:func:`write_decode_all_layers`) and bump ``lengths`` by ``inc``
+    ([B] int32 — the active mask; parked rows hold position so their
+    next write overwrites the same slot).
+
+    This is the per-step mutation both the plain decode tick and the
+    fused multi-step scan body (models/llama.decode_fused — K of these
+    back to back inside one dispatch) run, kept as ONE function so the
+    write/advance ordering cannot drift between the paths: the advance
+    must follow the scatter, or a fused step would write its token one
+    slot deep and the K-fused-ticks ≡ K-plain-ticks contract breaks.
+
+    Rejected alternative, for the record: carrying the fused tick's K
+    tokens in-register and landing them ONCE via
+    :func:`write_decode_multi_all_layers` (the spec-verify multi-token
+    append) would save K-1 pool scatters — but on int8 pools the later
+    steps would then attend EARLIER same-tick tokens at full precision
+    where sequential ticks read them back quantized, so fused output
+    would drift from plain ticks on logit ties (the exact caveat
+    verify_append documents for drafts). Bit-identity outranks the
+    scatter savings; the dispatch overhead fusion targets is host-side
+    anyway.
+    """
+    cache = write_decode_all_layers(cache, k_all, v_all)
+    return cache._replace(lengths=cache.lengths + inc)
+
+
 def _multi_write_indices(cache: PagedKVCache,
                          S: int) -> tuple[jax.Array, jax.Array]:
     """(phys, slot) [B,S] for S consecutive candidate positions per row.

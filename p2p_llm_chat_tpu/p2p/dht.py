@@ -296,6 +296,11 @@ class DHTNode:
         self._evict_mu = threading.Lock()
         self._challenging: set[str] = set()
         self._challenge_mu = threading.Lock()
+        # Destination-resolution memo (_resolve_dst): hostname -> IP, so
+        # a slow DNS server is consulted once per destination, not on
+        # every RPC. Bounded; numeric IPs never enter it.
+        self._resolve_cache: dict[str, str] = {}
+        self._resolve_mu = threading.Lock()
         self._closed = threading.Event()
         self._rx: Optional[threading.Thread] = None
         # One long-lived pool for lookup/store fan-out — per-round executor
@@ -447,17 +452,19 @@ class DHTNode:
         hits: list = []
         # dst rides the entry so the response path can require the reply
         # to come from the address we actually queried before it may
-        # update the routing table. Resolve hostname dsts first:
-        # recvfrom reports the numeric source IP, so a literal hostname
-        # tuple would never match its own replies and seed bootstrap
+        # update the routing table. Hostname dsts resolve first
+        # (_resolve_dst — numeric-IP fast path, memoized DNS): recvfrom
+        # reports the numeric source IP, so a literal hostname tuple
+        # would never match its own replies and seed bootstrap
         # (DHT_BOOTSTRAP=host:port) would silently never table the seed.
         # (A multihomed peer replying from a different interface IP is
         # still skipped for the table update — the response itself
         # delivers; the peer enters the table on a later direct answer.)
-        try:
-            dst_ip = socket.gethostbyname(dst[0])
-        except OSError:
-            dst_ip = dst[0]
+        # Resolution happens BEFORE taking _pending_mu: the RX thread
+        # needs that lock to dispatch every response, so a blocking
+        # gethostbyname inside it would stall the whole node's response
+        # path for the resolver timeout.
+        dst_ip = self._resolve_dst(dst[0])
         with self._pending_mu:
             self._pending[rid] = (ev, hits, (dst_ip, dst[1]))
         try:
@@ -470,6 +477,43 @@ class DHTNode:
         finally:
             with self._pending_mu:
                 self._pending.pop(rid, None)
+
+    def _resolve_dst(self, host: str) -> str:
+        """Destination IP for the response-address match (see _rpc).
+
+        Numeric IPv4 literals — the overwhelmingly common case: every
+        contact learned from the wire already carries one — pass through
+        on an ``inet_aton`` probe without ever touching the resolver;
+        only operator-supplied bootstrap HOSTNAMES resolve, and each
+        resolves once per node lifetime (memoized) so a slow or dead DNS
+        server cannot stall every RPC behind a synchronous
+        ``gethostbyname``. Resolution FAILURES are not memoized: DNS
+        flakiness at boot must not pin a hostname to itself forever —
+        the next RPC retries. Staleness trade-off: a re-pointed
+        bootstrap hostname is not picked up until restart; bootstrap
+        seeds are static operator config, and the cost of the
+        alternative was a resolver call on the hot path of every RPC."""
+        try:
+            # Normalized via ntoa, not returned verbatim: inet_aton also
+            # accepts abbreviated forms ('127.1', '10.1.2') that would
+            # never equal recvfrom's canonical source IP — the response
+            # match would then silently skip tabling the peer.
+            return socket.inet_ntoa(socket.inet_aton(host))
+        except OSError:
+            pass
+        with self._resolve_mu:
+            ip = self._resolve_cache.get(host)
+        if ip is not None:
+            return ip
+        try:
+            ip = socket.gethostbyname(host)
+        except OSError:
+            return host                       # transient: retry next RPC
+        with self._resolve_mu:
+            if len(self._resolve_cache) >= 256:
+                self._resolve_cache.clear()   # bounded, rebuilds on use
+            self._resolve_cache[host] = ip
+        return ip
 
     # -- routing-table maintenance -------------------------------------------
 

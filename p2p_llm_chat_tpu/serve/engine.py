@@ -26,6 +26,8 @@ Env surface (reference-style env-first config, utils/env.py):
 ``SERVE_ADMIT_CHUNK``, ``SERVE_QUEUE_TIMEOUT`` (seconds, 0 disables),
 ``SERVE_QUANT`` (int8 = weight-only quantization, models/quant.py),
 ``SERVE_SPEC`` (K>0 = speculative decoding with prompt-lookup drafts),
+``SERVE_FUSE`` (fused multi-step decode: up to K decode steps per device
+dispatch, adaptive; default 4, 1 disables),
 ``SERVE_PREFIX`` (shared-prefix KV caching, serve/prefix.py; default on),
 ``SERVE_PREFIX_TEXTS`` (extra templates to pre-register, ``||``-separated;
 the reference co-pilot template is always registered),
@@ -76,7 +78,8 @@ class TPUEngine:
                  spec_k: int = 0,
                  prefix_cache: bool = True,
                  prefix_texts: tuple[str, ...] = (SUGGEST_PREFIX,),
-                 kv_quant: bool = False) -> None:
+                 kv_quant: bool = False,
+                 decode_fuse_max: int = 4) -> None:
         self.name = name or config.name
         self.config = config
         self.prefix_texts = tuple(prefix_texts) if prefix_cache else ()
@@ -91,7 +94,8 @@ class TPUEngine:
                                         queue_timeout_s=queue_timeout_s,
                                         spec_k=spec_k,
                                         prefix_cache=prefix_cache,
-                                        kv_quant=kv_quant)
+                                        kv_quant=kv_quant,
+                                        decode_fuse_max=decode_fuse_max)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
@@ -226,6 +230,9 @@ def build_engine_from_env() -> Backend:
     qt = float(env_or("SERVE_QUEUE_TIMEOUT", "60"))
     queue_timeout_s = qt if qt > 0 else None
     spec_k = env_int("SERVE_SPEC", 0)
+    # Fused multi-step decode: up to this many decode steps per device
+    # dispatch (adaptive — see scheduler.decode_fuse_max). 1 disables.
+    decode_fuse_max = max(1, env_int("SERVE_FUSE", 4))
     prefix_cache = env_bool("SERVE_PREFIX", True)
     prefix_texts = (SUGGEST_PREFIX,) + tuple(
         t for t in env_or("SERVE_PREFIX_TEXTS", "").split("||") if t)
@@ -280,7 +287,8 @@ def build_engine_from_env() -> Backend:
                          queue_timeout_s=queue_timeout_s, spec_k=spec_k,
                          prefix_cache=prefix_cache,
                          prefix_texts=prefix_texts, name=name,
-                         kv_quant=bool(kv_quant))
+                         kv_quant=bool(kv_quant),
+                         decode_fuse_max=decode_fuse_max)
 
     def warmup_buckets():
         warmup = env_or("SERVE_WARMUP", "128,256")

@@ -101,6 +101,11 @@ class _Pending:
     # repeat_milli, seed32] — clamped per-request in generate_stream so a
     # malformed request fails alone instead of erroring its whole batch.
     fields: tuple = ()
+    # Ollama num_predict <= 0 ("until EOS / context full"): max_new is
+    # the whole context budget, so co-batching it would run every row's
+    # round for up to that many lockstep steps — the dispatcher runs
+    # unbounded requests in their OWN rounds (docs/serving.md HOL note).
+    unbounded: bool = False
     event: threading.Event = field(default_factory=threading.Event)
     text: str = ""
     out_ids: list = field(default_factory=list)   # generated ids as recorded
@@ -196,9 +201,16 @@ class MultihostEngine:
 
         @functools.partial(jax.jit, donate_argnums=(2,),
                            out_shardings=(NamedSharding(mesh, P()), None))
-        def _decode(params, tokens, cache):
+        def _decode(params, tokens, cache, active):
+            # active = ~done: retired rows PARK (single-host scheduler's
+            # parked-row invariant) — their lengths stop advancing, so a
+            # row that finished early never walks its KV write position
+            # toward the budget edge while the longest row drains, and
+            # its per-step write keeps overwriting the same untrusted
+            # slot. Every process computes the same done mask from the
+            # same command, so the mask cannot desync the lockstep.
             logits, cache = model.decode_step(params, config_, tokens,
-                                              cache, mesh_)
+                                              cache, mesh_, active=active)
             return logits.astype(jnp.float32), cache
 
         self._decode_j = _decode
@@ -326,7 +338,8 @@ class MultihostEngine:
             if done.all():
                 break
             lg, cache = self._decode_j(self._params,
-                                       jnp.asarray(nxt[:, None]), cache)
+                                       jnp.asarray(nxt[:, None]), cache,
+                                       jnp.asarray(~done))
             last = np.asarray(lg)[:, 0]
         return out_ids[:n_active]
 
@@ -377,8 +390,16 @@ class MultihostEngine:
             self._stopped.set()
 
     def _dispatch_loop_inner(self) -> None:
+        # An item displaced out of a round (embed / unbounded / shutdown
+        # encountered mid-fill) is HELD as the next round's head, never
+        # re-queued to the back — a put() would park it behind every
+        # newly arrived request, and sustained bounded traffic could
+        # then starve it indefinitely (re-encountered and re-queued
+        # every round). Holding it bounds the wait to one round.
+        held = None
         while True:
-            item = self._q.get()
+            item = held if held is not None else self._q.get()
+            held = None
             if item is _SHUTDOWN:
                 try:
                     cmd = np.zeros((self._cmd_size,), np.int32)
@@ -416,7 +437,12 @@ class MultihostEngine:
                 continue
             batch = [item]
             deadline = time.monotonic() + self.window_s
-            while len(batch) < self._rows:
+            # A round costs max(max_new) lockstep steps for EVERY row, so
+            # an unbounded (num_predict <= 0) request would couple each
+            # co-batched peer's latency to its whole context budget —
+            # head-of-line blocking measured in hundreds of steps. It
+            # runs alone; bounded requests keep batching.
+            while not item.unbounded and len(batch) < self._rows:
                 left = deadline - time.monotonic()
                 if left <= 0:
                     break
@@ -424,10 +450,13 @@ class MultihostEngine:
                     nxt = self._q.get(timeout=left)
                 except queue.Empty:
                     break
-                if nxt is _SHUTDOWN or isinstance(nxt, _PendingEmbed):
-                    # Different program (or exit): never co-batched with
-                    # generate rows — re-queue and run this batch first.
-                    self._q.put(nxt)
+                if (nxt is _SHUTDOWN or isinstance(nxt, _PendingEmbed)
+                        or nxt.unbounded):
+                    # Different program, exit, or an unbounded request
+                    # (solo round by policy): never co-batched with
+                    # these rows — hold it as the NEXT round's head and
+                    # run this batch first (see the loop-head note).
+                    held = nxt
                     break
                 batch.append(nxt)
             try:
@@ -474,7 +503,8 @@ class MultihostEngine:
         ids, max_new, _ = normalize_request(
             self.tokenizer, self.config.vocab_size, self.max_seq, req)
         pending = _Pending(req=req, ids=list(ids), max_new=max_new,
-                           fields=fields)
+                           fields=fields,
+                           unbounded=req.options.max_tokens <= 0)
         t0 = time.monotonic()
         self._q.put(pending)
 

@@ -56,7 +56,7 @@ from ..models import family_for
 from ..models.configs import ModelConfig
 from ..models.layers import causal_mask
 from ..models.llama import KVCache
-from ..models.sampling import sample_batched
+from ..models.sampling import sample_batched, sample_step_batched
 from ..tokenizer import Tokenizer
 from ..utils.log import get_logger
 from .backend import GenerateRequest, RequestStats, normalize_request
@@ -178,7 +178,8 @@ class BatchScheduler:
                  spec_k: int = 0,
                  prefix_cache: bool = False,
                  prefix_promote_after: int = 2,
-                 kv_quant: bool = False) -> None:
+                 kv_quant: bool = False,
+                 decode_fuse_max: int = 4) -> None:
         """``admit_chunk``: burst-admission width. None (default) admits a
         backlog burst through one full-width prefill (minimal dispatches —
         best p95/throughput); a fixed power-of-two (e.g. 8) staggers the
@@ -208,6 +209,16 @@ class BatchScheduler:
         EARLIER drafts are unquantized where the plain path, once they
         commit, reads them quantized — logit ties can flip
         (ops/paged_attention.paged_attention_verify_append).
+
+        ``decode_fuse_max``: fused multi-step decode — one dispatch runs
+        up to this many decode steps as an on-device ``lax.scan``
+        (models/llama.decode_fused), amortising the per-tick host
+        dispatch + readback (a third of the B=32 decode tick wall,
+        BENCH_r05) by K. K adapts per tick: 1 whenever admissions are
+        pending, speculation could run, or any row is within K tokens of
+        its budget; otherwise it doubles up to this cap. 1 disables.
+        Output is bit-identical to plain ticks (same programs per step,
+        same key/ring streams; EOS parks rows inside the scan).
 
         ``prefix_cache``: shared-prefix KV caching (serve/prefix.py).
         Prompts that begin with a cached prefix (the co-pilot template,
@@ -322,6 +333,24 @@ class BatchScheduler:
         self._promote_done: "queue.Queue[tuple]" = queue.Queue()
         self._promote_pending: set = set()    # submitted, not yet integrated
         self._promote_worker: Optional[threading.Thread] = None
+        # Fused multi-step decode state (tentpole of the wall/device-gap
+        # work): the ramp remembers the last dispatched K, the counters
+        # feed /metrics (decode_fused_* — realized K is steps/dispatches),
+        # and the wall histogram samples steady-state per-step wall time.
+        if decode_fuse_max < 1:
+            raise ValueError(
+                f"decode_fuse_max must be >= 1, got {decode_fuse_max}")
+        self.decode_fuse_max = decode_fuse_max
+        self._fuse_ramp = 1
+        self._n_fused_ticks = 0       # dispatches with K > 1
+        self._n_fused_steps = 0       # decode steps inside fused dispatches
+        self._n_decode_steps = 0      # decode steps across plain dispatches
+        self._n_spec_ticks = 0        # speculative dispatches (no K; they
+                                      # must not dilute the realized mean)
+        self._last_dispatch: Optional[tuple[float, int]] = None
+        from ..utils.metrics import Histogram
+        self._wall_hist = Histogram("decode_wall_ms")
+        self._decode_device_ms = 0.0  # measured once at warmup (probe)
         # Adaptive speculation: EMA of accepted drafts per spec tick.
         # The verify forward computes K+1 positions for every row, so
         # when drafts stop landing (non-repetitive output), paying it
@@ -339,7 +368,7 @@ class BatchScheduler:
                 # The emitted token's context position is lengths+1 (the
                 # INPUT token occupies lengths) — writing at lengths would
                 # clobber the previous tick's emission in the ring.
-                ring_pos = (cache.lengths + 1) % _RING
+                emit_pos = cache.lengths + 1
                 if self.kv_mode == "paged":
                     pages = -(-kv_window // self.page_size)
                     logits, cache = model.decode_step_paged(
@@ -349,13 +378,13 @@ class BatchScheduler:
                     logits, cache = model.decode_step(
                         params, config, tokens, cache, mesh, active=active,
                         kv_window=kv_window)
-                toks, keys = sample_batched(logits[:, 0, :], keys, temps,
-                                            top_ks, top_ps, ring=ring, rp=rps)
-                # The emitted token enters the penalty ring at its context
-                # position (parked rows' writes drop via the idx sentinel).
-                B = toks.shape[0]
-                idx = jnp.where(active, ring_pos, _RING)
-                ring = ring.at[jnp.arange(B), idx].set(toks, mode="drop")
+                # Shared sample + penalty-ring step (parked rows' ring
+                # writes drop) — the ONE implementation the fused path's
+                # scan body also runs, so fused-K output stays
+                # bit-identical to K plain ticks.
+                toks, keys, ring = sample_step_batched(
+                    logits[:, 0, :], keys, temps, top_ks, top_ps, ring=ring,
+                    rp=rps, emit_pos=emit_pos, active=active)
                 # Parked rows keep their previous input token so their
                 # (ignored) next step stays stable regardless of their
                 # garbage sample.
@@ -365,6 +394,42 @@ class BatchScheduler:
 
         self._make_decode = _make_decode
         self._decode_programs: dict[int, object] = {}
+
+        def _make_decode_fused(kv_window: int, K: int):
+            """Fused K-step decode program (models/llama.decode_fused):
+            one dispatch runs K scan steps, each the exact plain-step
+            computation — decode + on-device sampling + ring update —
+            carrying cache/next-token/keys/ring/active on device. EOS
+            parks rows mid-scan (see decode_fused). Readback shrinks to
+            K*B int32 per K tokens instead of K round-trips — the
+            host-dispatch share of the decode tick (BENCH_r05's 36%
+            wall/device gap) amortises by K."""
+            stop_ids = np.asarray(sorted(self._stop_ids), np.int32)
+
+            def _decode_fused(params, tokens, cache, active, temps, top_ks,
+                              top_ps, keys, ring, rps):
+                def sample_fn(logits, state, emit_pos, act):
+                    keys, ring = state
+                    toks, keys, ring = sample_step_batched(
+                        logits, keys, temps, top_ks, top_ps, ring=ring,
+                        rp=rps, emit_pos=emit_pos, active=act)
+                    return toks, (keys, ring)
+
+                kwargs: dict = dict(num_steps=K, sample_fn=sample_fn,
+                                    sample_state=(keys, ring),
+                                    stop_ids=stop_ids, active=active)
+                if self.kv_mode == "paged":
+                    kwargs["pages"] = -(-kv_window // self.page_size)
+                else:
+                    kwargs["kv_window"] = kv_window
+                (toks_all, _, next_tokens, cache, _,
+                 (keys, ring)) = model.decode_fused(params, config, tokens,
+                                                    cache, mesh, **kwargs)
+                return toks_all, next_tokens, cache, keys, ring
+            return jax.jit(_decode_fused, donate_argnums=(1, 2, 7, 8))
+
+        self._make_decode_fused = _make_decode_fused
+        self._decode_fused_programs: dict[tuple[int, int], object] = {}
 
         def _make_spec(kv_window: int):
             """Speculative tick: one verify forward over [cur, draft_0..,
@@ -717,6 +782,69 @@ class BatchScheduler:
             self._spec_programs[window] = p
         return p
 
+    def _decode_fused_for(self, window: int, K: int):
+        p = self._decode_fused_programs.get((window, K))
+        if p is None:
+            p = self._make_decode_fused(window, K)
+            self._decode_fused_programs[(window, K)] = p
+        return p
+
+    @property
+    def _fuse_ladder(self) -> tuple[int, ...]:
+        """Compiled fused-K sizes: powers of two up to decode_fuse_max
+        (plus the cap itself) — the ramp climbs this ladder, so the
+        compile cache holds a handful of fused programs per window, not
+        one per possible K."""
+        ks, k = [], 2
+        while k < self.decode_fuse_max:
+            ks.append(k)
+            k *= 2
+        if self.decode_fuse_max > 1:
+            ks.append(self.decode_fuse_max)
+        return tuple(ks)
+
+    def _choose_fuse_k(self, inflight: int) -> int:
+        """Adaptive fused-K for this tick. Collapses to 1 whenever
+        fusing could hurt latency or overrun a budget:
+
+        - admissions pending (queued requests, carried chunks, or
+          page-starved waiters): a K-step tick would push their TTFT
+          back K-1 steps;
+        - any active row within K tokens of its ``max_new`` or KV
+          budget — the device must never write a slot past a row's
+          allocation, and ``inflight`` unprocessed pipelined steps count
+          against the headroom (device length runs ahead of the host's
+          ctx_len mirror by up to that many slots);
+
+        otherwise K doubles along the compiled ladder up to
+        ``decode_fuse_max``, so a stream that just admitted ramps
+        1 -> 2 -> 4 instead of jumping straight to a long fused tick.
+        """
+        kmax = self.decode_fuse_max
+        if kmax <= 1:
+            return 1
+        if (self._admit_carry or self._waiting
+                or not self._admit_q.empty()):
+            self._fuse_ramp = 1
+            return 1
+        cap = kmax
+        for s in self._slots:
+            if s is None:
+                continue
+            cap = min(cap,
+                      s.max_new - len(s.ids) - inflight,
+                      s.ctx_budget - s.ctx_len - inflight)
+            if cap < 2:
+                self._fuse_ramp = 1
+                return 1
+        k = 1
+        target = min(cap, self._fuse_ramp * 2)
+        for cand in self._fuse_ladder:
+            if cand <= target:
+                k = cand
+        self._fuse_ramp = max(k, 1)
+        return max(k, 1)
+
     def _chunk_cap(self, S: int) -> int:
         """Widest admission chunk (power of two) whose R x S footprint
         stays inside _ADMIT_TOKEN_BUDGET; at least 1."""
@@ -830,6 +958,9 @@ class BatchScheduler:
             steps.append(lambda w=w: self._warm_window(w))
         if self.kv_mode == "paged":
             steps.append(self._warm_zero_row)
+        # One-shot device-step measurement for the wall/device gauges —
+        # after the windows compiled, before traffic.
+        steps.append(self._probe_device_step)
         # Admission rounds short prompts UP to the smallest warmed bucket
         # (_serving_bucket) — recorded only after every program compiled.
         def _record():
@@ -973,6 +1104,54 @@ class BatchScheduler:
                 jnp.zeros((B,), jnp.int32), self._cache, inactive,
                 self._temps_dev, self._top_ks_dev, self._top_ps_dev,
                 self._keys, self._ring_dev, self._rps_dev)
+        if self.decode_fuse_max > 1:
+            # Fused-K programs for this window: the ramp's whole ladder,
+            # so the first fused tick after warmup never compiles
+            # mid-serving (a lazy scan compile would stall every live
+            # stream exactly like a lazy decode compile would).
+            for K in self._fuse_ladder:
+                (_, self._next_dev, self._cache, self._keys,
+                 self._ring_dev) = self._decode_fused_for(w, K)(
+                    self._params, self._next_dev, self._cache, inactive,
+                    self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                    self._keys, self._ring_dev, self._rps_dev)
+        if keys_before is not None:
+            self._keys = jnp.where(jnp.asarray(live)[:, None],
+                                   keys_before, self._keys)
+
+    def _probe_device_step(self) -> None:
+        """Measure the device decode step once, at warmup's tail: a
+        two-point solve over parked-row no-op ticks of the smallest
+        window (wall(N) = N*step + readback-RTT; the solve cancels the
+        constant), run on the live buffers through the REAL decode
+        program. Feeds the ``decode_device_ms`` gauge so /metrics can
+        show the wall/device decomposition (``decode_wall_ms`` tracks
+        the serving loop live). Keys are restored afterwards, exactly
+        like _warm_window — the probe must not perturb seeded streams."""
+        B = self.num_slots
+        live = np.array([s is not None for s in self._slots], bool)
+        keys_before = (self._keys + 0) if live.any() else None
+        inactive = jnp.zeros((B,), bool)
+        decode_j = self._decode_for(min(128, self.max_seq))
+
+        def loop(n: int) -> float:
+            t = time.monotonic()
+            toks = None
+            for _ in range(n):
+                (toks, self._next_dev, self._cache, self._keys,
+                 self._ring_dev) = decode_j(
+                    self._params, self._next_dev, self._cache, inactive,
+                    self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                    self._keys, self._ring_dev, self._rps_dev)
+            np.asarray(toks)                     # forced sync
+            return (time.monotonic() - t) / n
+
+        loop(1)                                  # warm dispatch path
+        n1, n2 = 4, 12
+        w1, w2 = loop(n1), loop(n2)
+        d = (n2 * w2 - n1 * w1) / (n2 - n1)
+        self._decode_device_ms = round(
+            (d if d > 0.05 * w2 else w2) * 1e3, 4)
         if keys_before is not None:
             self._keys = jnp.where(jnp.asarray(live)[:, None],
                                    keys_before, self._keys)
@@ -1045,7 +1224,29 @@ class BatchScheduler:
                     if slot.error is not None:
                         raise RuntimeError(slot.error)
                     return
-                yield delta
+                # Burst drain: a fused K-step tick (or a speculative
+                # tick) lands several deltas at once — coalesce whatever
+                # is already queued into ONE yield so the HTTP front
+                # writes one NDJSON chunk per burst instead of K
+                # per-token chunks (K syscalls + K JSON records per
+                # tick otherwise; latency is untouched because only
+                # immediately-available deltas are merged).
+                parts = [delta]
+                done = False
+                while True:
+                    try:
+                        nxt = slot.out_q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if nxt is None:
+                        done = True
+                        break
+                    parts.append(nxt)
+                yield "".join(parts)
+                if done:
+                    if slot.error is not None:
+                        raise RuntimeError(slot.error)
+                    return
         finally:
             slot.cancelled.set()
 
@@ -1093,7 +1294,7 @@ class BatchScheduler:
         lands beyond the trusted length or in the garbage page).
         Speculative ticks stay synchronous — drafting needs the current
         ids — and flush the pipeline first."""
-        pending: Optional[tuple] = None      # (toks_dev, slots snapshot)
+        pending: Optional[tuple] = None   # (toks_dev, snapshot, K)
         while not self._closed.is_set():
             try:
                 # Admission inside the same recovery envelope as decode: an
@@ -1120,7 +1321,8 @@ class BatchScheduler:
                 # can actually run this tick (drafting needs current ids)
                 # — while the acceptance throttle has speculation backed
                 # off, plain ticks keep their pipelining.
-                if self.spec_k and not self._spec_throttled():
+                spec_now = bool(self.spec_k) and not self._spec_throttled()
+                if spec_now:
                     if pending is not None:
                         self._process_tick(*pending)
                         pending = None
@@ -1128,7 +1330,15 @@ class BatchScheduler:
                         continue
                     if self._spec_tick():
                         continue
-                new = self._dispatch_tick()
+                # Fused K-step ticks ride the same one-tick-deep pipeline
+                # as plain ones: tick t+1 (up to K steps) is enqueued
+                # BEFORE tick t's K-token burst is drained, so the
+                # readback/stream work overlaps device compute. K=1 while
+                # speculation is live this iteration (a fused tick would
+                # emit K tokens with no draft chance).
+                new = self._dispatch_tick(
+                    allow_fuse=not spec_now,
+                    inflight=pending[2] if pending is not None else 0)
                 if pending is not None:
                     self._process_tick(*pending)
                 pending = new
@@ -1269,6 +1479,25 @@ class BatchScheduler:
             "serve_admitted_total": self._n_admitted,
             "serve_decode_ticks_total": self._n_decode_ticks,
             "serve_queue_expired_total": self._n_expired,
+            # Fused multi-step decode (decode_fuse_max): dispatches that
+            # fused K>1 steps, total fused steps, and the realized mean K
+            # over every decode dispatch — the lever that closes the
+            # wall/device gap, so its engagement is first-class.
+            "decode_fused_ticks_total": self._n_fused_ticks,
+            "decode_fused_steps_total": self._n_fused_steps,
+            # Realized K over NON-speculative decode dispatches: spec
+            # ticks have no fused-K and counting them would dilute the
+            # mean below 1 on spec-enabled deployments (reading as
+            # "fusion disengaged" when it is not).
+            "decode_fused_mean_k": round(
+                self._n_decode_steps
+                / max(1, self._n_decode_ticks - self._n_spec_ticks), 3),
+            # Wall vs device decode step: wall is the live p50 of
+            # steady-state per-step dispatch intervals; device is the
+            # warmup probe's two-point solve (_probe_device_step).
+            "decode_wall_ms": round(self._wall_hist.percentile(50) or 0.0,
+                                    4),
+            "decode_device_ms": self._decode_device_ms,
         }
         if self.spec_k:
             out["serve_spec_accepted_total"] = self._n_spec_accepted
@@ -1572,37 +1801,70 @@ class BatchScheduler:
                 # finished on the very first token (eos / limits)
                 self._release(row)
 
-    def _dispatch_tick(self) -> tuple:
-        """Dispatch one batched decode step (async — returns without a
-        readback). Returns (toks_dev, snapshot of the rows it decoded
-        for); _process_tick consumes it, one tick later under
+    def _dispatch_tick(self, allow_fuse: bool = True,
+                       inflight: int = 0) -> tuple:
+        """Dispatch one batched decode tick (async — returns without a
+        readback): K=1 plain step, or a fused K-step scan when
+        _choose_fuse_k allows (``allow_fuse`` is False on iterations
+        where speculation could run — a fused tick would emit K tokens
+        with no draft opportunity). ``inflight``: steps of the still-
+        unprocessed pipelined tick, counted against every budget.
+        Returns (toks_dev [B] or [K,B], snapshot of the rows it decoded
+        for, K); _process_tick consumes it, one tick later under
         pipelining."""
+        K = self._choose_fuse_k(inflight) if allow_fuse else 1
         self._n_decode_ticks += 1
+        self._n_decode_steps += K
+        if K > 1:
+            self._n_fused_ticks += 1
+            self._n_fused_steps += K
+        now = time.monotonic()
+        if (self._last_dispatch is not None
+                and now - self._last_dispatch[0] < 0.25):
+            # Steady-state per-STEP wall: the interval between dispatches
+            # spans the previous tick's host drain + whatever device time
+            # the pipeline couldn't hide, over that tick's K steps. Idle
+            # gaps (> 250 ms) are load valleys, not decode wall.
+            self._wall_hist.observe(
+                (now - self._last_dispatch[0]) * 1e3 / self._last_dispatch[1])
+        self._last_dispatch = (now, K)
         active = tuple(s is not None for s in self._slots)
         if active != self._active_host:
             # Re-upload the mask only when the active set changed (it only
             # moves on admission/finish — not per tick).
             self._active_host = active
             self._active_dev = jnp.asarray(np.array(active, bool))
-        # extra=1: under pipelining a row's device length can be one
-        # ahead of the host's ctx_len (its previous token is still
-        # unprocessed), so the window budget covers it.
-        decode_j = self._decode_for(self._window(extra=1))
+        # extra: under pipelining a row's device length can run up to
+        # ``inflight`` slots ahead of the host's ctx_len, and this tick
+        # writes K more slots — the deepest attended position is
+        # ctx_len + inflight + K - 1 (floor 1 keeps K=1 selection
+        # identical to the pre-fusion program ladder).
+        decode_w = self._window(extra=max(1, inflight + K - 1))
+        if K == 1:
+            decode_j = self._decode_for(decode_w)
+        else:
+            decode_j = self._decode_fused_for(decode_w, K)
         (toks_dev, self._next_dev, self._cache, self._keys,
          self._ring_dev) = decode_j(
             self._params, self._next_dev, self._cache, self._active_dev,
             self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys,
             self._ring_dev, self._rps_dev)
-        return toks_dev, list(self._slots)
+        return toks_dev, list(self._slots), K
 
-    def _process_tick(self, toks_dev, snapshot: list) -> None:
+    def _process_tick(self, toks_dev, snapshot: list, K: int = 1) -> None:
         """Host half of a decode tick: read the sampled tokens back and
         run per-row bookkeeping for the rows captured at dispatch time.
-        Rows finished/released since (their slot.done is set) are
-        skipped — their in-flight token is discarded, and the write it
-        made sits beyond the trusted length by the overwrite-before-
-        trust invariant."""
-        toks = np.asarray(toks_dev)              # [B] int32 — tiny sync
+        Fused ticks drain a [K, B] burst — each row consumes its tokens
+        in order and stops at the first finisher (EOS parked the row
+        in-scan at exactly that point, so later burst positions of a
+        finished row are garbage by construction). Rows finished/released
+        since dispatch (their slot.done is set) are skipped — their
+        in-flight tokens are discarded, and the writes they made sit
+        beyond the trusted length by the overwrite-before-trust
+        invariant."""
+        toks = np.asarray(toks_dev)         # [B] or [K,B] int32 — tiny sync
+        if toks.ndim == 1:
+            toks = toks[None]
         for row, slot in enumerate(snapshot):
             # Identity check, not just done/None: the row may have been
             # released AND re-admitted since dispatch — acting on it now
@@ -1613,9 +1875,11 @@ class BatchScheduler:
             if slot.cancelled.is_set():
                 self._release(row)
                 continue
-            slot.ctx_len += 1          # decode wrote this row's next kv slot
-            if not self._append_token(slot, row, int(toks[row])):
-                self._release(row)
+            for k in range(toks.shape[0]):
+                slot.ctx_len += 1      # decode wrote this row's next kv slot
+                if not self._append_token(slot, row, int(toks[k, row])):
+                    self._release(row)
+                    break
 
     def _spec_throttled(self) -> bool:
         """Acceptance-collapse throttle: when the accepted-drafts EMA is
@@ -1666,6 +1930,8 @@ class BatchScheduler:
             return False
 
         self._n_decode_ticks += 1
+        self._n_spec_ticks += 1
+        self._last_dispatch = None    # spec wall is not decode-step wall
         active = tuple(s is not None for s in self._slots)
         if active != self._active_host:
             self._active_host = active

@@ -106,6 +106,40 @@ def test_store_rejects_bad_records_and_keeps_freshest():
     node.close()
 
 
+def test_resolve_dst_skips_resolver_for_ips_and_memoizes(monkeypatch):
+    """RPC destinations that are already numeric IPv4 literals (every
+    wire-learned contact) must never touch the resolver, and hostname
+    lookups happen once per destination — a slow DNS server used to be
+    consulted synchronously on EVERY outgoing RPC."""
+    import socket as _socket
+
+    node = DHTNode(Identity.generate())
+    calls = []
+
+    def fake_resolve(host):
+        calls.append(host)
+        if host == "flaky.example":
+            raise OSError("dns down")
+        return "10.0.0.7"
+
+    monkeypatch.setattr(_socket, "gethostbyname", fake_resolve)
+    try:
+        # Numeric literal: passthrough, resolver untouched.
+        assert node._resolve_dst("192.168.1.5") == "192.168.1.5"
+        assert calls == []
+        # Hostname: resolved once, then memoized.
+        assert node._resolve_dst("seed.example") == "10.0.0.7"
+        assert node._resolve_dst("seed.example") == "10.0.0.7"
+        assert calls == ["seed.example"]
+        # Failure falls back to the hostname and is NOT memoized — the
+        # next RPC retries DNS instead of pinning the bad answer.
+        assert node._resolve_dst("flaky.example") == "flaky.example"
+        assert node._resolve_dst("flaky.example") == "flaky.example"
+        assert calls.count("flaky.example") == 2
+    finally:
+        node.close()
+
+
 def test_store_bounded_evicts_farthest_key():
     """The store caps at max_records; overflow evicts the key farthest
     from our node id (the record some OTHER node is responsible for)."""

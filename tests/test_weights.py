@@ -217,6 +217,20 @@ def test_load_checkpoint_quantized_hf_matches_quantize_then_fuse(tmp_path):
     want = llama.fuse_params(quantize_params(base))
     _assert_trees_equal(got, want)
 
+    # HF-branch config identity: a caller-supplied REGISTRY config whose
+    # shapes match must be honored even though its name can never equal
+    # the HF-derived one (_name_or_path / "hf-model") — shape fields
+    # alone establish identity there. A shape disagreement still rejects.
+    supplied = got_cfg.with_(name="my-registry-tag",
+                             max_seq_len=got_cfg.max_seq_len * 2)
+    got2, got2_cfg = load_checkpoint_quantized(ckpt, config=supplied)
+    assert got2_cfg.name == "my-registry-tag"
+    assert got2_cfg.max_seq_len == got_cfg.max_seq_len * 2
+    _assert_trees_equal(got2, want)
+    with pytest.raises(ValueError, match="identity"):
+        load_checkpoint_quantized(
+            ckpt, config=got_cfg.with_(num_layers=got_cfg.num_layers + 1))
+
 
 def test_load_checkpoint_quantized_native_matches(tmp_path):
     """Same equivalence through a native Orbax checkpoint (the e2e quote
@@ -240,6 +254,19 @@ def test_load_checkpoint_quantized_native_matches(tmp_path):
     assert got_cfg.name == "tiny"
     want = llama.fuse_params(quantize_params(params))
     _assert_trees_equal(got, want)
+
+    # Config agreement is relaxed to IDENTITY fields (name + tensor
+    # shapes): a benign runtime-field bump — the registry raising a
+    # config's max_seq_len — must not orphan pre-existing checkpoints,
+    # and the caller's bumped value must win.
+    bumped = cfg.with_(max_seq_len=cfg.max_seq_len * 2)
+    got2, got2_cfg = load_checkpoint_quantized(ckpt, config=bumped)
+    assert got2_cfg.max_seq_len == cfg.max_seq_len * 2
+    _assert_trees_equal(got2, want)
+    # A shape-bearing field disagreement is a DIFFERENT model: reject.
+    with pytest.raises(ValueError, match="identity"):
+        load_checkpoint_quantized(
+            ckpt, config=cfg.with_(num_kv_heads=cfg.num_kv_heads * 2))
 
 
 def test_load_checkpoint_quantized_moe_matches_quantize_then_fuse(tmp_path):
