@@ -90,6 +90,9 @@ import sys
 import threading
 import time
 
+from p2p_llm_chat_tpu.utils.env import (env_float, env_int, env_opt,
+                                        env_or, env_bool)
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -111,13 +114,13 @@ def main() -> None:
     from p2p_llm_chat_tpu.serve.scheduler import BatchScheduler
     from p2p_llm_chat_tpu.tokenizer import ByteTokenizer
 
-    cfg_name = os.environ.get("BENCH_CONFIG", "bench-1b")
-    slots = int(os.environ.get("BENCH_SLOTS", "32"))
-    max_seq = int(os.environ.get("BENCH_MAX_SEQ", "1024"))
-    new_tokens = int(os.environ.get("BENCH_NEW_TOKENS", "32"))
-    decode_steps = int(os.environ.get("BENCH_DECODE_STEPS", "64"))
-    kv_mode = os.environ.get("BENCH_KV", "paged")   # dense | paged
-    page_size = int(os.environ.get("BENCH_PAGE_SIZE", "64"))
+    cfg_name = env_or("BENCH_CONFIG", "bench-1b")
+    slots = env_int("BENCH_SLOTS", 32)
+    max_seq = env_int("BENCH_MAX_SEQ", 1024)
+    new_tokens = env_int("BENCH_NEW_TOKENS", 32)
+    decode_steps = env_int("BENCH_DECODE_STEPS", 64)
+    kv_mode = env_or("BENCH_KV", "paged")   # dense | paged
+    page_size = env_int("BENCH_PAGE_SIZE", 64)
 
     platform = jax.devices()[0].platform
     log(f"bench: {cfg_name} on {jax.devices()[0]} ({platform}), "
@@ -126,8 +129,8 @@ def main() -> None:
     config = get_config(cfg_name)
     family = family_for(config)   # llama or mixtral (bench-moe)
     dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
-    quant = os.environ.get("BENCH_QUANT", "int8")    # "" | int8
-    workload = os.environ.get("BENCH_WORKLOAD", "")
+    quant = env_opt("BENCH_QUANT", "int8")   # "" | int8; BENCH_QUANT= = bf16
+    workload = env_or("BENCH_WORKLOAD", "")
     stream_int8 = (quant == "int8"
                    and hasattr(family, "init_params_quantized"))
     if workload == "quote":
@@ -177,7 +180,7 @@ def main() -> None:
     _pa = importlib.import_module("p2p_llm_chat_tpu.ops.paged_attention")
     kv_quant_default = ("int8" if kv_mode == "paged"
                         and _pa._DEFAULT_IMPL == "gather" else "")
-    kv_quant = os.environ.get("BENCH_KV_QUANT", kv_quant_default) == "int8"
+    kv_quant = env_opt("BENCH_KV_QUANT", kv_quant_default) == "int8"
     if kv_quant and kv_mode != "paged":
         raise SystemExit("BENCH_KV_QUANT=int8 requires BENCH_KV=paged")
 
@@ -192,7 +195,7 @@ def main() -> None:
     # large K its token count can EXCEED the plain loop's — an
     # under-sized pool would silently drop the tail writes past the page
     # table and publish numbers from a truncated window).
-    fuse_k = max(1, int(os.environ.get("BENCH_FUSE", "4")))
+    fuse_k = max(1, env_int("BENCH_FUSE", 4))
     n1 = max(16, decode_steps // 4)
     n2 = max(decode_steps, 2 * n1)      # strictly > n1, or the solve is 0/0
     f1 = max(4, n1 // fuse_k)
@@ -327,13 +330,13 @@ def main() -> None:
     del raw_params
 
     # -- end-to-end serving: p50 TTFT at `slots` concurrent peers ------------
-    admit_chunk = int(os.environ.get("BENCH_ADMIT_CHUNK", "0")) or None
-    spec_k = int(os.environ.get("BENCH_SPEC", "0"))
-    use_prefix = os.environ.get("BENCH_PREFIX", "1") not in ("", "0", "false")
+    admit_chunk = env_int("BENCH_ADMIT_CHUNK", 0) or None
+    spec_k = env_int("BENCH_SPEC", 0)
+    use_prefix = env_bool("BENCH_PREFIX", True)
     tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
     prompt = ("Draft a concise, friendly reply to the following message:\n\n"
               "Hey, are we still meeting tomorrow at 10?\n\nReply:")
-    bench_ctx = int(os.environ.get("BENCH_CTX", "0"))
+    bench_ctx = env_int("BENCH_CTX", 0)
     if bench_ctx:
         # Long-context suggestion: a big conversation history ahead of
         # the same template tail (byte tokenizer: ~1 token per char).
@@ -363,7 +366,7 @@ def main() -> None:
     # earlier n-grams, which greedy decoding does and temperature-0.7
     # sampling essentially never does on this synthetic model — spec rows
     # must report serve_spec_accepted_total > 0 to credit spec for a win.
-    bench_temp = float(os.environ.get("BENCH_TEMP", "0.7"))
+    bench_temp = env_float("BENCH_TEMP", 0.7)
     opts = GenerateOptions(max_tokens=new_tokens, temperature=bench_temp,
                            top_p=0.9, seed=0)
 
@@ -406,7 +409,7 @@ def main() -> None:
     # BENCH_PROFILE=/dir captures a jax.profiler trace of the concurrent
     # section (view with tensorboard / xprof; SURVEY.md §5 tracing plan).
     import contextlib
-    profile_dir = os.environ.get("BENCH_PROFILE", "")
+    profile_dir = env_or("BENCH_PROFILE", "")
     trace_cm = (jax.profiler.trace(profile_dir) if profile_dir
                 else contextlib.nullcontext())
 

@@ -1,15 +1,24 @@
 #!/bin/bash
-# CI gate: one command runs the fast correctness suite plus the native
-# sanitizer job (SURVEY.md §5 race-detection plan: the C++ components
-# handle untrusted network bytes and tokenizer hot loops, so they run
-# under ASan+UBSan; Python-side concurrency is covered by the scheduler
-# chaos tests in the fast suite).
+# CI gate: graftcheck static analysis, then the fast correctness suite,
+# plus the native sanitizer job (SURVEY.md §5 race-detection plan: the
+# C++ components handle untrusted network bytes and tokenizer hot
+# loops, so they run under ASan+UBSan — and, in full mode, TSan; the
+# Python planes get graftcheck's trace-safety/lock-discipline checks
+# plus the scheduler chaos tests in the fast suite).
 #
-#   ./ci.sh          fast suite + sanitizer job
-#   ./ci.sh full     the whole test suite + sanitizer job
+#   ./ci.sh          graftcheck + fast suite + sanitizer job
+#   ./ci.sh full     graftcheck + whole test suite + ASan and TSan jobs
 set -u
 cd "$(dirname "$0")"
 rc=0
+
+# Static analysis runs FIRST: it needs no device and fails in seconds,
+# so a trace-safety/lock-discipline/env-hygiene regression never waits
+# on a compile. Any new finding fails the gate — suppress only with a
+# reasoned annotation (docs/static-analysis.md).
+echo "== graftcheck static analysis"
+python -m tools.graftcheck p2p_llm_chat_tpu bench.py start_all.py tests \
+  || exit 1
 
 echo "== native sanitizer build (ASan + UBSan)"
 make -C native san || exit 1
@@ -28,6 +37,21 @@ NATIVE_LIB_DIR="$PWD/native/san" \
   -q -x || rc=1
 
 if [ "${1:-}" = "full" ]; then
+  # TSan is mutually exclusive with ASan, so the race job is its own
+  # build + preload pass over the threaded native path (the splice runs
+  # one OS thread per relayed direction over shared session state).
+  echo "== native splice tests under ThreadSanitizer"
+  make -C native tsan || exit 1
+  TSAN_LIB=$(g++ -print-file-name=libtsan.so)
+  # -print-file-name echoes the bare name when the runtime is absent,
+  # and a failed LD_PRELOAD is only an ld.so warning — either way the
+  # tests would run UNinstrumented and report green. Fail loudly.
+  [ -f "$TSAN_LIB" ] || { echo "libtsan.so not found ($TSAN_LIB)"; exit 1; }
+  NATIVE_LIB_DIR="$PWD/native/tsan" \
+    LD_PRELOAD="$TSAN_LIB" \
+    TSAN_OPTIONS=halt_on_error=1:exitcode=66 \
+    python -m pytest tests/test_native_splice.py -q -x || rc=1
+
   echo "== full test suite"
   python -m pytest tests/ -q || rc=1
 else

@@ -197,7 +197,7 @@ class RoutingTable:
     def __init__(self, self_id: int, k: int = K) -> None:
         self.self_id = self_id
         self.k = k
-        self._buckets: list[list[Contact]] = [[] for _ in range(ID_BITS)]
+        self._buckets: list[list[Contact]] = [[] for _ in range(ID_BITS)]  # guarded-by: _mu
         self._mu = threading.Lock()
 
     def _bucket_index(self, node_id: int) -> int:
@@ -286,20 +286,20 @@ class DHTNode:
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self.sock.bind((host or "127.0.0.1", int(port or 0)))
         self.table = RoutingTable(self.node_id, k=k)
-        self._store: dict[int, SignedRecord] = {}
+        self._store: dict[int, SignedRecord] = {}  # guarded-by: _store_mu
         self._store_mu = threading.Lock()
         # rid -> (event, hits, resolved dst addr the RPC was sent to)
         self._pending: dict[str, tuple[threading.Event, list,
-                                       tuple[str, int]]] = {}
+                                       tuple[str, int]]] = {}  # guarded-by: _pending_mu
         self._pending_mu = threading.Lock()
-        self._evicting: set[str] = set()
+        self._evicting: set[str] = set()  # guarded-by: _evict_mu
         self._evict_mu = threading.Lock()
-        self._challenging: set[str] = set()
+        self._challenging: set[str] = set()  # guarded-by: _challenge_mu
         self._challenge_mu = threading.Lock()
         # Destination-resolution memo (_resolve_dst): hostname -> IP, so
         # a slow DNS server is consulted once per destination, not on
         # every RPC. Bounded; numeric IPs never enter it.
-        self._resolve_cache: dict[str, str] = {}
+        self._resolve_cache: dict[str, str] = {}  # guarded-by: _resolve_mu
         self._resolve_mu = threading.Lock()
         self._closed = threading.Event()
         self._rx: Optional[threading.Thread] = None

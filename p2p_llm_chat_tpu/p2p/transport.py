@@ -130,7 +130,7 @@ class SecureStream:
         self._sock = sock
         self._send = ChaCha20Poly1305(send_key)
         self._recv = ChaCha20Poly1305(recv_key)
-        self._send_ctr = 0
+        self._send_ctr = 0        # guarded-by: _send_lock
         self._recv_ctr = 0
         self._send_lock = threading.Lock()
         self.remote_peer_id = remote_peer_id
@@ -287,12 +287,17 @@ class P2PHost:
         self._relay_threads: list[threading.Thread] = []
         self._relay_addrs: list[Multiaddr] = []
         self._extra_addrs: list[Multiaddr] = []
-        self._relay_socks: list[socket.socket] = []
+        self._relay_socks: list[socket.socket] = []  # guarded-by: _relay_socks_mu
         self._relay_socks_mu = threading.Lock()
         # Negative cache for hole punching: peers whose punch failed are
         # dialed via the relay circuit directly for a while, so every
         # /send to a UDP-blocked peer doesn't re-pay the punch stall.
-        self._punch_failed: dict[str, float] = {}
+        # Dials run on whatever thread asked (HTTP handlers, the node
+        # loop), so the read-prune-insert below must hold the lock — the
+        # unlocked version lost concurrent failure entries to the prune
+        # rebuild (graftcheck lock-discipline finding).
+        self._punch_failed: dict[str, float] = {}  # guarded-by: _punch_mu
+        self._punch_mu = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -443,8 +448,11 @@ class P2PHost:
             # addrs would all share one slot and suppress each other),
             # pruned on insert so long-lived hosts don't accumulate
             # entries forever.
-            failed_at = (self._punch_failed.get(maddr.peer_id)
-                         if maddr.peer_id else None)
+            if maddr.peer_id:
+                with self._punch_mu:
+                    failed_at = self._punch_failed.get(maddr.peer_id)
+            else:
+                failed_at = None
             if failed_at is not None and time.time() - failed_at < 60.0:
                 punch_ok = False
             if punch_ok:
@@ -454,10 +462,11 @@ class P2PHost:
                         ValueError) as e:
                     if maddr.peer_id:
                         now = time.time()
-                        self._punch_failed = {
-                            pid: t for pid, t in
-                            self._punch_failed.items() if now - t < 60.0}
-                        self._punch_failed[maddr.peer_id] = now
+                        with self._punch_mu:
+                            self._punch_failed = {
+                                pid: t for pid, t in
+                                self._punch_failed.items() if now - t < 60.0}
+                            self._punch_failed[maddr.peer_id] = now
                     log.debug("hole punch to %s failed (%s); "
                               "falling back to relay circuit",
                               (maddr.peer_id or "?")[:12], e)

@@ -83,7 +83,7 @@ class TPUEngine:
         self.name = name or config.name
         self.config = config
         self.prefix_texts = tuple(prefix_texts) if prefix_cache else ()
-        self._embed_j = None
+        self._embed_j = None      # guarded-by: _embed_lock
         self._embed_lock = threading.Lock()
         self.scheduler = BatchScheduler(params, config, tokenizer,
                                         num_slots=num_slots, max_seq=max_seq,
@@ -165,9 +165,11 @@ class TPUEngine:
                 for r, seq in enumerate(chunk):
                     toks[r, : len(seq)] = seq
                     lens[r] = max(1, len(seq))
+                # graftcheck: sync-ok embed responses need the vectors now
                 vecs = np.asarray(self._embed_j(
                     sched._params, tokens=jnp.asarray(toks),
                     lens=jnp.asarray(lens)))
+                # graftcheck: sync-ok host numpy rows, already materialized above
                 out.extend(vecs[r].tolist() for r in range(len(chunk)))
         return out, n_tokens
 

@@ -224,9 +224,9 @@ class MultihostEngine:
         self._q: "queue.Queue" = queue.Queue()
         self._dispatcher: Optional[threading.Thread] = None
         self._stopped = threading.Event()
-        self._requests_served = 0
-        self._batched_rounds = 0
-        self._rows_served_total = 0
+        self._requests_served = 0       # owned-by: _dispatch_loop
+        self._batched_rounds = 0        # owned-by: _dispatch_loop
+        self._rows_served_total = 0     # owned-by: _dispatch_loop
         if jax.process_index() == 0:
             self._dispatcher = threading.Thread(
                 target=self._dispatch_loop, name="mh-dispatch", daemon=True)
@@ -281,6 +281,7 @@ class MultihostEngine:
 
         if op == _OP_EMBED:
             toks = unpack_tokens(_bucket(int(lens.max()), self.max_seq))
+            # graftcheck: sync-ok embed result readback, end of the round
             vecs = np.asarray(self._embed_j(self._params,
                                             jnp.asarray(toks),
                                             jnp.asarray(lens)),
@@ -299,6 +300,7 @@ class MultihostEngine:
                                dtype=self._params["embed"].dtype)
         logits, cache = self._prefill_j(
             self._params, jnp.asarray(toks), jnp.asarray(lens), cache)
+        # graftcheck: sync-ok lockstep: every process samples from host logits
         last = np.asarray(logits)[:, 0]                  # [R, V]
 
         # Per-row deterministic PRNG: identical on every process because
@@ -315,9 +317,10 @@ class MultihostEngine:
         # Penalty window parity with the single-host engine
         # (scheduler.py's penalty ring): the prompt tail counts toward
         # repeat_last_n, not just generated tokens.
+        # graftcheck: sync-ok host token matrix, no device buffer involved
         prompt_tails = [toks[r, max(0, int(lens[r]) - _REPEAT_WINDOW):
                              int(lens[r])].tolist() for r in range(R)]
-        done = np.asarray(max_new <= 0)
+        done = np.asarray(max_new <= 0)  # graftcheck: sync-ok host numpy, no device state
         for _ in range(T):
             nxt = np.zeros((R,), np.int32)
             for r in range(R):
@@ -340,7 +343,7 @@ class MultihostEngine:
             lg, cache = self._decode_j(self._params,
                                        jnp.asarray(nxt[:, None]), cache,
                                        jnp.asarray(~done))
-            last = np.asarray(lg)[:, 0]
+            last = np.asarray(lg)[:, 0]  # graftcheck: sync-ok per-step lockstep readback
         return out_ids[:n_active]
 
     def _truncate_at_stop(self, ids: list, stops: list) -> tuple:
@@ -369,6 +372,7 @@ class MultihostEngine:
     def _broadcast(self, cmd: np.ndarray) -> np.ndarray:
         from jax.experimental import multihost_utils
 
+        # graftcheck: sync-ok the broadcast IS a sync point by design
         return np.asarray(
             multihost_utils.broadcast_one_to_all(jnp.asarray(cmd)))
 
@@ -428,6 +432,7 @@ class MultihostEngine:
                 try:
                     res = self._run_cmd(self._broadcast(
                         self._pack_embed(item.ids_list)))
+                    # graftcheck: sync-ok host numpy vectors from the finished round
                     item.vecs = [v.tolist() for v in res]
                 except Exception as e:        # noqa: BLE001
                     log.exception("multihost embed round failed")
@@ -591,6 +596,7 @@ class MultihostEngine:
     def models(self) -> list[str]:
         return [self.name]
 
+    # graftcheck: lock-ok advisory gauges — torn int reads off the dispatcher thread are acceptable for /metrics
     def metrics_snapshot(self) -> dict[str, float]:
         rounds = max(1, self._batched_rounds)
         return {
@@ -636,7 +642,8 @@ def build_multihost_engine(coordinator: Optional[str]) -> MultihostEngine:
     def put(x, spec):
         sh = NamedSharding(mesh, spec)
         return jax.make_array_from_callback(
-            x.shape, sh, lambda idx, x=x: np.asarray(x[idx]))
+            x.shape, sh,  # graftcheck: sync-ok host->device shard materialization at boot
+            lambda idx, x=x: np.asarray(x[idx]))
 
     # PartitionSpec is a tuple (a pytree), so zip flat leaf lists instead
     # of a two-tree map.

@@ -283,8 +283,8 @@ class BatchScheduler:
                                        mesh=mesh)
         self._params = params
 
-        self._slots: list[Optional[_Slot]] = [None] * num_slots
-        self._waiting: list[_Slot] = []    # paged: admitted later, no pages yet
+        self._slots: list[Optional[_Slot]] = [None] * num_slots  # owned-by: _loop
+        self._waiting: list[_Slot] = []  # owned-by: _loop — paged: admitted later, no pages yet
         self._stop_ids = set(config.eos_token_ids)
         eos = getattr(tokenizer, "eos_id", None)
         if eos is not None and 0 <= eos < config.vocab_size:
@@ -293,15 +293,15 @@ class BatchScheduler:
         self._reset_device_state()
 
         self._admit_q: "queue.Queue[Optional[_Slot]]" = queue.Queue()
-        self._admit_carry: list[_Slot] = []   # prepared chunks awaiting rows
+        self._admit_carry: list[_Slot] = []  # owned-by: _loop — prepared chunks awaiting rows
         self._closed = threading.Event()
         # Serving-plane counters (SURVEY.md §5 metrics plan: queue depth,
         # batch occupancy, decode ticks). Plain ints written only by the
         # scheduler thread; snapshotted by metrics_snapshot().
-        self._n_admitted = 0
-        self._n_decode_ticks = 0
-        self._n_expired = 0
-        self._n_spec_accepted = 0     # draft tokens accepted by verify
+        self._n_admitted = 0          # owned-by: _loop
+        self._n_decode_ticks = 0      # owned-by: _loop
+        self._n_expired = 0           # owned-by: _loop
+        self._n_spec_accepted = 0     # owned-by: _loop — draft tokens accepted by verify
         # Shared-prefix KV cache (serve/prefix.py): prompt-head matches
         # skip recomputing the prefix at admission. Ladder grains that
         # could never pass the admission budget guard (P + smallest
@@ -317,10 +317,10 @@ class BatchScheduler:
                             if ladder else None)
         else:
             self._prefix = None
-        self._n_prefix_admits = 0     # requests admitted via a cached prefix
-        self._n_prefix_tokens = 0     # prompt tokens NOT recomputed
-        self._promote_q: list[tuple] = []   # heads awaiting a build slot
-        self._last_promote_tick = 0
+        self._n_prefix_admits = 0     # owned-by: _loop — requests admitted via a cached prefix
+        self._n_prefix_tokens = 0     # owned-by: _loop — prompt tokens NOT recomputed
+        self._promote_q: list[tuple] = []  # owned-by: _loop — heads awaiting a build slot
+        self._last_promote_tick = 0   # owned-by: _loop
         # Off-thread promotion builds: the build's jit compile + prefill
         # read only the (immutable) params, so a worker thread computes
         # the prefix KV while live ticks keep flowing; the scheduler
@@ -331,7 +331,7 @@ class BatchScheduler:
         # stream ~5 s.
         self._promote_work: "queue.Queue[Optional[tuple]]" = queue.Queue()
         self._promote_done: "queue.Queue[tuple]" = queue.Queue()
-        self._promote_pending: set = set()    # submitted, not yet integrated
+        self._promote_pending: set = set()  # owned-by: _loop — submitted, not yet integrated
         self._promote_worker: Optional[threading.Thread] = None
         # Fused multi-step decode state (tentpole of the wall/device-gap
         # work): the ramp remembers the last dispatched K, the counters
@@ -341,13 +341,13 @@ class BatchScheduler:
             raise ValueError(
                 f"decode_fuse_max must be >= 1, got {decode_fuse_max}")
         self.decode_fuse_max = decode_fuse_max
-        self._fuse_ramp = 1
-        self._n_fused_ticks = 0       # dispatches with K > 1
-        self._n_fused_steps = 0       # decode steps inside fused dispatches
-        self._n_decode_steps = 0      # decode steps across plain dispatches
-        self._n_spec_ticks = 0        # speculative dispatches (no K; they
-                                      # must not dilute the realized mean)
-        self._last_dispatch: Optional[tuple[float, int]] = None
+        self._fuse_ramp = 1           # owned-by: _loop
+        self._n_fused_ticks = 0       # owned-by: _loop — dispatches with K > 1
+        self._n_fused_steps = 0       # owned-by: _loop — decode steps inside fused dispatches
+        self._n_decode_steps = 0      # owned-by: _loop — decode steps across plain dispatches
+        self._n_spec_ticks = 0        # owned-by: _loop — speculative dispatches (no K;
+                                      # they must not dilute the realized mean)
+        self._last_dispatch: Optional[tuple[float, int]] = None  # owned-by: _loop
         from ..utils.metrics import Histogram
         self._wall_hist = Histogram("decode_wall_ms")
         self._decode_device_ms = 0.0  # measured once at warmup (probe)
@@ -356,8 +356,8 @@ class BatchScheduler:
         # when drafts stop landing (non-repetitive output), paying it
         # every tick is pure loss — below the floor, only probe every
         # _SPEC_PROBE_EVERY ticks until acceptance recovers.
-        self._spec_ema = float(spec_k)         # optimistic start
-        self._spec_cooldown = 0
+        self._spec_ema = float(spec_k)  # owned-by: _loop — optimistic start
+        self._spec_cooldown = 0         # owned-by: _loop
 
         # Jitted programs. decode is compiled once; admit once per
         # (chunk-rows, prompt-bucket) shape pair — both power-of-two
@@ -404,6 +404,7 @@ class BatchScheduler:
             K*B int32 per K tokens instead of K round-trips — the
             host-dispatch share of the decode tick (BENCH_r05's 36%
             wall/device gap) amortises by K."""
+            # graftcheck: sync-ok host-side constant, not a device readback
             stop_ids = np.asarray(sorted(self._stop_ids), np.int32)
 
             def _decode_fused(params, tokens, cache, active, temps, top_ks,
@@ -752,7 +753,8 @@ class BatchScheduler:
         the jitted builder), so it is safe on the promotion worker
         thread too."""
         return self._build_prefix_j(
-            self._params, jnp.asarray(np.asarray(ids, np.int32)[None, :]))
+            self._params,  # graftcheck: sync-ok host token ids, upload not readback
+            jnp.asarray(np.asarray(ids, np.int32)[None, :]))
 
     def _install_prefix(self, ids, k, v, note: str = "") -> None:
         """Store insert + log (scheduler thread only — single writer)."""
@@ -971,6 +973,7 @@ class BatchScheduler:
         # Drain the dispatch queue at the end: warmup executions (and the
         # axon tunnel's deferred per-program loads) are async — without a
         # readback the first real request queues behind all of them.
+        # graftcheck: sync-ok,lock-ok intentional drain, runs as a queued _WarmupJob ON the scheduler thread
         steps.append(lambda: np.asarray(self._cache.lengths[:1]))
 
         def _warmup_finished():
@@ -1073,12 +1076,14 @@ class BatchScheduler:
             entry = PrefixEntry(ids=tuple(range(P)), k=z, v=z)
         self._admit_chunk([], [], S, R, warm_prefix=entry)
 
+    # graftcheck: runs-on _loop
     def _warm_window(self, w: int) -> None:
         """Compile+run the decode (and spec) program for one window on
         live state as a parked-row no-op. The programs split every row's
         PRNG key unconditionally, so live rows' keys are restored after —
         a mid-traffic warmup must not perturb seeded requests' outputs."""
         B = self.num_slots
+        # graftcheck: sync-ok host bool list, no device readback
         live = np.array([s is not None for s in self._slots], bool)
         keys_before = (self._keys + 0) if live.any() else None   # copy:
         inactive = jnp.zeros((B,), bool)                         # donated
@@ -1119,6 +1124,7 @@ class BatchScheduler:
             self._keys = jnp.where(jnp.asarray(live)[:, None],
                                    keys_before, self._keys)
 
+    # graftcheck: runs-on _loop
     def _probe_device_step(self) -> None:
         """Measure the device decode step once, at warmup's tail: a
         two-point solve over parked-row no-op ticks of the smallest
@@ -1129,6 +1135,7 @@ class BatchScheduler:
         the serving loop live). Keys are restored afterwards, exactly
         like _warm_window — the probe must not perturb seeded streams."""
         B = self.num_slots
+        # graftcheck: sync-ok host bool list, no device readback
         live = np.array([s is not None for s in self._slots], bool)
         keys_before = (self._keys + 0) if live.any() else None
         inactive = jnp.zeros((B,), bool)
@@ -1143,7 +1150,7 @@ class BatchScheduler:
                     self._params, self._next_dev, self._cache, inactive,
                     self._temps_dev, self._top_ks_dev, self._top_ps_dev,
                     self._keys, self._ring_dev, self._rps_dev)
-            np.asarray(toks)                     # forced sync
+            np.asarray(toks)  # graftcheck: sync-ok the probe IS the forced sync
             return (time.monotonic() - t) / n
 
         loop(1)                                  # warm dispatch path
@@ -1156,6 +1163,7 @@ class BatchScheduler:
             self._keys = jnp.where(jnp.asarray(live)[:, None],
                                    keys_before, self._keys)
 
+    # graftcheck: runs-on _loop
     def _warm_zero_row(self) -> None:
         # The row-release program (_zero_row_j) otherwise compiles on
         # the first request's release — inside a later request's TTFT.
@@ -1250,6 +1258,7 @@ class BatchScheduler:
         finally:
             slot.cancelled.set()
 
+    # graftcheck: lock-ok drains scheduler-owned state only AFTER _thread.join — the owner is gone
     def stop(self) -> None:
         self._closed.set()
         self._admit_q.put(None)    # wake the loop if parked
@@ -1467,6 +1476,7 @@ class BatchScheduler:
         self._n_expired += 1
         return True
 
+    # graftcheck: lock-ok advisory gauges — torn reads of loop-owned ints are harmless for /metrics
     def metrics_snapshot(self) -> dict[str, float]:
         """Serving-plane gauges/counters for the /metrics endpoint (read
         from any thread; values are monotonically-written ints and
@@ -1785,7 +1795,8 @@ class BatchScheduler:
                     self._keys, self._next_dev, self._temps_dev,
                     self._top_ks_dev, self._top_ps_dev, self._ring_dev,
                     self._rps_dev)
-        first_toks = np.asarray(toks_dev)        # tiny sync readback
+        # graftcheck: sync-ok intentional: R int32 first tokens, TTFT depends on it
+        first_toks = np.asarray(toks_dev)
 
         now = time.monotonic()
         self._n_admitted += len(chunk)
@@ -1833,6 +1844,7 @@ class BatchScheduler:
             # Re-upload the mask only when the active set changed (it only
             # moves on admission/finish — not per tick).
             self._active_host = active
+            # graftcheck: sync-ok host tuple -> device upload, not a readback
             self._active_dev = jnp.asarray(np.array(active, bool))
         # extra: under pipelining a row's device length can run up to
         # ``inflight`` slots ahead of the host's ctx_len, and this tick
@@ -1862,7 +1874,8 @@ class BatchScheduler:
         in-flight tokens are discarded, and the writes they made sit
         beyond the trusted length by the overwrite-before-trust
         invariant."""
-        toks = np.asarray(toks_dev)         # [B] or [K,B] int32 — tiny sync
+        # graftcheck: sync-ok intentional: [B] or [K,B] int32, the tick's readback
+        toks = np.asarray(toks_dev)
         if toks.ndim == 1:
             toks = toks[None]
         for row, slot in enumerate(snapshot):
@@ -1935,6 +1948,7 @@ class BatchScheduler:
         active = tuple(s is not None for s in self._slots)
         if active != self._active_host:
             self._active_host = active
+            # graftcheck: sync-ok host tuple -> device upload, not a readback
             self._active_dev = jnp.asarray(np.array(active, bool))
         spec_j = self._spec_for(self._window(extra=K))
         (accepted, correction, self._next_dev, self._cache,
@@ -1943,8 +1957,8 @@ class BatchScheduler:
             jnp.asarray(max_acc), self._cache, self._active_dev,
             self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys,
             self._ring_dev, self._rps_dev)
-        acc = np.asarray(accepted)               # [B] int32 — tiny sync
-        corr = np.asarray(correction)
+        acc = np.asarray(accepted)  # graftcheck: sync-ok 2xB int32 verify readback
+        corr = np.asarray(correction)  # graftcheck: sync-ok same dispatch, already synced
         n_active = sum(s is not None for s in self._slots)
         tick_acc = float(acc.sum()) / max(1, n_active)
         self._spec_ema = ((1 - _SPEC_EMA_ALPHA) * self._spec_ema

@@ -35,6 +35,18 @@ def env_float(key: str, default: float) -> float:
     return float(v)
 
 
+def env_opt(key: str, default: str) -> str:
+    """Return ``os.environ[key]`` if SET — even when empty — else ``default``.
+
+    The one sanctioned exception to ``env_or``'s empty-is-unset contract,
+    for optional-feature flags whose documented OFF spelling is the empty
+    string (``BENCH_QUANT=`` = plain bf16, ``BENCH_KV_QUANT=`` = bf16 KV
+    pool). graftcheck's env-hygiene analyzer recognizes it alongside the
+    typed helpers.
+    """
+    return os.environ.get(key, default)
+
+
 def env_bool(key: str, default: bool = False) -> bool:
     v = os.environ.get(key, "").strip().lower()
     if v == "":

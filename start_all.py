@@ -31,6 +31,8 @@ import tempfile
 import time
 import urllib.request
 
+from p2p_llm_chat_tpu.utils.env import env_float, env_int, env_or
+
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 
 
@@ -56,23 +58,23 @@ def spawn(name: str, module: str, env_extra: dict[str, str],
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--backend", default=os.environ.get("SERVE_BACKEND", "fake"),
+    ap.add_argument("--backend", default=env_or("SERVE_BACKEND", "fake"),
                     help="LLM backend: fake | tpu (default: fake)")
     ap.add_argument("--relay", action="store_true", help="also start the relay daemon")
     ap.add_argument("--users", default="Najy,Cannan",
                     help="comma-separated usernames (default mirrors start_all.sh)")
     ap.add_argument("--node-port-base", type=int,
-                    default=int(os.environ.get("NODE_PORT_BASE", "8081")),
+                    default=env_int("NODE_PORT_BASE", 8081),
                     help="first node HTTP port (default 8081, reference layout)")
     ap.add_argument("--ui-port-base", type=int,
-                    default=int(os.environ.get("UI_PORT_BASE", "8501")),
+                    default=env_int("UI_PORT_BASE", 8501),
                     help="first UI port (default 8501, reference layout)")
     ap.add_argument("--dir-port", type=int,
-                    default=int(os.environ.get("DIR_PORT", "8080")))
+                    default=env_int("DIR_PORT", 8080))
     ap.add_argument("--serve-port", type=int,
-                    default=int(os.environ.get("SERVE_PORT", "11434")))
+                    default=env_int("SERVE_PORT", 11434))
     ap.add_argument("--relay-port", type=int,
-                    default=int(os.environ.get("RELAY_PORT", "4100")))
+                    default=env_int("RELAY_PORT", 4100))
     args = ap.parse_args()
 
     users = [u.strip() for u in args.users.split(",") if u.strip()]
@@ -125,8 +127,8 @@ def main() -> int:
         # Big-model TPU boots (8B checkpoint restore + streamed int8
         # quantize + warmup compile) legitimately take many minutes;
         # SERVE_WAIT_S widens the readiness budget.
-        serve_wait = float(os.environ.get(
-            "SERVE_WAIT_S", "300" if args.backend != "fake" else "30"))
+        serve_wait = env_float(
+            "SERVE_WAIT_S", 300.0 if args.backend != "fake" else 30.0)
         wait_http(f"{serve_url}/healthz", timeout=serve_wait)
 
         dht_seed = ""

@@ -1,0 +1,511 @@
+"""graftcheck fixture suite: known-violation snippets must flag, clean
+snippets must pass, suppressions/annotations must behave per the policy
+in docs/static-analysis.md. Pure AST analysis — no JAX import, no
+device; this file stays in the tier-1 gate.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.graftcheck import __main__ as cli
+from tools.graftcheck.core import Config, run_paths
+
+REPO_ROOT = __file__.rsplit("/tests/", 1)[0]
+
+
+def check(tmp_path, source, name="mod.py", select=None, **cfg_kw):
+    """Write one fixture file and run the selected analyzers on it."""
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    cfg = Config(root=str(tmp_path), **cfg_kw)
+    return run_paths([str(path)], cfg, select)
+
+
+def rules(findings):
+    return [f.rule for f in findings]
+
+
+# -- trace-safety ------------------------------------------------------------
+
+class TestTraceSafety:
+    def test_host_sync_in_jitted_function_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax, numpy as np
+
+            @jax.jit
+            def step(x):
+                return np.asarray(x) + 1
+        """, select=["trace"])
+        assert "trace-safety/host-sync" in rules(fs)
+
+    def test_item_call_in_scan_body_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            from jax import lax
+
+            def body(carry, x):
+                return carry, x.item()
+
+            def run(xs):
+                return lax.scan(body, 0, xs)
+        """, select=["trace"])
+        assert "trace-safety/host-sync" in rules(fs)
+
+    def test_branch_on_traced_value_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x):
+                if x > 0:
+                    return x
+                return -x
+        """, select=["trace"])
+        assert "trace-safety/tracer-branch" in rules(fs)
+
+    def test_branch_on_static_state_is_clean(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax, jax.numpy as jnp
+
+            @jax.jit
+            def step(x, config):
+                if config.deep:          # static param name
+                    x = x * 2
+                if x.shape[0] > 4:       # shape reads are static
+                    x = x[:4]
+                return jnp.sum(x)
+        """, select=["trace"])
+        assert fs == []
+
+    def test_static_argname_branch_is_clean(self, tmp_path):
+        fs = check(tmp_path, """
+            import functools, jax
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def step(x, mode):
+                if mode == "fast":
+                    return x * 2
+                return x
+        """, select=["trace"])
+        assert fs == []
+
+    def test_reachability_through_helper_calls(self, tmp_path):
+        # The sync hides one call down from the jitted entry point.
+        fs = check(tmp_path, """
+            import jax, numpy as np
+
+            def helper(x):
+                return np.asarray(x)
+
+            @jax.jit
+            def step(x):
+                return helper(x)
+        """, select=["trace"])
+        assert "trace-safety/host-sync" in rules(fs)
+
+    def test_unreachable_sync_is_clean(self, tmp_path):
+        fs = check(tmp_path, """
+            import numpy as np
+
+            def host_only(x):
+                return np.asarray(x)      # never traced
+        """, select=["trace"])
+        assert fs == []
+
+    def test_jit_in_loop_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax
+
+            def compile_all(fns):
+                out = []
+                for f in fns:
+                    out.append(jax.jit(f))
+                return out
+        """, select=["trace"])
+        assert "trace-safety/jit-in-loop" in rules(fs)
+
+    def test_static_unhashable_default_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import functools, jax
+
+            @functools.partial(jax.jit, static_argnames=("shapes",))
+            def step(x, shapes=[1, 2]):
+                return x
+        """, select=["trace"])
+        assert "trace-safety/static-unhashable" in rules(fs)
+
+    def test_sync_ok_suppression_with_reason(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax, numpy as np
+
+            @jax.jit
+            def step(x):
+                # graftcheck: sync-ok fixture says this readback is intentional
+                return np.asarray(x) + 1
+        """, select=["trace"])
+        assert fs == []
+
+    def test_reasonless_suppression_is_its_own_finding(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax, numpy as np
+
+            @jax.jit
+            def step(x):
+                # graftcheck: sync-ok
+                return np.asarray(x) + 1
+        """, select=["trace"])
+        assert "suppression/no-reason" in rules(fs)
+
+    def test_hot_sync_covers_np_array_and_tolist(self, tmp_path):
+        fs = check(tmp_path, """
+            import numpy as np
+
+            def snapshot(self, logits):
+                live = np.array([1, 2], bool)
+                return logits.tolist()
+        """, name="serve/scheduler.py", select=["trace"])
+        assert rules(fs).count("trace-safety/hot-sync") == 2
+
+    def test_trailing_suppression_does_not_leak_to_next_statement(
+            self, tmp_path):
+        # A trailing sync-ok on one statement must not suppress the
+        # separate statement on the next line.
+        fs = check(tmp_path, """
+            import numpy as np
+
+            def drain(self):
+                a = np.asarray(self.x)  # graftcheck: sync-ok first readback is intentional
+                b = np.asarray(self.y)
+                return a, b
+        """, name="serve/scheduler.py", select=["trace"])
+        assert [f.line for f in fs
+                if f.rule == "trace-safety/hot-sync"] == [6]
+
+    def test_trailing_suppression_inside_multiline_statement_applies(
+            self, tmp_path):
+        # ...but a trailing comment mid-way through ONE multi-line call
+        # covers the call's later physical lines (the in-tree
+        # scheduler/multihost annotations use this form).
+        fs = check(tmp_path, """
+            import numpy as np
+
+            def build(self, ids):
+                return self._build_j(
+                    self._params,  # graftcheck: sync-ok upload of host ids, not a readback
+                    np.asarray(ids))
+        """, name="serve/scheduler.py", select=["trace"])
+        assert fs == []
+
+    def test_hot_path_sync_requires_annotation(self, tmp_path):
+        src = """
+            import numpy as np
+
+            def drain(lengths):
+                return np.asarray(lengths)
+        """
+        fs = check(tmp_path, src, name="serve/scheduler.py",
+                   select=["trace"])
+        assert "trace-safety/hot-sync" in rules(fs)
+        # Same code outside the hot-path modules needs no annotation.
+        assert check(tmp_path, src, name="serve/other.py",
+                     select=["trace"]) == []
+
+
+# -- lock-discipline ---------------------------------------------------------
+
+class TestLockDiscipline:
+    def test_unguarded_access_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._data = {}       # guarded-by: _mu
+                    self._mu = threading.Lock()
+
+                def get(self, k):
+                    return self._data.get(k)
+        """, select=["lock"])
+        assert "lock-discipline/unguarded" in rules(fs)
+
+    def test_guarded_access_is_clean(self, tmp_path):
+        fs = check(tmp_path, """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._data = {}       # guarded-by: _mu
+                    self._mu = threading.Lock()
+
+                def get(self, k):
+                    with self._mu:
+                        return self._data.get(k)
+        """, select=["lock"])
+        assert fs == []
+
+    def test_nested_function_does_not_inherit_lock(self, tmp_path):
+        # The closure runs later, on whatever thread calls it — holding
+        # the lock at definition time protects nothing.
+        fs = check(tmp_path, """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._data = {}       # guarded-by: _mu
+                    self._mu = threading.Lock()
+
+                def deferred(self):
+                    with self._mu:
+                        def later():
+                            return self._data.copy()
+                    return later
+        """, select=["lock"])
+        assert "lock-discipline/unguarded" in rules(fs)
+
+    def test_trailing_annotation_does_not_bleed_to_next_line(self, tmp_path):
+        # Regression: the lock assignment on the line AFTER a trailing
+        # `# guarded-by:` comment must not register as guarded by itself
+        # (acquiring `with self._mu:` would then flag everywhere).
+        fs = check(tmp_path, """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._data = {}       # guarded-by: _mu
+                    self._mu = threading.Lock()
+
+                def swap(self):
+                    with self._mu:
+                        self._data = {}
+        """, select=["lock"])
+        assert fs == []
+
+    def test_bad_lock_name_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            class Store:
+                def __init__(self):
+                    self._data = {}       # guarded-by: _nonexistent
+        """, select=["lock"])
+        assert "lock-discipline/bad-lock" in rules(fs)
+
+    def test_owned_by_off_thread_access_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            class Sched:
+                def __init__(self):
+                    self._slots = []      # owned-by: _loop
+
+                def _loop(self):
+                    self._slots.append(1)
+
+                def snapshot(self):
+                    return len(self._slots)
+        """, select=["lock"])
+        assert "lock-discipline/off-thread" in rules(fs)
+
+    def test_runs_on_annotation_clears_off_thread(self, tmp_path):
+        fs = check(tmp_path, """
+            class Sched:
+                def __init__(self):
+                    self._slots = []      # owned-by: _loop
+
+                def _loop(self):
+                    self._tick()
+
+                def _tick(self):
+                    self._slots.append(1)
+
+                # graftcheck: runs-on _loop
+                def _warm(self):
+                    return len(self._slots)
+        """, select=["lock"])
+        assert fs == []
+
+    def test_function_level_suppression_covers_body(self, tmp_path):
+        fs = check(tmp_path, """
+            class Sched:
+                def __init__(self):
+                    self._slots = []      # owned-by: _loop
+
+                def _loop(self):
+                    self._slots.append(1)
+
+                # graftcheck: lock-ok fixture: drained after thread join
+                def stop(self):
+                    self._slots = []
+        """, select=["lock"])
+        assert fs == []
+
+
+# -- env-hygiene -------------------------------------------------------------
+
+class TestEnvHygiene:
+    DOCS = "flags.md"
+
+    def _cfg(self, tmp_path, docs_text="| `SERVE_ADDR` | documented |\n"):
+        (tmp_path / self.DOCS).write_text(docs_text)
+        return dict(docs_files=(self.DOCS,))
+
+    def test_raw_environ_read_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import os
+            addr = os.environ.get("SERVE_ADDR", "")
+        """, select=["env"], **self._cfg(tmp_path))
+        assert "env-hygiene/raw-read" in rules(fs)
+
+    def test_getenv_and_subscript_reads_flag(self, tmp_path):
+        fs = check(tmp_path, """
+            import os
+            a = os.getenv("SERVE_ADDR")
+            b = os.environ["BENCH_SLOTS"]
+        """, select=["env"], **self._cfg(tmp_path))
+        assert rules(fs).count("env-hygiene/raw-read") == 2
+
+    def test_typed_helper_read_is_clean(self, tmp_path):
+        fs = check(tmp_path, """
+            from p2p_llm_chat_tpu.utils.env import env_or
+            addr = env_or("SERVE_ADDR", "127.0.0.1:11434")
+        """, select=["env"], **self._cfg(tmp_path))
+        assert fs == []
+
+    def test_undocumented_flag_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            from p2p_llm_chat_tpu.utils.env import env_int
+            n = env_int("SERVE_SECRET_KNOB", 0)
+        """, select=["env"], **self._cfg(tmp_path))
+        assert "env-hygiene/undocumented" in rules(fs)
+
+    def test_documented_match_is_exact_token_not_substring(self, tmp_path):
+        # `SERVE_MAX` must not ride on a documented `SERVE_MAX_SEQ`.
+        fs = check(tmp_path, """
+            from p2p_llm_chat_tpu.utils.env import env_int
+            n = env_int("SERVE_MAX", 0)
+        """, select=["env"],
+                   **self._cfg(tmp_path, "| `SERVE_MAX_SEQ` | documented |\n"))
+        assert "env-hygiene/undocumented" in rules(fs)
+
+    def test_env_module_itself_may_read_environ(self, tmp_path):
+        fs = check(tmp_path, """
+            import os
+
+            def env_or(key, default):
+                v = os.environ.get(key, "")
+                return v if v != "" else default
+
+            x = os.environ.get("SERVE_ADDR", "")
+        """, name="utils/env.py", select=["env"], **self._cfg(tmp_path))
+        assert fs == []
+
+    def test_non_prefixed_vars_ignored(self, tmp_path):
+        fs = check(tmp_path, """
+            import os
+            home = os.environ.get("HOME", "/")
+        """, select=["env"], **self._cfg(tmp_path))
+        assert fs == []
+
+
+# -- pytest-marker hygiene ---------------------------------------------------
+
+class TestMarkers:
+    INI = "fixture_pytest.ini"
+
+    def _cfg(self, tmp_path):
+        (tmp_path / self.INI).write_text(
+            "[pytest]\nmarkers =\n    slow: registered marker\n")
+        return dict(pytest_ini=self.INI)
+
+    def test_unregistered_marker_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import pytest
+
+            @pytest.mark.sloow
+            def test_x():
+                pass
+        """, name="test_fixture.py", select=["markers"],
+                   **self._cfg(tmp_path))
+        assert "markers/unregistered" in rules(fs)
+
+    def test_registered_and_builtin_markers_clean(self, tmp_path):
+        fs = check(tmp_path, """
+            import pytest
+
+            @pytest.mark.slow
+            @pytest.mark.parametrize("x", [1, 2])
+            def test_x(x):
+                pass
+        """, name="test_fixture.py", select=["markers"],
+                   **self._cfg(tmp_path))
+        assert fs == []
+
+    def test_non_test_files_ignored(self, tmp_path):
+        fs = check(tmp_path, """
+            import pytest
+            mark = pytest.mark.sloow
+        """, name="helper.py", select=["markers"], **self._cfg(tmp_path))
+        assert fs == []
+
+    def test_repo_markers_are_registered(self):
+        # The real pytest.ini must cover every marker the suite uses —
+        # `-m 'not slow'` on a typo would silently select everything.
+        from tools.graftcheck.markers import registered_markers
+        regs = registered_markers(f"{REPO_ROOT}/pytest.ini")
+        assert {"slow", "model"} <= regs
+
+
+# -- CLI exit-status contract ------------------------------------------------
+
+class TestCLI:
+    def _write(self, tmp_path, source):
+        p = tmp_path / "fixture.py"
+        p.write_text(textwrap.dedent(source))
+        return str(p)
+
+    def test_clean_file_exits_zero(self, tmp_path, capsys):
+        p = self._write(tmp_path, "x = 1\n")
+        assert cli.main([p, "--root", str(tmp_path)]) == 0
+        assert "0 findings" in capsys.readouterr().out
+
+    def test_violation_exits_one(self, tmp_path, capsys):
+        p = self._write(tmp_path, """
+            import jax, numpy as np
+
+            @jax.jit
+            def step(x):
+                return np.asarray(x)
+        """)
+        assert cli.main([p, "--root", str(tmp_path)]) == 1
+        assert "trace-safety/host-sync" in capsys.readouterr().out
+
+    def test_unknown_analyzer_exits_two(self, tmp_path):
+        p = self._write(tmp_path, "x = 1\n")
+        assert cli.main([p, "--select", "bogus"]) == 2
+
+    def test_nonexistent_path_exits_two(self, tmp_path):
+        # A typo'd target must be a loud usage error — a silent 0-file
+        # "clean" run would neuter the CI gate.
+        assert cli.main([str(tmp_path / "no_such_dir")]) == 2
+
+    def test_select_runs_only_requested_analyzer(self, tmp_path):
+        p = self._write(tmp_path, """
+            import os
+            a = os.environ.get("SERVE_ADDR", "")
+        """)
+        assert cli.main([p, "--select", "lock",
+                         "--root", str(tmp_path)]) == 0
+        assert cli.main([p, "--select", "env",
+                         "--root", str(tmp_path)]) == 1
+
+    def test_shipped_tree_is_clean(self):
+        # The acceptance bar: `python -m tools.graftcheck p2p_llm_chat_tpu/`
+        # exits 0 on the shipped tree (same invocation ci.sh runs).
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftcheck",
+             "p2p_llm_chat_tpu", "bench.py", "start_all.py", "tests"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

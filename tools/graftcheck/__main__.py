@@ -1,0 +1,54 @@
+"""CLI: ``python -m tools.graftcheck [paths...]``.
+
+Exit status: 0 = clean, 1 = findings, 2 = usage error. See
+docs/static-analysis.md for the analyzer catalog and suppression policy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import Config, run_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftcheck",
+        description="jax_graft static analysis: trace-safety, "
+                    "lock-discipline, env-flag hygiene, pytest markers.")
+    ap.add_argument("paths", nargs="*", default=["p2p_llm_chat_tpu"],
+                    help="files or directories to analyze "
+                         "(default: p2p_llm_chat_tpu)")
+    ap.add_argument("--select", default="",
+                    help="comma-separated analyzers to run "
+                         "(trace,lock,env,markers; default all)")
+    ap.add_argument("--docs", default="",
+                    help="comma-separated docs files for the flag-table "
+                         "check (default docs/serving.md)")
+    ap.add_argument("--pytest-ini", default="pytest.ini",
+                    help="pytest config with the registered markers")
+    ap.add_argument("--root", default=".",
+                    help="repo root for docs/pytest.ini resolution")
+    args = ap.parse_args(argv)
+
+    config = Config(root=args.root, pytest_ini=args.pytest_ini)
+    if args.docs:
+        config.docs_files = tuple(
+            d for d in args.docs.split(",") if d)
+    select = [s for s in args.select.split(",") if s] or None
+    try:
+        findings = run_paths(args.paths, config, select)
+    except ValueError as e:
+        print(f"graftcheck: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.render())
+    n = len(findings)
+    print(f"graftcheck: {n} finding{'s' if n != 1 else ''}"
+          f" ({', '.join(select) if select else 'all analyzers'})")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,297 @@
+"""Shared graftcheck machinery: file model, suppressions, runner.
+
+Suppression/annotation comment grammar (one comment, N tags):
+
+    # graftcheck: <tag>[,<tag>...] <reason>
+
+A finding is suppressed when a matching tag with a non-empty reason
+appears on the finding's line, the line above it, or the ``def`` line of
+the enclosing function (function-level suppressions cover e.g. a whole
+``stop()`` that legitimately touches scheduler-owned state after the
+thread join). A graftcheck comment with no reason string is itself a
+finding (``suppression`` rule): the policy is that every suppression
+says *why* the flagged pattern is safe.
+
+Structural annotations (consumed by individual analyzers, same comment
+channel):
+
+    self._store = {}          # guarded-by: _store_mu
+    self._slots = [...]       # owned-by: _loop
+    def _warm_window(self, w):  # graftcheck: runs-on _loop
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+_GRAFT_RE = re.compile(r"#\s*graftcheck:\s*([a-z0-9_,\-]+)\s*(.*)")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_OWNED_RE = re.compile(r"#\s*owned-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_RUNS_ON_RE = re.compile(r"#\s*graftcheck:\s*runs-on\s+([A-Za-z_][A-Za-z0-9_]*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str        # e.g. "trace-safety/host-sync"
+    tag: str         # suppression tag, e.g. "sync-ok"
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Config:
+    """Knobs shared by the analyzers (defaults match this repo)."""
+
+    env_prefixes: tuple[str, ...] = ("SERVE_", "BENCH_")
+    env_module: str = "utils/env.py"           # the one blessed reader
+    docs_files: tuple[str, ...] = ("docs/serving.md",)
+    pytest_ini: str = "pytest.ini"
+    # Modules where EVERY forced host sync must be annotated sync-ok —
+    # the serving hot path, where an unannounced sync is a latency bug.
+    hot_sync_modules: tuple[str, ...] = (
+        "serve/scheduler.py", "serve/engine.py", "serve/multihost.py")
+    root: str = "."
+
+
+class SourceFile:
+    """One parsed Python file plus its comment/annotation side tables."""
+
+    def __init__(self, path: str, text: str) -> None:
+        self.path = path
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        # line -> full comment text (including the leading '#')
+        self.comments: dict[int, str] = {}
+        # lines whose comment stands alone (nothing but whitespace before
+        # it) — structural annotations only look UP to these, so a
+        # trailing `# guarded-by:` on line N can't bleed onto the
+        # unrelated assignment on line N+1 (e.g. the lock itself).
+        self.own_line_comments: set[int] = set()
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    row, col = tok.start
+                    self.comments[row] = tok.string
+                    if not tok.line[:col].strip():
+                        self.own_line_comments.add(row)
+        except tokenize.TokenizeError:
+            pass
+        # line -> {tag: reason}
+        self.suppressions: dict[int, dict[str, str]] = {}
+        self.bad_suppressions: list[int] = []
+        for line, comment in self.comments.items():
+            m = _GRAFT_RE.search(comment)
+            if not m:
+                continue
+            tags = [t for t in m.group(1).split(",") if t]
+            reason = m.group(2).strip()
+            if tags == ["runs-on"]:
+                continue             # structural, parsed via runs_on()
+            if not reason:
+                self.bad_suppressions.append(line)
+                continue
+            self.suppressions.setdefault(line, {}).update(
+                {t: reason for t in tags})
+        # def-lineno set (for function-level suppression lookup)
+        self._def_lines: list[tuple[int, int, int]] = []   # (start, end, defline)
+        # statement spans, for trailing-comment suppression scoping
+        self._stmt_spans: list[tuple[int, int]] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                end = getattr(node, "end_lineno", node.lineno)
+                self._def_lines.append((node.lineno, end, node.lineno))
+            if isinstance(node, ast.stmt):
+                self._stmt_spans.append(
+                    (node.lineno, getattr(node, "end_lineno", node.lineno)))
+
+    def comment_on(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+    def _structural(self, line: int, regex: re.Pattern) -> Optional[str]:
+        """Same-line trailing comment, or an own-line comment just above
+        (a trailing comment on the PREVIOUS statement never applies)."""
+        m = regex.search(self.comments.get(line, ""))
+        if m:
+            return m.group(1)
+        if line - 1 in self.own_line_comments:
+            m = regex.search(self.comments.get(line - 1, ""))
+            if m:
+                return m.group(1)
+        return None
+
+    def guarded_by(self, line: int) -> Optional[str]:
+        return self._structural(line, _GUARDED_RE)
+
+    def owned_by(self, line: int) -> Optional[str]:
+        return self._structural(line, _OWNED_RE)
+
+    def runs_on(self, def_line: int) -> Optional[str]:
+        for ln in (def_line, def_line - 1):
+            m = _RUNS_ON_RE.search(self.comments.get(ln, ""))
+            if m:
+                return m.group(1)
+        return None
+
+    def _same_statement(self, line: int, other: int) -> bool:
+        """True when ``line`` and ``other`` fall inside one statement —
+        the tightest statement span containing ``line`` also covers
+        ``other``. Scopes trailing-comment suppressions: a trailing
+        comment mid-way through a multi-line call suppresses findings
+        on that call's later physical lines, but a trailing comment on
+        a *separate previous statement* must not leak onto this one."""
+        best = None
+        for start, end in self._stmt_spans:
+            if start <= line <= end:
+                if best is None or start > best[0]:
+                    best = (start, end)
+        return best is not None and best[0] <= other <= best[1]
+
+    def suppressed(self, line: int, tag: str) -> bool:
+        if tag in self.suppressions.get(line, {}):
+            return True
+        # Line above: an own-line comment always applies; a TRAILING
+        # comment applies only from inside the same (multi-line)
+        # statement, never from the statement before.
+        if tag in self.suppressions.get(line - 1, {}):
+            if (line - 1 in self.own_line_comments
+                    or self._same_statement(line, line - 1)):
+                return True
+        # Function-level: the def line of the tightest enclosing function.
+        best = None
+        for start, end, defline in self._def_lines:
+            if start <= line <= end:
+                if best is None or start > best[0]:
+                    best = (start, end, defline)
+        if best is not None:
+            for ln in (best[2], best[2] - 1):
+                if tag in self.suppressions.get(ln, {}):
+                    return True
+        return False
+
+
+def load_files(paths: Iterable[str]) -> tuple[list[SourceFile], list[Finding]]:
+    """Collect .py files under ``paths`` (files or directories)."""
+    files: list[SourceFile] = []
+    findings: list[Finding] = []
+    seen: set[str] = set()
+    for p in paths:
+        if os.path.isfile(p):
+            candidates = [p]
+        elif not os.path.isdir(p):
+            # A typo'd target must be a loud usage error, not a silent
+            # 0-file 'clean' run that neuters the CI gate.
+            raise ValueError(f"no such file or directory: {p}")
+        else:
+            candidates = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git",
+                                            "testdata", ".jax_cache")]
+                candidates.extend(os.path.join(dirpath, f)
+                                  for f in sorted(filenames)
+                                  if f.endswith(".py"))
+        for c in sorted(candidates):
+            c = os.path.normpath(c)
+            if c in seen:
+                continue
+            seen.add(c)
+            try:
+                with open(c, encoding="utf-8") as fh:
+                    text = fh.read()
+            except OSError as e:
+                findings.append(Finding(c, 0, "io/read", "io-ok",
+                                        f"unreadable: {e}"))
+                continue
+            try:
+                files.append(SourceFile(c, text))
+            except SyntaxError as e:
+                findings.append(Finding(c, e.lineno or 0, "io/syntax",
+                                        "io-ok", f"syntax error: {e.msg}"))
+    return files, findings
+
+
+def apply_suppressions(files: list[SourceFile],
+                       findings: list[Finding]) -> list[Finding]:
+    by_path = {f.path: f for f in files}
+    out = []
+    for fi in findings:
+        sf = by_path.get(fi.path)
+        if sf is not None and sf.suppressed(fi.line, fi.tag):
+            continue
+        out.append(fi)
+    # Reason-less graftcheck comments are findings of their own.
+    for sf in files:
+        for line in sf.bad_suppressions:
+            out.append(Finding(
+                sf.path, line, "suppression/no-reason", "suppression-ok",
+                "graftcheck suppression without a reason string — every "
+                "suppression must say why the pattern is safe"))
+    return out
+
+
+def run_paths(paths: Iterable[str], config: Optional[Config] = None,
+              select: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Load files and run the selected analyzers (default: all)."""
+    from . import env_hygiene, lock_discipline, markers, trace_safety
+
+    config = config or Config()
+    analyzers = {
+        "trace": trace_safety.analyze,
+        "lock": lock_discipline.analyze,
+        "env": env_hygiene.analyze,
+        "markers": markers.analyze,
+    }
+    names = list(select) if select else list(analyzers)
+    unknown = [n for n in names if n not in analyzers]
+    if unknown:
+        raise ValueError(f"unknown analyzer(s): {', '.join(unknown)} "
+                         f"(have: {', '.join(analyzers)})")
+    files, findings = load_files(paths)
+    for name in names:
+        findings.extend(analyzers[name](files, config))
+    findings = apply_suppressions(files, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# -- small shared AST helpers -------------------------------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.lax.scan' for nested Attribute/Name chains; '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def iter_functions(tree: ast.AST):
+    """Yield every (Async)FunctionDef/Lambda with its parent chain."""
+    def walk(node, chain):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                yield child, chain
+                yield from walk(child, chain + [child])
+            else:
+                yield from walk(child, chain)
+    yield from walk(tree, [])
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,201 @@
+"""Lock-discipline analyzer.
+
+Two annotation-driven checks over every class in the analyzed tree:
+
+- ``# guarded-by: <lock>`` on a ``self.X = ...`` line in ``__init__``
+  declares that attribute protected by ``self.<lock>``. Every access to
+  ``self.X`` outside ``__init__`` must then be lexically inside a
+  ``with self.<lock>:`` block (``lock-discipline/unguarded``, tag
+  ``lock-ok``). Nested functions do NOT inherit an enclosing ``with`` —
+  they run later, on whatever thread calls them.
+- ``# owned-by: <method>`` declares single-writer thread confinement:
+  the attribute may only be touched by ``__init__``, by ``<method>``
+  (the thread entry), and by functions reachable from it through
+  ``self.<m>()`` calls. Functions that run on the owner thread through
+  an indirection the call graph can't see (e.g. scheduler warmup jobs
+  posted through the admit queue) are declared with
+  ``# graftcheck: runs-on <method>`` on their ``def`` line
+  (``lock-discipline/off-thread``, tag ``lock-ok``).
+
+``__init__`` is exempt from both: construction happens-before any
+thread start (publishing ``self`` out of a constructor that already
+started its threads is a bug this analyzer does not model).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Config, Finding, SourceFile
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, sf: SourceFile, node: ast.ClassDef) -> None:
+        self.sf = sf
+        self.node = node
+        self.guarded: dict[str, str] = {}   # attr -> lock attr
+        self.owned: dict[str, str] = {}     # attr -> owner method
+        self.methods: dict[str, ast.FunctionDef] = {}
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[child.name] = child
+        init = self.methods.get("__init__")
+        scopes = [node] + ([init] if init is not None else [])
+        for scope in scopes:
+            for stmt in ast.walk(scope):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None and isinstance(t, ast.Name):
+                        attr = t.id      # class-level annotation
+                    if attr is None:
+                        continue
+                    lock = sf.guarded_by(stmt.lineno)
+                    if lock:
+                        self.guarded[attr] = lock
+                    owner = sf.owned_by(stmt.lineno)
+                    if owner:
+                        self.owned[attr] = owner
+
+
+def _reachable_methods(info: _ClassInfo, roots: list[str]) -> set[str]:
+    """Methods reachable from ``roots`` via self.<m>() calls (the whole
+    method subtree, nested functions included, is one node — closures
+    run on the caller's thread in the patterns this models)."""
+    seen: set[str] = set()
+    work = [r for r in roots if r in info.methods]
+    while work:
+        name = work.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for node in ast.walk(info.methods[name]):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in info.methods):
+                work.append(node.func.attr)
+    return seen
+
+
+def _check_guarded(info: _ClassInfo, findings: list[Finding]) -> None:
+    sf = info.sf
+
+    def visit(node: ast.AST, held: frozenset[str]) -> None:
+        if isinstance(node, ast.With):
+            newly = set()
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None:
+                    newly.add(attr)
+            inner = held | newly
+            for item in node.items:
+                visit(item.context_expr, held)
+            for stmt in node.body:
+                visit(stmt, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # A nested def does not inherit enclosing locks at run time.
+            for child in ast.iter_child_nodes(node):
+                visit(child, frozenset())
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr in info.guarded:
+            lock = info.guarded[attr]
+            if lock not in held:
+                findings.append(Finding(
+                    sf.path, node.lineno, "lock-discipline/unguarded",
+                    "lock-ok",
+                    f"access to `self.{attr}` (guarded-by {lock}) outside "
+                    f"`with self.{lock}:`"))
+            return   # don't double-report nested names
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for name, method in info.methods.items():
+        if name == "__init__":
+            continue
+        for child in ast.iter_child_nodes(method):
+            visit(child, frozenset())
+
+
+def _check_owned(info: _ClassInfo, findings: list[Finding]) -> None:
+    sf = info.sf
+    by_owner: dict[str, set[str]] = {}
+    for attr, owner in info.owned.items():
+        by_owner.setdefault(owner, set()).add(attr)
+    for owner, attrs in by_owner.items():
+        roots = [owner]
+        for name, method in info.methods.items():
+            if sf.runs_on(method.lineno) == owner:
+                roots.append(name)
+        allowed = _reachable_methods(info, roots) | {"__init__"}
+        for name, method in info.methods.items():
+            if name in allowed:
+                continue
+            for node in ast.walk(method):
+                attr = _self_attr(node)
+                if attr in attrs:
+                    findings.append(Finding(
+                        sf.path, node.lineno, "lock-discipline/off-thread",
+                        "lock-ok",
+                        f"`self.{attr}` is owned-by {owner} but "
+                        f"`{name}` is not reachable from it (annotate "
+                        f"the def with `# graftcheck: runs-on {owner}` "
+                        "if it executes on that thread, or suppress "
+                        "with a reason)"))
+
+
+def analyze(files: list[SourceFile], config: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            info = _ClassInfo(sf, node)
+            if info.guarded:
+                _check_guarded(info, findings)
+            if info.owned:
+                _check_owned(info, findings)
+            # An owned/guarded annotation naming a nonexistent lock or
+            # method is a typo that would silently verify nothing.
+            for attr, lock in info.guarded.items():
+                if not _attr_assigned(node, lock):
+                    findings.append(Finding(
+                        sf.path, node.lineno, "lock-discipline/bad-lock",
+                        "lock-ok",
+                        f"`{attr}` declares guarded-by `{lock}` but no "
+                        f"`self.{lock}` is ever assigned in this class"))
+            for attr, owner in info.owned.items():
+                if owner not in info.methods:
+                    findings.append(Finding(
+                        sf.path, node.lineno, "lock-discipline/bad-owner",
+                        "lock-ok",
+                        f"`{attr}` declares owned-by `{owner}` but the "
+                        "class has no such method"))
+    return findings
+
+
+def _attr_assigned(cls: ast.ClassDef, attr: str) -> bool:
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if _self_attr(t) == attr:
+                    return True
+    return False

@@ -1,0 +1,64 @@
+"""Pytest-marker hygiene analyzer.
+
+``-m 'not slow'`` silently selects EVERYTHING when `slow` is misspelled
+or unregistered — the tier-1 gate would then time out mid-suite and
+skip later tests, which is exactly how the seed lost ~100 tests once.
+This analyzer flags any ``pytest.mark.<name>`` in test files whose name
+is neither registered in pytest.ini's ``markers`` section nor a pytest
+builtin (``markers/unregistered``, tag ``marker-ok``). pytest's own
+``--strict-markers`` (pytest.ini addopts) enforces the same contract at
+collection time; this check catches it pre-test-run in the fast CI gate
+and in editors.
+"""
+
+from __future__ import annotations
+
+import ast
+import configparser
+import os
+
+from .core import Config, Finding, SourceFile, dotted_name
+
+_BUILTIN = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
+            "filterwarnings"}
+
+
+def registered_markers(ini_path: str) -> set[str]:
+    cp = configparser.ConfigParser()
+    try:
+        cp.read(ini_path)
+    except configparser.Error:
+        return set()
+    raw = cp.get("pytest", "markers", fallback="")
+    out = set()
+    for line in raw.splitlines():
+        line = line.strip()
+        if line:
+            out.add(line.split(":", 1)[0].split("(", 1)[0].strip())
+    return out
+
+
+def analyze(files: list[SourceFile], config: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    registered = registered_markers(
+        os.path.join(config.root, config.pytest_ini))
+    allowed = registered | _BUILTIN
+    for sf in files:
+        base = os.path.basename(sf.path)
+        if not (base.startswith("test_") or base == "conftest.py"):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            d = dotted_name(node)
+            if not d.startswith("pytest.mark."):
+                continue
+            name = d.split(".")[2]
+            if name not in allowed:
+                findings.append(Finding(
+                    sf.path, node.lineno, "markers/unregistered",
+                    "marker-ok",
+                    f"marker `{name}` is not registered in "
+                    f"{config.pytest_ini} (a typo here makes "
+                    "`-m 'not <marker>'` silently select everything)"))
+    return findings

@@ -1,0 +1,395 @@
+"""Trace-safety analyzer.
+
+Walks functions reachable from ``jax.jit`` / ``lax.scan`` entry points
+and flags the patterns that silently wreck a compiled hot path:
+
+- ``trace-safety/host-sync`` (tag ``sync-ok``): a forced host sync
+  (``np.asarray``/``np.array``, ``.item()``, ``.tolist()``,
+  ``.block_until_ready()``, ``jax.device_get``) or a host cast
+  (``int()``/``float()``/``bool()`` of a traced value) inside traced
+  code. Under tracing these either fail or, worse, constant-fold a
+  tracer-dependent value into the compiled program.
+- ``trace-safety/tracer-branch`` (tag ``trace-ok``): ``if``/``while``
+  on a traced value — a retrace-per-value hazard (or a concretization
+  error at trace time). Shape/dtype/ndim reads, ``is``/``is not``
+  comparisons, ``isinstance``/``len`` are static under tracing and are
+  exempt; so are parameters conventionally bound to static state
+  (``self``, ``config``, ``mesh``, ``model``, ...) and parameters the
+  jit call declares static.
+- ``trace-safety/jit-in-loop`` (tag ``retrace-ok``): ``jax.jit(...)``
+  called lexically inside a loop body — every iteration builds a fresh
+  wrapper with a fresh compile cache.
+- ``trace-safety/static-unhashable`` (tag ``retrace-ok``): a parameter
+  declared in ``static_argnames``/``static_argnums`` whose default is a
+  list/dict/set — non-hashable statics raise at call time.
+- ``trace-safety/hot-sync`` (tag ``sync-ok``): in the serving hot-path
+  modules (config.hot_sync_modules), EVERY forced sync must carry an
+  explicit ``# graftcheck: sync-ok <reason>`` annotation — the
+  scheduler's intentional readbacks are fine, but each one is a
+  latency decision that must be visible in the diff.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Config, Finding, SourceFile, dotted_name, str_const
+
+# Parameters conventionally bound to static (non-traced) state in this
+# codebase; branch checks skip them (documented in docs/static-analysis.md).
+STATIC_PARAM_NAMES = {"self", "cls", "config", "cfg", "mesh", "model",
+                      "tokenizer", "sample_fn"}
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "onp.asarray", "onp.array", "jax.device_get"}
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_HOT_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray",
+                   "numpy.array", "jax.device_get"}
+_HOT_SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+# Library roots whose attribute calls never resolve to in-tree functions.
+_LIB_ROOTS = {"np", "jnp", "jax", "numpy", "lax", "os", "time", "math",
+              "queue", "threading", "logging", "functools", "json",
+              "socket", "struct", "secrets", "hashlib", "re", "sys",
+              "itertools", "collections", "dataclasses"}
+
+
+def _is_jit_name(node: ast.AST) -> bool:
+    d = dotted_name(node)
+    return d == "jit" or d.endswith(".jit")
+
+
+def _is_scan_name(node: ast.AST) -> bool:
+    d = dotted_name(node)
+    return d == "scan" or d.endswith("lax.scan")
+
+
+def _partial_target(call: ast.Call) -> Optional[ast.AST]:
+    """For functools.partial(f, ...) return f, else None."""
+    d = dotted_name(call.func)
+    if d == "partial" or d.endswith(".partial"):
+        if call.args:
+            return call.args[0]
+    return None
+
+
+def _static_names_from_jit(call: ast.Call,
+                           fn: Optional[ast.FunctionDef]) -> set[str]:
+    """Parameter names declared static on a jit call/decorator."""
+    out: set[str] = set()
+    params: list[str] = []
+    if fn is not None:
+        params = [a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)]
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            vals = (kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value])
+            for v in vals:
+                s = str_const(v)
+                if s:
+                    out.add(s)
+        elif kw.arg == "static_argnums":
+            vals = (kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value])
+            for v in vals:
+                if (isinstance(v, ast.Constant)
+                        and isinstance(v.value, int)
+                        and 0 <= v.value < len(params)):
+                    out.add(params[v.value])
+    return out
+
+
+class _FileIndex:
+    """Per-file function defs keyed by name. Methods (direct children of
+    a ClassDef) are excluded from call resolution: resolving a bare
+    ``x.get(...)`` / ``x.decode(...)`` against every same-named method in
+    the tree pulls whole unrelated classes into the traced-reachable set
+    (measured: the DHT routing table via dict ``.get``)."""
+
+    def __init__(self, sf: SourceFile) -> None:
+        self.sf = sf
+        self.defs: dict[str, list[ast.FunctionDef]] = {}
+        method_ids = {id(m) for node in ast.walk(sf.tree)
+                      if isinstance(node, ast.ClassDef)
+                      for m in node.body
+                      if isinstance(m, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(node) not in method_ids:
+                self.defs.setdefault(node.name, []).append(node)
+
+
+def _own_body_nodes(fn: ast.AST):
+    """Walk a function's subtree, NOT descending into nested defs/lambdas
+    (they are separate nodes in the call graph / reachable set)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def analyze(files: list[SourceFile], config: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    indexes = {sf.path: _FileIndex(sf) for sf in files}
+    global_defs: dict[str, list[tuple[SourceFile, ast.FunctionDef]]] = {}
+    for sf in files:
+        for name, nodes in indexes[sf.path].defs.items():
+            for n in nodes:
+                global_defs.setdefault(name, []).append((sf, n))
+
+    # -- entry detection -----------------------------------------------------
+    # entries: (SourceFile, fn node) plus static-arg names per node id.
+    entries: list[tuple[SourceFile, ast.FunctionDef]] = []
+    static_args: dict[int, set[str]] = {}
+
+    def resolve_target(sf: SourceFile, target: ast.AST,
+                       jit_call: Optional[ast.Call]) -> None:
+        inner = _partial_target(target) if isinstance(target, ast.Call) \
+            else None
+        if inner is not None:
+            target = inner
+        cands: list[tuple[SourceFile, ast.FunctionDef]] = []
+        if isinstance(target, ast.Name):
+            for n in indexes[sf.path].defs.get(target.id, []):
+                cands.append((sf, n))
+            if not cands:
+                cands = list(global_defs.get(target.id, []))
+        elif isinstance(target, ast.Attribute):
+            cands = list(global_defs.get(target.attr, []))
+        for csf, cnode in cands:
+            entries.append((csf, cnode))
+            if jit_call is not None:
+                static_args.setdefault(id(cnode), set()).update(
+                    _static_names_from_jit(jit_call, cnode))
+
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_jit_name(dec):
+                        entries.append((sf, node))
+                    elif isinstance(dec, ast.Call):
+                        if _is_jit_name(dec.func):
+                            entries.append((sf, node))
+                            static_args.setdefault(id(node), set()).update(
+                                _static_names_from_jit(dec, node))
+                        else:
+                            pt = _partial_target(dec)
+                            if pt is not None and _is_jit_name(pt):
+                                entries.append((sf, node))
+                                static_args.setdefault(
+                                    id(node), set()).update(
+                                    _static_names_from_jit(dec, node))
+            elif isinstance(node, ast.Call):
+                if _is_jit_name(node.func) and node.args:
+                    resolve_target(sf, node.args[0], node)
+                elif _is_scan_name(node.func) and node.args:
+                    resolve_target(sf, node.args[0], None)
+
+    # -- reachability over the in-tree call graph ----------------------------
+    reachable: dict[int, tuple[SourceFile, ast.FunctionDef]] = {}
+    work = list(entries)
+    while work:
+        sf, fn = work.pop()
+        if id(fn) in reachable:
+            continue
+        reachable[id(fn)] = (sf, fn)
+        for node in _own_body_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            cands: list[tuple[SourceFile, ast.FunctionDef]] = []
+            if isinstance(node.func, ast.Name):
+                local = indexes[sf.path].defs.get(node.func.id, [])
+                cands = ([(sf, n) for n in local]
+                         or list(global_defs.get(node.func.id, [])))
+            elif isinstance(node.func, ast.Attribute):
+                base = node.func.value
+                root = base.id if isinstance(base, ast.Name) else ""
+                if root not in _LIB_ROOTS:
+                    cands = list(global_defs.get(node.func.attr, []))
+            work.extend(cands)
+
+    # -- per-function trace rules --------------------------------------------
+    for sf, fn in reachable.values():
+        if isinstance(fn, ast.Lambda):
+            continue
+        # Tracedness follows the codebase's type annotations: a parameter
+        # annotated with a non-Array type (int, str, Mesh, ModelConfig,
+        # ...) is a static Python value at trace time. Unannotated
+        # parameters are assumed traced (conservative), except the
+        # conventional static names. Branches on pytree *container*
+        # fields (e.g. cache.quantized) are not modeled — containers
+        # count as traced only when their annotation names Array/Cache.
+        tainted = set()
+        for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs):
+            if a.arg in STATIC_PARAM_NAMES \
+                    or a.arg in static_args.get(id(fn), set()):
+                continue
+            if a.annotation is not None:
+                try:
+                    ann = ast.unparse(a.annotation)
+                except Exception:  # pragma: no cover - unparse is total
+                    ann = ""
+                if not ("Array" in ann or "ndarray" in ann
+                        or "Any" in ann):
+                    continue
+            tainted.add(a.arg)
+
+        def expr_tainted(e: ast.AST) -> bool:
+            if isinstance(e, ast.Attribute) and e.attr in (
+                    "shape", "ndim", "dtype", "size"):
+                return False            # static under tracing
+            if isinstance(e, ast.Call):
+                d = dotted_name(e.func)
+                if d in ("len", "isinstance", "hasattr", "callable"):
+                    return False
+            if isinstance(e, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return False            # identity checks are static
+            if isinstance(e, ast.Name):
+                return e.id in tainted
+            return any(expr_tainted(c) for c in ast.iter_child_nodes(e))
+
+        # One flow-sensitive pass in source order: taint propagates
+        # through assignments as they appear, and the branch/sync checks
+        # see only taint introduced ABOVE them (a later `cache = <traced>`
+        # rebind must not retroactively taint an earlier
+        # `ps = cache.page_size`). Loop-carried taint (a name tainted at
+        # the bottom of a loop body, read at the top) is a documented
+        # miss of this heuristic.
+        ordered = sorted(_own_body_nodes(fn),
+                         key=lambda n: (getattr(n, "lineno", 0),
+                                        getattr(n, "col_offset", 0)))
+        for node in ordered:
+            targets: list[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.NamedExpr):
+                targets, value = [node.target], node.value
+            if value is not None and expr_tainted(value):
+                for t in targets:
+                    elts = (t.elts if isinstance(
+                        t, (ast.Tuple, ast.List)) else [t])
+                    for el in elts:
+                        if isinstance(el, ast.Starred):
+                            el = el.value
+                        if isinstance(el, ast.Name):
+                            tainted.add(el.id)
+            if isinstance(node, ast.Call):
+                d = dotted_name(node.func)
+                if d in _SYNC_CALLS:
+                    findings.append(Finding(
+                        sf.path, node.lineno, "trace-safety/host-sync",
+                        "sync-ok",
+                        f"`{d}` inside code reachable from a jax.jit/"
+                        "lax.scan entry point forces a host sync (or "
+                        "constant-folds a tracer)"))
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _SYNC_METHODS
+                        and not node.args and not node.keywords):
+                    findings.append(Finding(
+                        sf.path, node.lineno, "trace-safety/host-sync",
+                        "sync-ok",
+                        f"`.{node.func.attr}()` inside traced code forces "
+                        "a host sync"))
+                elif (d in ("int", "float", "bool") and len(node.args) == 1
+                        and expr_tainted(node.args[0])):
+                    findings.append(Finding(
+                        sf.path, node.lineno, "trace-safety/host-sync",
+                        "sync-ok",
+                        f"`{d}(...)` of a traced value concretizes the "
+                        "tracer (host sync / trace error)"))
+            elif isinstance(node, (ast.If, ast.While)):
+                if expr_tainted(node.test):
+                    findings.append(Finding(
+                        sf.path, node.lineno, "trace-safety/tracer-branch",
+                        "trace-ok",
+                        "Python branch on a traced value inside jit-"
+                        "reachable code (use lax.cond/jnp.where, or mark "
+                        "the argument static)"))
+
+    # -- retrace hazards (whole tree, reachability-independent) --------------
+    for sf in files:
+        idx = indexes[sf.path]
+        for fn, _chain in _iter_fns(sf.tree):
+            loops = [n for n in _own_body_nodes(fn)
+                     if isinstance(n, (ast.For, ast.While))]
+            for loop in loops:
+                for node in _own_body_nodes(loop):
+                    if isinstance(node, ast.Call) and _is_jit_name(node.func):
+                        findings.append(Finding(
+                            sf.path, node.lineno,
+                            "trace-safety/jit-in-loop", "retrace-ok",
+                            "jax.jit(...) called inside a loop body builds "
+                            "a fresh wrapper (and compile cache) every "
+                            "iteration — hoist it"))
+        jit_bindings: list[tuple[ast.Call, ast.FunctionDef]] = []
+        for node in ast.walk(sf.tree):
+            # jax.jit(f, static_argnames=...) call form
+            if (isinstance(node, ast.Call) and _is_jit_name(node.func)
+                    and node.args and isinstance(node.args[0], ast.Name)):
+                defs = idx.defs.get(node.args[0].id, [])
+                if defs:
+                    jit_bindings.append((node, defs[0]))
+            # @jax.jit(...) / @functools.partial(jax.jit, ...) decorators
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    pt = _partial_target(dec)
+                    if _is_jit_name(dec.func) or (
+                            pt is not None and _is_jit_name(pt)):
+                        jit_bindings.append((dec, node))
+        for call, target in jit_bindings:
+            statics = _static_names_from_jit(call, target)
+            if not statics:
+                continue
+            args = target.args
+            named = args.posonlyargs + args.args
+            defaults = args.defaults
+            for p, d in zip(named[len(named) - len(defaults):], defaults):
+                if p.arg in statics and isinstance(
+                        d, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(Finding(
+                        sf.path, target.lineno,
+                        "trace-safety/static-unhashable", "retrace-ok",
+                        f"static arg `{p.arg}` defaults to a non-hashable "
+                        "literal — jit static args must be hashable"))
+
+    # -- hot-path forced-sync annotations ------------------------------------
+    for sf in files:
+        norm = sf.path.replace("\\", "/")
+        if not any(norm.endswith(m) for m in config.hot_sync_modules):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            hit = None
+            if d in _HOT_SYNC_CALLS:
+                hit = d
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOT_SYNC_METHODS
+                    and not node.args and not node.keywords):
+                hit = f".{node.func.attr}()"
+            if hit is not None:
+                findings.append(Finding(
+                    sf.path, node.lineno, "trace-safety/hot-sync",
+                    "sync-ok",
+                    f"forced host sync `{hit}` on the serving hot path "
+                    "must carry `# graftcheck: sync-ok <reason>`"))
+    return findings
+
+
+def _iter_fns(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, None

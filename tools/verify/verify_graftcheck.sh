@@ -1,0 +1,95 @@
+#!/bin/bash
+# Verify the graftcheck static-analysis gate end-to-end: the shipped
+# tree must pass, and a seeded violation of each analyzer must fail the
+# same invocation ci.sh runs (acceptance criterion: ci.sh fails when an
+# unguarded write to a `# guarded-by:` attribute is introduced).
+set -u
+cd /root/repo
+mkdir -p /tmp/v
+
+fail() { echo "FAIL: $1"; exit 1; }
+
+# 1. Shipped tree is clean (the exact ci.sh invocation).
+python -m tools.graftcheck p2p_llm_chat_tpu bench.py start_all.py tests \
+  >/tmp/v/graftcheck_clean.log 2>&1 \
+  || fail "shipped tree has findings: $(tail -3 /tmp/v/graftcheck_clean.log)"
+
+# 2. Each seeded violation fixture flags (non-zero exit, right rule).
+SEED=/tmp/v/graftcheck_seed
+rm -rf "$SEED"; mkdir -p "$SEED"
+
+seed_expect() {  # <fixture.py> <expected-rule>
+  local fixture=$1 rule=$2
+  python -m tools.graftcheck "$fixture" --root "$SEED" \
+    >/tmp/v/graftcheck_seed.log 2>&1
+  [ $? -eq 1 ] || fail "$fixture: expected exit 1"
+  grep -q "$rule" /tmp/v/graftcheck_seed.log \
+    || fail "$fixture: expected $rule, got $(cat /tmp/v/graftcheck_seed.log)"
+}
+
+cat > "$SEED/trace.py" <<'EOF'
+import jax, numpy as np
+
+@jax.jit
+def step(x):
+    return np.asarray(x) + 1
+EOF
+seed_expect "$SEED/trace.py" "trace-safety/host-sync"
+
+cat > "$SEED/lock.py" <<'EOF'
+import threading
+
+class Store:
+    def __init__(self):
+        self._data = {}       # guarded-by: _mu
+        self._mu = threading.Lock()
+
+    def unguarded_write(self, k, v):
+        self._data[k] = v
+EOF
+seed_expect "$SEED/lock.py" "lock-discipline/unguarded"
+
+cat > "$SEED/envread.py" <<'EOF'
+import os
+addr = os.environ.get("SERVE_ADDR", "")
+EOF
+seed_expect "$SEED/envread.py" "env-hygiene/raw-read"
+
+cat > "$SEED/test_marker.py" <<'EOF'
+import pytest
+
+@pytest.mark.sloow
+def test_x():
+    pass
+EOF
+seed_expect "$SEED/test_marker.py" "markers/unregistered"
+
+# 3. ci.sh itself fails on a seeded in-tree violation: an unguarded
+# write to a guarded-by attribute, appended to dht.py in a scratch
+# copy of the tree (the real tree is never touched).
+TREE=/tmp/v/graftcheck_tree
+rm -rf "$TREE"; mkdir -p "$TREE"
+cp -r p2p_llm_chat_tpu tools bench.py start_all.py ci.sh pytest.ini \
+      docs "$TREE/"
+mkdir -p "$TREE/tests"   # graftcheck target dir; tests themselves not needed
+# Seed an unguarded METHOD on DHTNode (guarded-by is per-class, so the
+# violation must live inside the class body).
+python - "$TREE" <<'EOF'
+import sys
+tree = sys.argv[1]
+p = f"{tree}/p2p_llm_chat_tpu/p2p/dht.py"
+src = open(p).read()
+marker = "    def close(self)"
+assert marker in src, "seed anchor missing"
+seeded = ("    def _seeded_violation(self):\n"
+          "        self._store[0] = None\n\n" + marker)
+open(p, "w").write(src.replace(marker, seeded, 1))
+EOF
+(cd "$TREE" && python -m tools.graftcheck p2p_llm_chat_tpu \
+  >/tmp/v/graftcheck_ci.log 2>&1)
+[ $? -eq 1 ] || fail "seeded tree: graftcheck did not flag the violation"
+grep -q "lock-discipline/unguarded" /tmp/v/graftcheck_ci.log \
+  || fail "seeded tree: wrong rule: $(cat /tmp/v/graftcheck_ci.log)"
+
+echo "PASS: graftcheck gates clean tree + flags seeded violations"
+exit 0
