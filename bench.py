@@ -76,6 +76,21 @@ Env knobs (all optional):
                         tokens (0 = the short suggestion template).
                         Exercises chunked-flash prefill and long-window
                         paged decode; size BENCH_MAX_SEQ to fit it.
+- ``BENCH_PREFILL_CHUNK`` chunked-prefill token budget for the serving
+                        scheduler (default 256; 0 = legacy whole-bucket
+                        admission)
+- ``BENCH_MIXED``       mixed-load phase (default 1): Poisson arrivals
+                        of long prompts while the batch decodes,
+                        reporting inter-token p50/p95 (TBT) and the max
+                        decode-tick gap — once with chunked prefill,
+                        once single-shot, so the admission stall the
+                        chunking bounds is measured, not inferred.
+                        TTFT alone cannot see it: a whole-bucket
+                        prefill stalls OTHER streams' tokens.
+- ``BENCH_ARRIVAL_CTX`` mixed-phase arrival prompt length in tokens
+                        (default 384 -> a 512 bucket, two chunks)
+- ``BENCH_ARRIVAL_N``   mixed-phase arrival count (default 6)
+- ``BENCH_ARRIVAL_RATE`` mixed-phase Poisson arrival rate, 1/s (default 4)
 - ``BENCH_PROFILE``     directory for a jax.profiler trace of the
                         concurrent section
 """
@@ -333,6 +348,14 @@ def main() -> None:
     admit_chunk = env_int("BENCH_ADMIT_CHUNK", 0) or None
     spec_k = env_int("BENCH_SPEC", 0)
     use_prefix = env_bool("BENCH_PREFIX", True)
+    # Chunked prefill (serve/scheduler.py prefill_chunk) + the mixed-load
+    # phase that measures the admission stall it bounds.
+    bench_chunk = max(0, env_int("BENCH_PREFILL_CHUNK", 256))
+    mixed = env_bool("BENCH_MIXED", True)
+    arr_ctx = env_int("BENCH_ARRIVAL_CTX", 384)
+    arr_n = env_int("BENCH_ARRIVAL_N", 6)
+    arr_rate = max(0.1, env_float("BENCH_ARRIVAL_RATE", 4.0))
+    mixed_new = max(64, 4 * new_tokens) if mixed else 0
     tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
     prompt = ("Draft a concise, friendly reply to the following message:\n\n"
               "Hey, are we still meeting tomorrow at 10?\n\nReply:")
@@ -351,8 +374,14 @@ def main() -> None:
     serve_pages = None
     if kv_mode == "paged":
         eff_max = min(max_seq, config.max_seq_len)
-        per_req = -(-(len(prompt) + 1 + new_tokens + spec_k + 2)
-                    // page_size) + 1
+        # Worst per-row shape across phases: the short suggestion, the
+        # mixed-phase decode rows (longer completions), and the
+        # mixed-phase long arrivals.
+        shapes = [len(prompt) + 1 + new_tokens + spec_k + 2]
+        if mixed:
+            shapes.append(len(prompt) + 1 + mixed_new + spec_k + 2)
+            shapes.append(arr_ctx + 32 + new_tokens + spec_k + 2)
+        per_req = max(-(-s // page_size) + 1 for s in shapes)
         per_req = min(per_req, -(-eff_max // page_size))
         serve_pages = slots * per_req + 1
     sched = BatchScheduler(params, config, tokenizer, num_slots=slots,
@@ -360,7 +389,8 @@ def main() -> None:
                            page_size=page_size, num_pages=serve_pages,
                            admit_chunk=admit_chunk,
                            spec_k=spec_k, prefix_cache=use_prefix,
-                           kv_quant=kv_quant, decode_fuse_max=fuse_k)
+                           kv_quant=kv_quant, decode_fuse_max=fuse_k,
+                           prefill_chunk=bench_chunk)
     # BENCH_TEMP=0 (greedy) is the honest speculative-decoding workload:
     # prompt-lookup drafts only land when the model's continuation repeats
     # earlier n-grams, which greedy decoding does and temperature-0.7
@@ -386,11 +416,25 @@ def main() -> None:
     eff_max = sched.max_seq        # BENCH_MAX_SEQ capped by the config
     plen = len(tokenizer.encode(prompt, add_bos=True))
     pbucket = _bucket(min(plen, eff_max - 2), eff_max)
-    buckets = tuple(sorted({64, 128, pbucket} if use_prefix
-                           else {128, pbucket}))
+    bucket_set = {64, 128, pbucket} if use_prefix else {128, pbucket}
+    arr_bucket = 0
+    if mixed:
+        # The mixed-phase arrivals land in their own (long) bucket; warm
+        # it — its chunk ladder when chunking is on — or the first
+        # arrival's compile would masquerade as an admission stall.
+        arr_bucket = _bucket(min(arr_ctx + 1, eff_max - 2), eff_max)
+        bucket_set.add(arr_bucket)
+    buckets = tuple(sorted(bucket_set))
     # Fused ticks read up to (pipelined + fused) steps past the context;
     # cover them so no decode window compiles lazily mid-bench.
-    need = min(plen + new_tokens + spec_k + 2 * fuse_k + 2, eff_max)
+    deepest_ctx = plen + new_tokens
+    if mixed:
+        # Mixed-phase rows decode deeper (longer completions; long
+        # arrivals) — an unwarmed window would lazily compile mid-phase
+        # and masquerade as a multi-second admission stall.
+        deepest_ctx = max(deepest_ctx, plen + mixed_new,
+                          min(arr_ctx + 1, eff_max - 2) + new_tokens)
+    need = min(deepest_ctx + spec_k + 2 * fuse_k + 2, eff_max)
     ws, w = [], 128
     while True:
         ws.append(w)
@@ -399,6 +443,15 @@ def main() -> None:
         w *= 2
     sched.warmup(prompt_buckets=buckets, windows=tuple(ws),
                  prefix_texts=(prompt,) if use_prefix else ())
+    if mixed and sched.prefill_chunk:
+        # The single-shot half of the mixed-load comparison runs with
+        # chunking toggled off, which takes the whole-bucket programs
+        # warmup skipped in favor of the chunk ladders — compile them
+        # now (same buckets, so _warmed_buckets stays the full set;
+        # already-compiled shapes are cache hits).
+        chunk_saved, sched.prefill_chunk = sched.prefill_chunk, 0
+        sched.warmup(prompt_buckets=buckets, windows=())
+        sched.prefill_chunk = chunk_saved
     run_one(RequestStats())
     # Single-request TTFT (the config-2 "drop-in OLLAMA_URL" number).
     s1 = RequestStats()
@@ -432,6 +485,105 @@ def main() -> None:
     served_tok_s = done_tokens / wall
     log(f"{slots} concurrent: p50 TTFT {p50:.1f} ms, p95 {p95:.1f} ms, "
         f"served {done_tokens} tokens in {wall:.2f}s ({served_tok_s:,.0f} tok/s)")
+
+    # -- mixed-load phase: Poisson arrivals of long prompts while the
+    # batch decodes. TTFT cannot see prefill/decode interference — a
+    # whole-bucket admission stalls the OTHER streams' tokens — so this
+    # phase measures what chunked prefill actually bounds: the
+    # inter-token gap (TBT, client-side, per delta) and the scheduler's
+    # max decode-tick gap attributable to admission (decode_stall_ms).
+    # Runs twice over the same warmed scheduler — chunked first, then
+    # single-shot (prefill_chunk=0) — with the max gauge reset at each
+    # phase start (reset_decode_stall), so each half reports ITS OWN max
+    # gap rather than a lifetime max polluted by earlier phases.
+    mixed_stats: dict = {}
+    if mixed and arr_n > 0:
+        import random
+
+        def _pct(xs, p):
+            if not xs:
+                return None
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))]
+
+        # Leave a few rows free so arrivals admit INTO live decode
+        # traffic instead of queueing behind a full batch.
+        decode_rows = max(1, slots - max(2, min(4, slots // 8)))
+        arr_history = ("Earlier in this thread we discussed the quarterly "
+                       "plans and the picnic schedule. ")
+        arr_chars = max(8, arr_ctx - 1)   # byte tokenizer: +BOS ~= arr_ctx
+
+        def mixed_phase(label: str) -> dict:
+            sched.reset_decode_stall()
+            chunks0 = sched.metrics_snapshot()["prefill_chunks_total"]
+            gap_mu = threading.Lock()
+            gaps: list[float] = []
+
+            def run_decode(seed: int) -> None:
+                o = GenerateOptions(max_tokens=mixed_new,
+                                    temperature=bench_temp, top_p=0.9,
+                                    seed=seed)
+                last = None
+                mine: list[float] = []
+                for _ in sched.submit(
+                        GenerateRequest(prompt=prompt, options=o),
+                        RequestStats()):
+                    t_now = time.monotonic()
+                    if last is not None:
+                        mine.append((t_now - last) * 1e3)
+                    last = t_now
+                with gap_mu:
+                    gaps.extend(mine)
+
+            def run_arrival(i: int) -> None:
+                # Unique head per arrival: identical heads would trip
+                # prefix auto-promotion mid-phase (a build + new splice
+                # programs — compiles that would pollute the stall).
+                ap = (f"mixed {label} req {i:04d}: "
+                      + arr_history * (arr_chars // len(arr_history) + 1)
+                      )[:arr_chars]
+                for _ in sched.submit(
+                        GenerateRequest(prompt=ap, options=opts),
+                        RequestStats()):
+                    pass
+
+            dts = [threading.Thread(target=run_decode, args=(i,))
+                   for i in range(decode_rows)]
+            for th in dts:
+                th.start()
+            time.sleep(0.3)     # let the decode rows admit and stream
+            rng = random.Random(0)
+            ats = []
+            for i in range(arr_n):
+                time.sleep(rng.expovariate(arr_rate))
+                th = threading.Thread(target=run_arrival, args=(i,))
+                th.start()
+                ats.append(th)
+            for th in ats + dts:
+                th.join()
+            snap = sched.metrics_snapshot()
+            out = {
+                "tbt_p50_ms": round(_pct(gaps, 50) or 0.0, 2),
+                "tbt_p95_ms": round(_pct(gaps, 95) or 0.0, 2),
+                "tbt_max_ms": round(max(gaps), 2) if gaps else None,
+                "decode_stall_ms": snap["decode_stall_ms"],
+                "prefill_chunks": snap["prefill_chunks_total"] - chunks0,
+            }
+            log(f"mixed load ({label}): TBT p50 {out['tbt_p50_ms']} ms, "
+                f"p95 {out['tbt_p95_ms']} ms, max decode-tick gap "
+                f"{out['decode_stall_ms']} ms, "
+                f"{out['prefill_chunks']} chunk dispatches")
+            return out
+
+        mixed_stats = {"arrival_bucket": arr_bucket, "arrivals": arr_n,
+                       "arrival_rate_hz": arr_rate,
+                       "decode_rows": decode_rows,
+                       "prefill_chunk": sched.prefill_chunk or None}
+        if sched.prefill_chunk:
+            mixed_stats["chunked"] = mixed_phase("chunked")
+        chunk_saved, sched.prefill_chunk = sched.prefill_chunk, 0
+        mixed_stats["single_shot"] = mixed_phase("single-shot")
+        sched.prefill_chunk = chunk_saved
     sched.stop()
 
     result = {
@@ -471,6 +623,13 @@ def main() -> None:
                                           if fused_wall_step_ms else None),
             "wall_over_device": round(
                 (fused_wall_step_ms or wall_step_ms) / step_ms, 3),
+            # Chunked prefill (BENCH_PREFILL_CHUNK) + the mixed-load
+            # interference numbers: TBT p50/p95 and the max decode-tick
+            # gap, chunked vs single-shot admission over the same warmed
+            # scheduler (the gap must be bounded by one chunk's compute,
+            # not the whole prompt's prefill).
+            "prefill_chunk": sched.prefill_chunk or None,
+            "mixed_load": mixed_stats or None,
             "ttft_single_ms": round(ttft_single_ms, 2),
             # TTFT pays at least one dispatch+readback of tunnel RTT
             # that a local v5e host would not; this subtracts the

@@ -52,6 +52,15 @@ if [ "${1:-}" = "full" ]; then
     TSAN_OPTIONS=halt_on_error=1:exitcode=66 \
     python -m pytest tests/test_native_splice.py -q -x || rc=1
 
+  # The chunked-prefill exact model-level asserts skip under the
+  # suite's 8-virtual-device topology (1-ulp reduction-partitioning
+  # drift — see the file docstring), so the full sweep alone would
+  # leave the bit-identity contract unpinned. Run the file once on the
+  # single-device reference platform where every assert executes.
+  echo "== chunked-prefill parity (single-device CPU)"
+  XLA_FLAGS=--xla_force_host_platform_device_count=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_chunked_prefill.py -q -x || rc=1
+
   echo "== full test suite"
   python -m pytest tests/ -q || rc=1
 else
@@ -63,9 +72,20 @@ else
   echo "== fused-decode parity (CPU)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_fused_decode.py -q -x || rc=1
 
+  # Chunked-prefill parity pinned on a SINGLE-device CPU: that is the
+  # bit-exact reference platform — the suite's default 8-virtual-device
+  # topology drifts the whole-prompt vs chunk forwards by 1 ulp
+  # (reduction partitioning by query width; see the file docstring),
+  # under which the exact model-level asserts skip. Excluded from the
+  # generic sweep below so it executes exactly once.
+  echo "== chunked-prefill parity (single-device CPU)"
+  XLA_FLAGS=--xla_force_host_platform_device_count=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_chunked_prefill.py -q -x || rc=1
+
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
     --ignore=tests/test_fused_decode.py \
+    --ignore=tests/test_chunked_prefill.py \
     --ignore=tests/test_stress.py \
     --ignore=tests/test_serve_tp.py \
     --ignore=tests/test_mixtral_parity.py \

@@ -579,6 +579,47 @@ def prefill(params: dict, config: ModelConfig, tokens: jax.Array,
     return logits, cache._replace(lengths=prompt_lens.astype(jnp.int32))
 
 
+def prefill_chunk(params: dict, config: ModelConfig, tokens: jax.Array,
+                  cache: KVCache, offset: int,
+                  mesh: Optional[Mesh] = None,
+                  rules: LogicalRules = DEFAULT_RULES,
+                  last_idx: Optional[jax.Array] = None,
+                  mlp_fn=None) -> tuple[jax.Array, KVCache]:
+    """Continuation prefill: C prompt tokens per row at positions
+    ``offset .. offset+C``, resuming from a partial KV already in
+    ``cache`` — the chunked-admission unit (serve/scheduler.py splits a
+    long prompt into fixed token-budget chunks so one admission never
+    stalls in-flight decodes for the whole prompt's prefill). The same
+    offset-mask continuation shape the prefix-cache prologue and the
+    speculative verify path use.
+
+    tokens: [B,C]; each row writes cache slots offset..offset+C and
+    attends the FULL cache width under a ``causal_mask(C, W, offset)``
+    — deliberately NOT a trimmed ``kv_window``. Masked not-yet-written
+    tail keys carry exactly-zero probability, so every softmax/matmul
+    reduction runs at the same padded width as the single-shot prefill
+    and the emitted KV and logits are BIT-identical to one whole-prompt
+    dispatch (a narrower window changes XLA's reduction blocking and
+    drifts last bits — measured; pinned by tests/test_chunked_prefill).
+    The full-width scores add no FLOPs chunking could have saved: the
+    single-shot path computes the same [S, W] score matrix at once.
+
+    ``last_idx`` ([B] int): CHUNK-LOCAL position to gather logits at
+    ([B,1,vocab]) — the admission path clamps each row's last prompt
+    position into this chunk and keeps the gather only for rows whose
+    last position actually falls here. Cache lengths are NOT set; the
+    caller installs total lengths atomically with the final chunk so a
+    half-prefilled row never looks live.
+
+    Returns (logits [B,1,vocab] (or [B,C,vocab] without last_idx),
+    cache with the chunk's slots written, lengths untouched)."""
+    B, C = tokens.shape
+    positions = jnp.broadcast_to(offset + jnp.arange(C)[None, :], (B, C))
+    mask = causal_mask(C, cache.k.shape[2], offset)
+    return forward(params, config, tokens, positions, cache, mask, mesh,
+                   rules, mlp_fn=mlp_fn, last_idx=last_idx)
+
+
 def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
                 cache: KVCache, mesh: Optional[Mesh] = None,
                 rules: LogicalRules = DEFAULT_RULES,

@@ -309,6 +309,27 @@ def prefill(params: dict, config: ModelConfig, tokens: jax.Array,
     return logits, cache._replace(lengths=prompt_lens.astype(jnp.int32))
 
 
+def prefill_chunk(params: dict, config: ModelConfig, tokens: jax.Array,
+                  cache: KVCache, offset: int,
+                  mesh: Optional[Mesh] = None,
+                  rules: LogicalRules = DEFAULT_RULES,
+                  last_idx: Optional[jax.Array] = None,
+                  capacity=_AUTO) -> tuple[jax.Array, KVCache]:
+    """llama.prefill_chunk with the MoE MLP (continuation prefill for
+    chunked admission; same offset-mask/full-width bit-identity
+    contract). Caveat: under a bounding ``moe_capacity_factor`` the
+    expert bucket scales with the CHUNK's token count, so overflow drops
+    can differ from the whole-prompt bucket's — the dropless default
+    (capacity None, all test/tiny configs) is exactly bit-identical,
+    capacity-bounded configs are exact only while no bucket overflows
+    (the same approximation class the capacity policy already accepts)."""
+    cap = _capacity_for(config, int(tokens.shape[0] * tokens.shape[1]),
+                        capacity)
+    return llama.prefill_chunk(params, config, tokens, cache, offset, mesh,
+                               rules, last_idx=last_idx,
+                               mlp_fn=_mlp_fn(config, cap))
+
+
 def decode_step(params: dict, config: ModelConfig, tokens: jax.Array,
                 cache: KVCache, mesh: Optional[Mesh] = None,
                 rules: LogicalRules = DEFAULT_RULES,

@@ -301,11 +301,32 @@ def write_prefill_batch(cache: PagedKVCache, chunk_k: jax.Array,
     invariant. Logical pages past the allocation land in page 0.
     """
     L, R, S, Hkv, D = chunk_k.shape
+    P, ps_eff = _page_tiling(S, cache.page_size)
+    phys = tables[:, :P].reshape(R * P).astype(jnp.int32)
+    cache = _tile_scatter(cache, chunk_k, chunk_v, phys, P, ps_eff)
+    table = cache.page_table.at[rows].set(tables.astype(jnp.int32),
+                                          mode="drop")
+    lengths = cache.lengths.at[rows].set(lens.astype(cache.lengths.dtype),
+                                         mode="drop")
+    return cache._replace(page_table=table, lengths=lengths)
+
+
+def _page_tiling(S: int, ps: int) -> tuple[int, int]:
+    """(page tiles P, effective tile width): a sub-page span is one
+    partial leading tile; otherwise ceil(S/ps) full-width tiles (the
+    last padded by _tile_scatter when S doesn't page-align)."""
+    return (1, S) if S < ps else (-(-S // ps), ps)
+
+
+def _tile_scatter(cache: PagedKVCache, chunk_k: jax.Array,
+                  chunk_v: jax.Array, phys: jax.Array, P: int,
+                  ps_eff: int) -> PagedKVCache:
+    """The page-tile window scatter shared by write_prefill_batch and
+    write_prefill_chunk's aligned path: one [L,<=page_size,Hkv,D] copy
+    per (row, logical page), ``phys`` [R*P] the physical page per tile.
+    Tables/lengths are NOT touched — callers own that install."""
+    L, R, S = chunk_k.shape[:3]
     ps = cache.page_size
-    if S < ps:
-        P, ps_eff = 1, S
-    else:
-        P, ps_eff = -(-S // ps), ps
 
     # [L,R,S,...] -> [L, R*P, ps_eff, ...]: one pool page per (row,
     # logical page) — a pure reshape under the token-major layout (pads
@@ -317,17 +338,68 @@ def write_prefill_batch(cache: PagedKVCache, chunk_k: jax.Array,
             x = jnp.pad(x, pad)
         return x.reshape(L, R * P, ps_eff, *x.shape[3:])
 
-    phys = tables[:, :P].reshape(R * P).astype(jnp.int32)
-    cache = _scatter_kv(cache, chunk_k, chunk_v,
-                        lambda arr, upd: arr.at[:, phys, :ps_eff].set(
-                            tiles(upd), mode="drop"),
-                        lambda arr, upd: arr.at[:, phys, :, :ps_eff].set(
-                            tiles(upd).transpose(0, 1, 3, 2), mode="drop"))
-    table = cache.page_table.at[rows].set(tables.astype(jnp.int32),
-                                          mode="drop")
-    lengths = cache.lengths.at[rows].set(lens.astype(cache.lengths.dtype),
-                                         mode="drop")
-    return cache._replace(page_table=table, lengths=lengths)
+    return _scatter_kv(cache, chunk_k, chunk_v,
+                       lambda arr, upd: arr.at[:, phys, :ps_eff].set(
+                           tiles(upd), mode="drop"),
+                       lambda arr, upd: arr.at[:, phys, :, :ps_eff].set(
+                           tiles(upd).transpose(0, 1, 3, 2), mode="drop"))
+
+
+def write_prefill_chunk(cache: PagedKVCache, chunk_k: jax.Array,
+                        chunk_v: jax.Array, tables: jax.Array,
+                        start: int) -> PagedKVCache:
+    """Splice ONE continuation-prefill chunk into the pool — the
+    incremental unit of chunked admission (serve/scheduler.py): each
+    chunk of a long prompt lands in the pool as it is computed, so the
+    final chunk's dispatch splices C tokens, not the whole prompt.
+
+    chunk_k/v: [L, R, C, Hkv, D] covering token positions
+    ``start .. start+C`` of each row; tables: [R, max_pages_per_row]
+    physical page ids (zero-padded past each row's allocation; all-zero
+    for padding entries). Deliberately installs NEITHER tables NOR
+    lengths — the scheduler routes every chunk through the ``tables``
+    operand and installs the row state atomically with the FINAL chunk,
+    so a half-prefilled row never looks live to the decode loop (its
+    live page_table row stays zeroed and parked-row garbage writes keep
+    landing in page 0 while the chunks accumulate).
+
+    A page-aligned ``start`` (the plain chunk ladder — chunk budgets are
+    power-of-two and >= the default page size) takes
+    :func:`write_prefill_batch`'s page-tile scatter shifted by
+    ``start // page_size``; an unaligned start (a prefix-offset chunk —
+    the broadcast prefix shifts every boundary by the registered prefix
+    length — or a sub-page chunk budget) falls back to a per-token
+    scatter. Positions past a row's allocation hit zero table entries
+    (or the width clamp) and land in garbage page 0 — the containment
+    write_prefill_batch documents."""
+    L, R, C, Hkv, D = chunk_k.shape
+    ps = cache.page_size
+    if start % ps == 0:
+        P, ps_eff = _page_tiling(C, ps)
+        lp = start // ps + jnp.arange(P)               # logical pages
+        idx = jnp.minimum(lp, tables.shape[1] - 1)
+        phys = jnp.where((lp < tables.shape[1])[None, :],
+                         tables.astype(jnp.int32)[:, idx], 0)
+        phys = phys.reshape(R * P)
+        return _tile_scatter(cache, chunk_k, chunk_v, phys, P, ps_eff)
+    # Mid-page start: per-token indices (write_prefill's shape) with the
+    # chunk's position offset; slower than page tiles but only the
+    # prefix-offset chunks pay it.
+    pos = start + jnp.arange(C)                        # [C]
+    logical = pos // ps
+    safe = jnp.minimum(logical, tables.shape[1] - 1)
+    phys = jnp.take_along_axis(tables.astype(jnp.int32),
+                               jnp.broadcast_to(safe[None, :], (R, C)),
+                               axis=1)                 # [R,C]
+    phys = jnp.where((logical < tables.shape[1])[None, :], phys, 0)
+    slot = jnp.broadcast_to((pos % ps)[None, :], (R, C))
+    return _scatter_kv(cache, chunk_k, chunk_v,
+                       lambda arr, upd: arr.at[:, phys, slot].set(
+                           upd, mode="drop"),
+                       # head-major scale target; advanced dims 1, 3 ->
+                       # front: update [R, C, L, Hkv]
+                       lambda arr, upd: arr.at[:, phys, :, slot].set(
+                           upd.transpose(1, 2, 0, 3), mode="drop"))
 
 
 def write_prefill_row(cache: PagedKVCache, row_k: jax.Array,

@@ -28,6 +28,9 @@ Env surface (reference-style env-first config, utils/env.py):
 ``SERVE_SPEC`` (K>0 = speculative decoding with prompt-lookup drafts),
 ``SERVE_FUSE`` (fused multi-step decode: up to K decode steps per device
 dispatch, adaptive; default 4, 1 disables),
+``SERVE_PREFILL_CHUNK`` (chunked prefill: admissions above this token
+budget land in fixed chunks interleaved with decode ticks; default 256,
+0 disables),
 ``SERVE_PREFIX`` (shared-prefix KV caching, serve/prefix.py; default on),
 ``SERVE_PREFIX_TEXTS`` (extra templates to pre-register, ``||``-separated;
 the reference co-pilot template is always registered),
@@ -79,7 +82,8 @@ class TPUEngine:
                  prefix_cache: bool = True,
                  prefix_texts: tuple[str, ...] = (SUGGEST_PREFIX,),
                  kv_quant: bool = False,
-                 decode_fuse_max: int = 4) -> None:
+                 decode_fuse_max: int = 4,
+                 prefill_chunk: int = 256) -> None:
         self.name = name or config.name
         self.config = config
         self.prefix_texts = tuple(prefix_texts) if prefix_cache else ()
@@ -95,7 +99,8 @@ class TPUEngine:
                                         spec_k=spec_k,
                                         prefix_cache=prefix_cache,
                                         kv_quant=kv_quant,
-                                        decode_fuse_max=decode_fuse_max)
+                                        decode_fuse_max=decode_fuse_max,
+                                        prefill_chunk=prefill_chunk)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
@@ -235,6 +240,11 @@ def build_engine_from_env() -> Backend:
     # Fused multi-step decode: up to this many decode steps per device
     # dispatch (adaptive — see scheduler.decode_fuse_max). 1 disables.
     decode_fuse_max = max(1, env_int("SERVE_FUSE", 4))
+    # Chunked prefill: admissions whose bucket exceeds this token budget
+    # land in fixed chunks interleaved with decode ticks (Sarathi-style
+    # stall-free admission — see scheduler.prefill_chunk). 0 disables
+    # (legacy whole-bucket admission).
+    prefill_chunk = max(0, env_int("SERVE_PREFILL_CHUNK", 256))
     prefix_cache = env_bool("SERVE_PREFIX", True)
     prefix_texts = (SUGGEST_PREFIX,) + tuple(
         t for t in env_or("SERVE_PREFIX_TEXTS", "").split("||") if t)
@@ -290,7 +300,8 @@ def build_engine_from_env() -> Backend:
                          prefix_cache=prefix_cache,
                          prefix_texts=prefix_texts, name=name,
                          kv_quant=bool(kv_quant),
-                         decode_fuse_max=decode_fuse_max)
+                         decode_fuse_max=decode_fuse_max,
+                         prefill_chunk=prefill_chunk)
 
     def warmup_buckets():
         warmup = env_or("SERVE_WARMUP", "128,256")

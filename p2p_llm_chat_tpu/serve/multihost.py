@@ -37,7 +37,15 @@ per-row detokenisation in the broadcast path for no throughput value.
 Deliberate deltas vs the single-host engine (COMPONENTS.md): no paged
 pool / speculation / prefix cache — those are per-step scheduler
 decisions that would have to be broadcast per tick; the single-host
-engine keeps the full feature stack. What this module now proves is the
+engine keeps the full feature stack. Chunked prefill
+(``SERVE_PREFILL_CHUNK``, docs/serving.md Round-7) also does not apply
+here: the lockstep plane admits strictly *between* rounds, so a
+round's prefill never runs with live decodes to stall — the admission
+interference chunking bounds is a continuous-batching phenomenon. The
+round-granularity latency coupling that DOES exist on this plane is
+the head-of-line behaviour covered by the Round-6 multihost note in
+docs/serving.md (unbounded requests run in solo rounds). What this
+module now proves is the
 claim that matters for DCN: R distinct requests per model pass, i.e.
 throughput scales with the dp axis (``serve_multihost_batched_rounds``
 vs ``serve_multihost_requests`` in /metrics; test_multihost_serve

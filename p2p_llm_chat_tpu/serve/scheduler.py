@@ -117,6 +117,7 @@ class _Slot:
     drafter: Optional[object] = None                   # spec-decode NGramDrafter
     prefix: Optional[PrefixEntry] = None               # cached-prefix admission
     prefix_checked: bool = False                       # match() ran for this slot
+    last_emit_t: float = 0.0                           # inter-token gap tracking
 
     def push(self, delta: str) -> None:
         if delta:
@@ -140,6 +141,32 @@ class _Slot:
         reference's "(LLM error)" string)."""
         self.error = msg
         self.finish()
+
+
+@dataclass
+class _PrefillCarry:
+    """Host state of a half-prefilled admission chunk (chunked prefill:
+    the prompt lands in fixed token-budget chunks, decode ticks run in
+    between — see BatchScheduler.prefill_chunk). Touched only by the
+    scheduler thread. ``kv``/``logits`` are the device carry: the small
+    continuation cache accumulating the chunks' KV and the [R, vocab]
+    merged last-prompt-position logits the final chunk samples from."""
+
+    chunk: list[_Slot]
+    rows: list[int]
+    S: int                         # suffix bucket (the chunk ladder's span)
+    off: int                       # suffix tokens already prefilled
+    C: int                         # chunk width, snapshotted at admission —
+    # a runtime toggle of scheduler.prefill_chunk (bench phases) must not
+    # reshape or never-finish an in-flight carry
+    prefix: Optional[PrefixEntry]  # shared broadcast prefix (or None)
+    kv: Optional[object]           # device carry cache [L,R,P0+S,Hkv,D]
+    logits: Optional[object]       # device carry [R,V] f32
+    tokens: "np.ndarray"           # [R,S] right-padded suffix tokens
+    ints: "np.ndarray"             # [5,R] lens/rows/seeds/top_k/total-lens
+    floats: "np.ndarray"           # [3,R] temp/top_p/repeat_penalty
+    rings: "np.ndarray"            # [R,_RING] prompt-tail penalty windows
+    tables: Optional["np.ndarray"]  # [R,mppr] page maps (paged mode)
 
 
 class _WarmupJob:
@@ -179,7 +206,8 @@ class BatchScheduler:
                  prefix_cache: bool = False,
                  prefix_promote_after: int = 2,
                  kv_quant: bool = False,
-                 decode_fuse_max: int = 4) -> None:
+                 decode_fuse_max: int = 4,
+                 prefill_chunk: int = 256) -> None:
         """``admit_chunk``: burst-admission width. None (default) admits a
         backlog burst through one full-width prefill (minimal dispatches —
         best p95/throughput); a fixed power-of-two (e.g. 8) staggers the
@@ -214,11 +242,29 @@ class BatchScheduler:
         up to this many decode steps as an on-device ``lax.scan``
         (models/llama.decode_fused), amortising the per-tick host
         dispatch + readback (a third of the B=32 decode tick wall,
-        BENCH_r05) by K. K adapts per tick: 1 whenever admissions are
-        pending, speculation could run, or any row is within K tokens of
-        its budget; otherwise it doubles up to this cap. 1 disables.
+        BENCH_r05) by K. K adapts per tick: 1 whenever speculation
+        could run, any row is within K tokens of its budget, or — with
+        chunked prefill disabled or not covering every bucket (max_seq
+        not a chunk multiple) — admissions are pending;
+        otherwise it doubles up to this cap. 1 disables. Decision table
+        in _choose_fuse_k, pinned by tests/test_fused_decode.py.
         Output is bit-identical to plain ticks (same programs per step,
         same key/ring streams; EOS parks rows inside the scan).
+
+        ``prefill_chunk``: chunked prefill (Sarathi-style stall-free
+        admission). A prompt whose bucket exceeds this token budget
+        (power-of-two snapped) prefills in fixed chunks the loop
+        interleaves with decode ticks — one chunk dispatch per loop
+        iteration — so no single admission dispatch stalls in-flight
+        decodes longer than one chunk's compute, and fused decode keeps
+        ramping while a backlog drains (pre-chunking, ANY pending
+        admission collapsed K to 1 and a 512-token admission froze
+        every stream for its whole prefill). Chunked output is
+        BIT-identical to the single-shot admission (the continuation
+        forward runs at the full bucket width — models/llama.
+        prefill_chunk — and the final chunk samples from the same
+        logits; pinned by tests/test_chunked_prefill.py). 0 disables
+        (whole-bucket admission, the legacy fused-K collapse rule).
 
         ``prefix_cache``: shared-prefix KV caching (serve/prefix.py).
         Prompts that begin with a cached prefix (the co-pilot template,
@@ -351,6 +397,30 @@ class BatchScheduler:
         from ..utils.metrics import Histogram
         self._wall_hist = Histogram("decode_wall_ms")
         self._decode_device_ms = 0.0  # measured once at warmup (probe)
+        # Chunked prefill (tentpole of the admission-stall work): prompts
+        # whose bucket exceeds this budget admit in fixed chunks the loop
+        # interleaves with decode ticks. Power-of-two snapped so the
+        # chunk ladder divides every power-of-two bucket; the TOP bucket
+        # is capped at max_seq, which need not be a multiple — that
+        # bucket falls back to single-shot admission (the S % C gates at
+        # _admit_steps and the chunked-admission branch), because a
+        # ladder whose offsets step past S would never hit its final
+        # chunk.
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0, got {prefill_chunk}")
+        self.prefill_chunk = (_bucket(prefill_chunk, self.max_seq)
+                              if prefill_chunk else 0)
+        self._prefill_carry: Optional[_PrefillCarry] = None  # owned-by: _loop
+        self._n_prefill_chunks = 0    # owned-by: _loop — chunk dispatches
+        self._admit_since_tick = False  # owned-by: _loop — admission work since last decode dispatch
+        self._last_decode_t: Optional[float] = None  # owned-by: _loop
+        self._decode_stall_ms = 0.0   # owned-by: _loop — max decode gap attributable to admission
+        # reset_decode_stall handshake: req set by the caller, serviced
+        # (and ack'd) by _loop at the top of every iteration.
+        self._stall_reset_req = threading.Event()
+        self._stall_reset_ack = threading.Event()
+        self._tbt_hist = Histogram("inter_token_ms")
         # Adaptive speculation: EMA of accepted drafts per spec tick.
         # The verify forward computes K+1 positions for every row, so
         # when drafts stop landing (non-repetitive output), paying it
@@ -677,6 +747,165 @@ class BatchScheduler:
                 _admit_batch_prefix,
                 donate_argnums=(7, 8, 9, 10, 11, 12, 13, 14))
 
+        def _make_prefill_chunk_program(P0: int, S: int, OFF: int, C: int):
+            """ONE continuation-prefill chunk program of the chunked
+            admission ladder (static key: prefix length P0, suffix
+            bucket S, chunk offset OFF; width C = prefill_chunk, which
+            divides S — a non-multiple bucket, i.e. the max_seq-capped
+            top one, admits single-shot instead). Three shapes of one
+            family:
+
+            - first (OFF == 0) creates the device carry (small cache
+              [L,R,P0+S] + [R,V] logits) and broadcasts the shared
+              prefix into it;
+            - every chunk runs the continuation forward
+              (models/llama.prefill_chunk — full-width mask, the
+              bit-identity rule), folds the rows whose LAST prompt
+              position falls in this chunk into the carried logits, and
+              splices the chunk's KV into the big cache incrementally
+              (rows' live lengths/tables stay uninstalled, so
+              half-prefilled rows never look live and parked-row
+              garbage writes cannot touch the accumulating KV);
+            - final (OFF + C == S) samples each row's first token from
+              the carried logits (the exact _prefill_first_token tail)
+              and installs lengths/tables/sampling state atomically.
+
+            Dense rows additionally park their decode-write position at
+            max_seq on the first chunk: a stale length from the row's
+            previous tenant could sit inside the region later chunks
+            write, and every decode tick scatters a parked row's
+            garbage k/v at that slot — out-of-range writes drop
+            instead. (Paged rows need nothing: their live page_table
+            row is zeroed from release, so garbage writes keep landing
+            in page 0 until the final install.)"""
+            if S % C or not 0 <= OFF < S:
+                raise ValueError(
+                    f"chunk ladder must divide the bucket: S={S} C={C} "
+                    f"OFF={OFF} (a non-multiple bucket admits single-shot)")
+            first, final = OFF == 0, OFF + C == S
+            W = P0 + S
+            base = P0 + OFF
+            paged = self.kv_mode == "paged"
+
+            def _fwd(params, tokens, ints, carry, logits_c):
+                suf_lens = ints[0]
+                local_last = suf_lens - 1 - OFF
+                logits, carry = model.prefill_chunk(
+                    params, config, tokens, carry, base, mesh,
+                    last_idx=jnp.clip(local_last, 0, C - 1))
+                keep = (local_last >= 0) & (local_last < C)
+                logits_c = jnp.where(keep[:, None], logits[:, 0, :],
+                                     logits_c)
+                return carry, logits_c
+
+            def _splice(cache, carry, ints, tables):
+                rows = ints[1]
+                lo = 0 if first else base   # first chunk carries the prefix
+                if paged:
+                    from ..ops.paged_kv import write_prefill_chunk
+                    cache = write_prefill_chunk(
+                        cache, carry.k[:, :, lo: base + C],
+                        carry.v[:, :, lo: base + C], tables, lo)
+                    if final:
+                        table = cache.page_table.at[rows].set(
+                            tables.astype(jnp.int32), mode="drop")
+                        lengths = cache.lengths.at[rows].set(
+                            ints[4].astype(cache.lengths.dtype),
+                            mode="drop")
+                        cache = cache._replace(page_table=table,
+                                               lengths=lengths)
+                    return cache
+                k = cache.k.at[:, rows, lo: base + C].set(
+                    carry.k[:, :, lo: base + C], mode="drop")
+                v = cache.v.at[:, rows, lo: base + C].set(
+                    carry.v[:, :, lo: base + C], mode="drop")
+                if final:
+                    lengths = cache.lengths.at[rows].set(
+                        ints[4].astype(cache.lengths.dtype), mode="drop")
+                elif first:
+                    lengths = cache.lengths.at[rows].set(
+                        jnp.int32(self.max_seq), mode="drop")
+                else:
+                    lengths = cache.lengths
+                return KVCache(k, v, lengths)
+
+            if first:
+                def _chunk_first(params, *args):
+                    if P0:
+                        pk, pv, tokens, ints = args[:4]
+                        rest = args[4:]
+                    else:
+                        pk = pv = None
+                        tokens, ints = args[:2]
+                        rest = args[2:]
+                    tables = rest[0] if paged else None
+                    cache = rest[-1]
+                    R = tokens.shape[0]
+                    carry = KVCache.create(config, R, W, dtype=self._dtype)
+                    if P0:
+                        k0 = jnp.broadcast_to(
+                            pk[:, None], (pk.shape[0], R) + pk.shape[1:])
+                        v0 = jnp.broadcast_to(
+                            pv[:, None], (pv.shape[0], R) + pv.shape[1:])
+                        carry = carry._replace(
+                            k=carry.k.at[:, :, :P0].set(k0),
+                            v=carry.v.at[:, :, :P0].set(v0))
+                    logits0 = jnp.zeros((R, config.vocab_size), jnp.float32)
+                    carry, logits_c = _fwd(params, tokens, ints, carry,
+                                           logits0)
+                    cache = _splice(cache, carry, ints, tables)
+                    return carry, logits_c, cache
+                # donate the big cache (always the last argument)
+                n_args = 1 + (2 if P0 else 0) + 2 + (1 if paged else 0) + 1
+                return jax.jit(_chunk_first, donate_argnums=(n_args - 1,))
+
+            if not final:
+                def _chunk_mid(params, tokens, ints, carry, logits_c, *rest):
+                    tables = rest[0] if paged else None
+                    cache = rest[-1]
+                    carry, logits_c = _fwd(params, tokens, ints, carry,
+                                           logits_c)
+                    cache = _splice(cache, carry, ints, tables)
+                    return carry, logits_c, cache
+                last = 5 + (1 if paged else 0)
+                return jax.jit(_chunk_mid, donate_argnums=(3, 4, last))
+
+            def _chunk_final(params, tokens, ints, floats, rings, carry,
+                             logits_c, *rest):
+                tables = rest[0] if paged else None
+                (cache, keys, next_tokens, temps, top_ks, top_ps, ring,
+                 rps) = rest[-8:]
+                carry, logits_c = _fwd(params, tokens, ints, carry,
+                                       logits_c)
+                R = tokens.shape[0]
+                seeds, total_lens = ints[2], ints[4]
+                row_keys = jax.vmap(jax.random.PRNGKey)(seeds)
+                toks, row_keys = sample_batched(logits_c, row_keys,
+                                                floats[0], ints[3],
+                                                floats[1], ring=rings,
+                                                rp=floats[2])
+                rings = rings.at[jnp.arange(R),
+                                 total_lens % _RING].set(toks)
+                cache = _splice(cache, carry, ints, tables)
+                (keys, next_tokens, temps, top_ks, top_ps, ring,
+                 rps) = _install_rows(ints[1], row_keys, toks, ints,
+                                      floats, rings, keys, next_tokens,
+                                      temps, top_ks, top_ps, ring, rps)
+                return (toks, cache, keys, next_tokens, temps, top_ks,
+                        top_ps, ring, rps)
+            # The carry kv/logits die here but have no same-shaped output
+            # to alias into — donating them only trips XLA's unusable-
+            # donation warning, so they are freed by refcount instead.
+            off0 = 7 + (1 if paged else 0)
+            return jax.jit(_chunk_final,
+                           donate_argnums=tuple(range(off0, off0 + 8)))
+
+        self._make_prefill_chunk_program = _make_prefill_chunk_program
+        self._prefill_chunk_programs: dict[tuple[int, int, int], object] = {}
+        # (P0, S, off, C, R) shapes that have actually executed (jit
+        # wrappers above compile per batch width R on first call).
+        self._chunk_shapes_run: set[tuple] = set()  # owned-by: _loop
+
         def _build_prefix(params, toks):
             """Prefill one prefix ([1,P]) and strip the batch axis —
             the register_prefix / promotion builder."""
@@ -791,6 +1020,21 @@ class BatchScheduler:
             self._decode_fused_programs[(window, K)] = p
         return p
 
+    def _prefill_chunk_for(self, P0: int, S: int, off: int, C: int):
+        """Jitted continuation-prefill chunk program (compiled once per
+        (prefix length, suffix bucket, offset, chunk width) — warmup
+        walks the whole ladder so none compiles mid-serving). ``C`` is
+        the caller's chunk width, NOT self.prefill_chunk: an in-flight
+        carry snapshots its width at admission, so a runtime toggle of
+        prefill_chunk (bench.py phases do this) can never mismatch a
+        half-prefilled admission against a differently-shaped program."""
+        key = (P0, S, off, C)
+        p = self._prefill_chunk_programs.get(key)
+        if p is None:
+            p = self._make_prefill_chunk_program(P0, S, off, C)
+            self._prefill_chunk_programs[key] = p
+        return p
+
     @property
     def _fuse_ladder(self) -> tuple[int, ...]:
         """Compiled fused-K sizes: powers of two up to decode_fuse_max
@@ -807,26 +1051,39 @@ class BatchScheduler:
 
     def _choose_fuse_k(self, inflight: int) -> int:
         """Adaptive fused-K for this tick. Collapses to 1 whenever
-        fusing could hurt latency or overrun a budget:
+        fusing could overrun a budget THIS tick:
 
-        - admissions pending (queued requests, carried chunks, or
-          page-starved waiters): a K-step tick would push their TTFT
-          back K-1 steps;
         - any active row within K tokens of its ``max_new`` or KV
           budget — the device must never write a slot past a row's
           allocation, and ``inflight`` unprocessed pipelined steps count
           against the headroom (device length runs ahead of the host's
           ctx_len mirror by up to that many slots);
+        - admissions pending while chunking is DISABLED or cannot cover
+          every bucket (``max_seq % prefill_chunk != 0``: the
+          max_seq-capped top bucket admits single-shot whole-bucket, so
+          a pending admission may put an unbounded prefill after this
+          tick, and a K-step tick would also push its TTFT back K-1
+          steps — conservative: power-of-two buckets in that config
+          lose the ramp-under-backlog win, but the bounded-stall
+          guarantee comes first). With chunking covering all buckets
+          (default), pending admissions do NOT collapse K: every
+          admission dispatch is already bounded to one chunk's compute,
+          so fusion keeps amortising host dispatch while the backlog
+          drains — the pre-chunking rule degraded decode to K=1 for the
+          entire drain (the BENCH_r05 10,724-raw vs 307-served gap);
 
         otherwise K doubles along the compiled ladder up to
         ``decode_fuse_max``, so a stream that just admitted ramps
         1 -> 2 -> 4 instead of jumping straight to a long fused tick.
+        The decision table is pinned by tests/test_fused_decode.py.
         """
         kmax = self.decode_fuse_max
         if kmax <= 1:
             return 1
-        if (self._admit_carry or self._waiting
-                or not self._admit_q.empty()):
+        C = self.prefill_chunk   # one read: bench toggles it at runtime
+        if ((not C or self.max_seq % C)
+                and (self._admit_carry or self._waiting
+                     or not self._admit_q.empty())):
             self._fuse_ramp = 1
             return 1
         cap = kmax
@@ -846,6 +1103,19 @@ class BatchScheduler:
                 k = cand
         self._fuse_ramp = max(k, 1)
         return max(k, 1)
+
+    def _chunk_ladder_ready(self, P0: int, S: int, R: int) -> bool:
+        """True when every continuation-chunk program of the (P0, S)
+        ladder has already EXECUTED at batch width R — the precondition
+        for chunked admission while live streams exist (an unwarmed
+        ladder would compile serially on the loop thread, stalling
+        every decode). Checked against the executed-shape set, not the
+        jit-wrapper cache: a wrapper registered by an earlier admission
+        at a different R would still pay ceil(S/C) fresh XLA compiles
+        at this R."""
+        C = self.prefill_chunk
+        return all((P0, S, off, C, R) in self._chunk_shapes_run
+                   for off in range(0, S, C))
 
     def _chunk_cap(self, S: int) -> int:
         """Widest admission chunk (power of two) whose R x S footprint
@@ -917,10 +1187,46 @@ class BatchScheduler:
             # window would walk past the KV allocation.
             windows = tuple(sorted({min(w, self.max_seq) for w in windows}))
 
+        def _admit_steps(S: int, R: int, P0: int = 0,
+                         synthetic: bool = False) -> list:
+            """Warmup jobs for one (prefix, suffix-bucket, chunk-width)
+            admission shape: the single-shot program when the bucket
+            fits one prefill chunk (or is not a chunk multiple — the
+            max_seq-capped top bucket, which admits single-shot), else
+            the WHOLE continuation-chunk ladder (one job per offset —
+            the chunked path never runs the single-shot program for
+            that bucket, and a lazy chunk compile mid-admission would
+            stall every live stream).
+            Prefix entries are looked up at RUN time, after the
+            registration jobs queued ahead have populated the store."""
+            C = self.prefill_chunk
+            if C and S > C and S % C == 0:
+                return [
+                    (lambda S=S, R=R, off=off, P0=P0:
+                     self._warm_prefill_chunk(S, R, off, prefix_len=P0,
+                                              synthetic=synthetic))
+                    for off in range(0, S, C)]
+            if P0 or synthetic:
+                return [lambda P0=P0, S=S, R=R:
+                        self._warm_prefix_combo(P0, S, R,
+                                                synthetic=synthetic)]
+            return [lambda S=S, R=R: self._admit_chunk([], [], S, R)]
+
         steps = []
+        n_chunk_jobs = 0
+
+        def _extend_admit(jobs: list) -> None:
+            """Queue one admission shape's warmup jobs, counting the
+            continuation-ladder ones (>1 job = a chunk ladder) for the
+            `warmup compiled:` line the verify script greps."""
+            nonlocal n_chunk_jobs
+            if len(jobs) > 1:
+                n_chunk_jobs += len(jobs)
+            steps.extend(jobs)
+
         for S in buckets:
             for R in self._chunks_for(S, chunk_sizes):
-                steps.append(lambda S=S, R=R: self._admit_chunk([], [], S, R))
+                _extend_admit(_admit_steps(S, R))
         # Shared-prefix programs: register the known templates (builds
         # their KV — one prefill compile per distinct P), then compile the
         # prefix-admission program for every (chunk, suffix bucket, P)
@@ -941,8 +1247,7 @@ class BatchScheduler:
                     if P + S > self.max_seq:
                         continue
                     for R in self._chunks_for(P + S, chunk_sizes):
-                        steps.append(lambda P=P, S=S, R=R:
-                                     self._warm_prefix_combo(P, S, R))
+                        _extend_admit(_admit_steps(S, R, P0=P))
             # Grain pre-warm: auto-promoted prefixes always land on the
             # grain ladder, so compiling each grain's splice program for
             # the SMALLEST suffix bucket now (synthetic zero entries —
@@ -954,8 +1259,8 @@ class BatchScheduler:
                 if P in plens or P + smallest > self.max_seq:
                     continue
                 for R in self._chunks_for(P + smallest, chunk_sizes):
-                    steps.append(lambda P=P, R=R: self._warm_prefix_combo(
-                        P, smallest, R, synthetic=True))
+                    _extend_admit(_admit_steps(smallest, R, P0=P,
+                                               synthetic=True))
         for w in windows:
             steps.append(lambda w=w: self._warm_window(w))
         if self.kv_mode == "paged":
@@ -968,7 +1273,9 @@ class BatchScheduler:
         def _record():
             self._warmed_buckets = buckets
             log.info("warmup compiled: admit %s x buckets %s, decode "
-                     "windows %s", chunk_sizes, buckets, windows)
+                     "windows %s, prefill chunk %d (%d continuation "
+                     "programs)", chunk_sizes, buckets, windows,
+                     self.prefill_chunk, n_chunk_jobs)
         steps.append(_record)
         # Drain the dispatch queue at the end: warmup executions (and the
         # axon tunnel's deferred per-program loads) are async — without a
@@ -1075,6 +1382,54 @@ class BatchScheduler:
                           self._dtype)
             entry = PrefixEntry(ids=tuple(range(P)), k=z, v=z)
         self._admit_chunk([], [], S, R, warm_prefix=entry)
+
+    # graftcheck: runs-on _loop
+    def _warm_prefill_chunk(self, S: int, R: int, off: int,
+                            prefix_len: int = 0,
+                            synthetic: bool = False) -> None:
+        """Compile+run ONE continuation-prefill chunk program as a
+        padding no-op on the live cache (one queued warmup job per
+        program, exactly like the admit/window jobs, so mid-traffic
+        warmups interleave with live ticks). Offsets past the first run
+        against a throwaway zero carry — the compile cache keys on
+        shapes only. ``prefix_len`` > 0 warms the prefix-offset ladder:
+        the entry is looked up at run time (registration jobs queued
+        ahead have populated the store); ``synthetic`` fabricates a
+        zeros entry of the right shapes (grain pre-warm)."""
+        entry = None
+        if prefix_len:
+            entry = next((e for e in self._prefix.snapshot()
+                          if e.length == prefix_len), None)
+            if entry is None:
+                if not synthetic:
+                    return
+                z = jnp.zeros((self.config.num_layers, prefix_len,
+                               self.config.num_kv_heads,
+                               self.config.head_dim), self._dtype)
+                entry = PrefixEntry(ids=tuple(range(prefix_len)), k=z, v=z)
+        if prefix_len + S > self.max_seq:
+            return
+        C = self.prefill_chunk
+        tokens = np.zeros((R, C), np.int32)
+        ints = np.zeros((5, R), np.int32)
+        ints[0] = 1
+        ints[1] = self.num_slots
+        ints[4] = prefix_len + 1
+        floats = np.zeros((3, R), np.float32)
+        floats[1] = 1.0
+        floats[2] = 1.0
+        rings = np.full((R, _RING), self.config.vocab_size, np.int32)
+        tables = (np.zeros((R, self._cache.max_pages_per_row), np.int32)
+                  if self.kv_mode == "paged" else None)
+        if off == 0:
+            kv = logits = None
+        else:
+            kv = KVCache.create(self.config, R, prefix_len + S,
+                                dtype=self._dtype)
+            logits = jnp.zeros((R, self.config.vocab_size), jnp.float32)
+        self._dispatch_prefill_chunk(prefix_len, S, off, C, tokens, ints,
+                                     floats, rings, tables, kv, logits,
+                                     entry)
 
     # graftcheck: runs-on _loop
     def _warm_window(self, w: int) -> None:
@@ -1276,6 +1631,10 @@ class BatchScheduler:
         for s in self._admit_carry:
             s.finish()
         self._admit_carry = []
+        pc, self._prefill_carry = self._prefill_carry, None
+        if pc is not None:
+            for s in pc.chunk:
+                s.finish()
         while True:
             try:
                 s = self._admit_q.get_nowait()
@@ -1306,21 +1665,35 @@ class BatchScheduler:
         pending: Optional[tuple] = None   # (toks_dev, snapshot, K)
         while not self._closed.is_set():
             try:
+                self._drain_stall_reset()
                 # Admission inside the same recovery envelope as decode: an
                 # unexpected admission-path error must fail requests and
                 # reset, never kill the scheduler thread (which would leave
                 # every future submit() hanging on a dead queue).
                 self._admit_pending(block=not self._any_active()
-                                    and pending is None)
+                                    and pending is None
+                                    and self._prefill_carry is None)
                 if self._closed.is_set():
                     return
                 if self._prefix is not None:
                     self._drain_promotions()
+                if self._prefill_carry is not None:
+                    # Chunked admission in progress: ONE continuation
+                    # chunk per loop iteration — the decode tick below
+                    # runs between chunks, so live streams stall at
+                    # most one chunk's compute per iteration (the
+                    # bounded-stall contract).
+                    self._prefill_step()
                 if not self._any_active():
+                    # No live decodes: the stall gauge must not bridge
+                    # this gap — a cold admission after idle time would
+                    # otherwise book the whole idle stretch as
+                    # decode_stall_ms (it stalled nobody).
+                    self._last_decode_t = None
                     if pending is not None:
                         self._process_tick(*pending)
                         pending = None
-                    elif self._promote_q:
+                    elif self._promote_q and self._prefill_carry is None:
                         # Idle: build one deferred prefix promotion
                         # (compile + prefill happen with no live streams
                         # to stall).
@@ -1476,6 +1849,36 @@ class BatchScheduler:
         self._n_expired += 1
         return True
 
+    def reset_decode_stall(self, timeout_s: float = 30.0) -> None:
+        """Zero the decode_stall_ms max gauge (and its timestamp), so a
+        phased workload (bench.py's mixed-load chunked vs single-shot
+        halves) can attribute the max decode-tick gap to its OWN phase
+        instead of reading a lifetime max. The gauge is _loop-owned, so
+        the reset executes ON the scheduler thread — via an event the
+        loop services at the top of EVERY iteration, not a queued
+        admission job: the admit queue only drains when admission can
+        run, so a job would starve (and this call would time out) behind
+        a full batch of long generations or an in-flight prefill carry.
+        Returns once the loop has performed the reset."""
+        if self._closed.is_set():
+            raise RuntimeError("scheduler is stopped")
+        self._stall_reset_ack.clear()
+        self._stall_reset_req.set()
+        if not self._stall_reset_ack.wait(timeout=timeout_s):
+            raise TimeoutError("reset_decode_stall: scheduler loop did "
+                               "not service the reset")
+
+    # graftcheck: runs-on _loop
+    def _drain_stall_reset(self) -> None:
+        """Service a pending reset_decode_stall handshake (scheduler
+        thread, every loop iteration — even when admission cannot
+        run)."""
+        if self._stall_reset_req.is_set():
+            self._stall_reset_req.clear()
+            self._decode_stall_ms = 0.0
+            self._last_decode_t = None
+            self._stall_reset_ack.set()
+
     # graftcheck: lock-ok advisory gauges — torn reads of loop-owned ints are harmless for /metrics
     def metrics_snapshot(self) -> dict[str, float]:
         """Serving-plane gauges/counters for the /metrics endpoint (read
@@ -1508,6 +1911,17 @@ class BatchScheduler:
             "decode_wall_ms": round(self._wall_hist.percentile(50) or 0.0,
                                     4),
             "decode_device_ms": self._decode_device_ms,
+            # Chunked prefill (SERVE_PREFILL_CHUNK): continuation-chunk
+            # dispatches, the max decode-tick gap attributable to
+            # admission (bounded by one chunk's compute when chunking is
+            # on — the stall the tentpole bounds), and client-perceived
+            # inter-token latency percentiles.
+            "prefill_chunks_total": self._n_prefill_chunks,
+            "decode_stall_ms": round(self._decode_stall_ms, 3),
+            "inter_token_p50_ms": round(
+                self._tbt_hist.percentile(50) or 0.0, 4),
+            "inter_token_p95_ms": round(
+                self._tbt_hist.percentile(95) or 0.0, 4),
         }
         if self.spec_k:
             out["serve_spec_accepted_total"] = self._n_spec_accepted
@@ -1556,6 +1970,12 @@ class BatchScheduler:
         (the rest carries to the next loop iteration), so a multi-chunk
         burst cannot stall every live stream behind back-to-back
         prefills — chunked-prefill interleaving."""
+        if self._prefill_carry is not None:
+            # A half-prefilled chunk owns its rows (they are not in
+            # _slots until the final chunk installs them) and admission
+            # is strictly ordered — everything else queues behind it in
+            # _admit_carry until the carry drains.
+            return
         free = self._free_rows()
         if not free:
             return
@@ -1655,7 +2075,34 @@ class BatchScheduler:
                 chunk = group[:R]
                 group = group[R:]
                 rows = [free.pop(0) for _ in range(len(chunk))]
+                # One read of the runtime-togglable budget: condition and
+                # carry snapshot must see the SAME value (a mid-expression
+                # flip could divide by zero or build a mis-shaped carry).
+                C = self.prefill_chunk
                 try:
+                    if (C and S > C and S % C == 0
+                            and (not had_active
+                                 or self._chunk_ladder_ready(len(pkey), S,
+                                                             R))):
+                        # Chunked admission: install the carry (the loop
+                        # dispatches one chunk per iteration, decode
+                        # ticks in between) and stash every remaining
+                        # request behind it — admission is strictly
+                        # ordered, so nothing leapfrogs a half-prefilled
+                        # chunk. An UNWARMED ladder with live streams
+                        # falls through to single-shot instead (output-
+                        # identical by contract): lazily compiling
+                        # ceil(S/C) chunk programs back-to-back on this
+                        # thread would stall every live decode for the
+                        # whole ladder — strictly worse than the one
+                        # whole-bucket compile it replaced, i.e. the
+                        # exact stall class chunking exists to remove.
+                        # With no live streams the ladder compiles (and
+                        # is cached) with nobody to stall.
+                        self._start_prefill_carry(chunk, rows, S, R, C)
+                        self._admit_carry = group + [
+                            x for _, g in groups[gi + 1:] for x in g]
+                        return
                     self._admit_chunk(chunk, rows, S, R)
                     if had_active and (group or gi + 1 < len(groups)):
                         # Live streams existed before this round and more
@@ -1667,6 +2114,7 @@ class BatchScheduler:
                 except Exception:   # noqa: BLE001
                     log.exception("admission failed for %d request(s)",
                                   len(chunk))
+                    self._prefill_carry = None
                     for s in chunk:
                         s.fail("internal error: admission failed")
                     if self.kv_mode == "paged":
@@ -1710,43 +2158,14 @@ class BatchScheduler:
         prefix = chunk[0].prefix if chunk else warm_prefix
         P = prefix.length if prefix is not None else 0
         pad = R - len(chunk)
-        tokens = np.zeros((R, S), np.int32)
-        # lens/rows/seeds/top_k (+ total lens for prefix chunks)
-        ints = np.zeros((5 if prefix is not None else 4, R), np.int32)
-        floats = np.zeros((3, R), np.float32)       # temp/top_p/repeat_pen
-        rings = np.full((R, _RING), self.config.vocab_size, np.int32)
-        ints[0] = 1                                 # padding: 1-token prompt
-        ints[1] = self.num_slots                    # padding: dropped rows
-        if prefix is not None:
-            ints[4] = P + 1
-        floats[1] = 1.0
-        floats[2] = 1.0
-        for i, (slot, row) in enumerate(zip(chunk, rows)):
-            r = pad + i
-            suffix = slot.prompt_ids[P:]
-            tokens[r, : len(suffix)] = suffix
-            o = slot.req.options
-            ints[:4, r] = (len(suffix), row, slot.seed, o.top_k)
-            if prefix is not None:
-                ints[4, r] = len(slot.prompt_ids)
-            floats[:, r] = (o.temperature, o.top_p, o.repeat_penalty)
-            # Penalty window: prompt tokens at their context position mod
-            # _RING (later positions overwrite earlier — last-64 window).
-            # Prefix-cached rows still seed from the FULL prompt: the ring
-            # is host-built state, independent of which KV was recomputed.
-            if o.repeat_penalty != 1.0:
-                start = max(0, len(slot.prompt_ids) - _RING)
-                for p_i in range(start, len(slot.prompt_ids)):
-                    rings[r, p_i % _RING] = slot.prompt_ids[p_i]
+        tokens, ints, floats, rings, tables = self._admit_host_arrays(
+            chunk, rows, S, R, prefix)
+        self._admit_since_tick = True
 
         if prefix is not None:
             self._n_prefix_admits += len(chunk)
             self._n_prefix_tokens += P * len(chunk)
             if self.kv_mode == "paged":
-                tables = np.zeros((R, self._cache.max_pages_per_row),
-                                  np.int32)
-                for i, slot in enumerate(chunk):
-                    tables[pad + i, : len(slot.pages)] = slot.pages
                 (toks_dev, self._cache, self._keys, self._next_dev,
                  self._temps_dev, self._top_ks_dev, self._top_ps_dev,
                  self._ring_dev, self._rps_dev) = \
@@ -1772,14 +2191,12 @@ class BatchScheduler:
             # Padding entries keep an all-zero table: their prefill writes
             # land in garbage page 0 (their table/length installs are
             # dropped via the row sentinel).
-            tables = np.zeros((R, self._cache.max_pages_per_row), np.int32)
-            for i, slot in enumerate(chunk):
-                tables[pad + i, : len(slot.pages)] = slot.pages
             (toks_dev, self._cache, self._keys, self._next_dev,
              self._temps_dev, self._top_ks_dev, self._top_ps_dev,
              self._ring_dev, self._rps_dev) = \
                 self._admit_j(
-                    self._params, jnp.asarray(tokens), jnp.asarray(ints),
+                    self._params, jnp.asarray(tokens),
+                    jnp.asarray(ints[:4]),
                     jnp.asarray(floats), jnp.asarray(rings),
                     jnp.asarray(tables), self._cache,
                     self._keys, self._next_dev, self._temps_dev,
@@ -1790,11 +2207,62 @@ class BatchScheduler:
              self._temps_dev, self._top_ks_dev, self._top_ps_dev,
              self._ring_dev, self._rps_dev) = \
                 self._admit_j(
-                    self._params, jnp.asarray(tokens), jnp.asarray(ints),
+                    self._params, jnp.asarray(tokens),
+                    jnp.asarray(ints[:4]),
                     jnp.asarray(floats), jnp.asarray(rings), self._cache,
                     self._keys, self._next_dev, self._temps_dev,
                     self._top_ks_dev, self._top_ps_dev, self._ring_dev,
                     self._rps_dev)
+        self._install_admitted(chunk, rows, pad, toks_dev)
+
+    def _admit_host_arrays(self, chunk: list[_Slot], rows: list[int],
+                           S: int, R: int,
+                           prefix: Optional[PrefixEntry]) -> tuple:
+        """Host-side upload arrays for one admission chunk — shared by
+        the single-shot programs and the chunked-prefill carry, so the
+        two admission paths cannot drift. Returns (tokens [R,S], ints
+        [5,R] = lens/rows/seeds/top_k/total-lens, floats [3,R], rings
+        [R,_RING], tables [R,mppr] or None); the non-prefix single-shot
+        programs consume ``ints[:4]``."""
+        P = prefix.length if prefix is not None else 0
+        pad = R - len(chunk)
+        tokens = np.zeros((R, S), np.int32)
+        ints = np.zeros((5, R), np.int32)
+        floats = np.zeros((3, R), np.float32)       # temp/top_p/repeat_pen
+        rings = np.full((R, _RING), self.config.vocab_size, np.int32)
+        ints[0] = 1                                 # padding: 1-token prompt
+        ints[1] = self.num_slots                    # padding: dropped rows
+        ints[4] = P + 1
+        floats[1] = 1.0
+        floats[2] = 1.0
+        for i, (slot, row) in enumerate(zip(chunk, rows)):
+            r = pad + i
+            suffix = slot.prompt_ids[P:]
+            tokens[r, : len(suffix)] = suffix
+            o = slot.req.options
+            ints[:4, r] = (len(suffix), row, slot.seed, o.top_k)
+            ints[4, r] = len(slot.prompt_ids)
+            floats[:, r] = (o.temperature, o.top_p, o.repeat_penalty)
+            # Penalty window: prompt tokens at their context position mod
+            # _RING (later positions overwrite earlier — last-64 window).
+            # Prefix-cached rows still seed from the FULL prompt: the ring
+            # is host-built state, independent of which KV was recomputed.
+            if o.repeat_penalty != 1.0:
+                start = max(0, len(slot.prompt_ids) - _RING)
+                for p_i in range(start, len(slot.prompt_ids)):
+                    rings[r, p_i % _RING] = slot.prompt_ids[p_i]
+        tables = None
+        if self.kv_mode == "paged":
+            tables = np.zeros((R, self._cache.max_pages_per_row), np.int32)
+            for i, slot in enumerate(chunk):
+                tables[pad + i, : len(slot.pages)] = slot.pages
+        return tokens, ints, floats, rings, tables
+
+    def _install_admitted(self, chunk: list[_Slot], rows: list[int],
+                          pad: int, toks_dev) -> None:
+        """Admission epilogue shared by the single-shot program and the
+        final prefill chunk: read the first tokens back, install the
+        slots, stream/stop-check each first token."""
         # graftcheck: sync-ok intentional: R int32 first tokens, TTFT depends on it
         first_toks = np.asarray(toks_dev)
 
@@ -1804,6 +2272,9 @@ class BatchScheduler:
             if slot.stats is not None:
                 slot.stats.ttft_s = now - slot.req.arrival_time
             slot.ctx_len = len(slot.prompt_ids)
+            # last_emit_t stays 0 until _append_token below sets it: the
+            # first token's latency is TTFT, not an inter-token gap — a
+            # pre-set stamp would log a fake ~0 ms TBT sample per request.
             if self.spec_k:
                 from ..utils.draft import NGramDrafter
                 slot.drafter = NGramDrafter(slot.prompt_ids, self.spec_k)
@@ -1811,6 +2282,114 @@ class BatchScheduler:
             if not self._append_token(slot, row, int(first_toks[pad + i])):
                 # finished on the very first token (eos / limits)
                 self._release(row)
+
+    def _start_prefill_carry(self, chunk: list[_Slot], rows: list[int],
+                             S: int, R: int, C: int) -> None:
+        """Begin a chunked admission: build the host arrays once and
+        install the carry. Dispatch happens exclusively in _loop — one
+        chunk per iteration (_prefill_step), decode ticks in between —
+        so an admission can never put two chunk dispatches back-to-back
+        ahead of a decode tick (the bounded-stall contract). ``C`` is
+        the caller's already-validated read of prefill_chunk, NOT
+        re-read here — the runtime toggle must not land between the
+        divisibility check and this snapshot."""
+        prefix = chunk[0].prefix if chunk else None
+        tokens, ints, floats, rings, tables = self._admit_host_arrays(
+            chunk, rows, S, R, prefix)
+        self._prefill_carry = _PrefillCarry(
+            chunk=chunk, rows=rows, S=S, off=0, C=C,
+            prefix=prefix, kv=None,
+            logits=None, tokens=tokens, ints=ints, floats=floats,
+            rings=rings, tables=tables)
+
+    def _prefill_step(self) -> None:
+        """Dispatch ONE continuation-prefill chunk of the in-progress
+        admission. At most one chunk runs per loop iteration, so a long
+        prompt's admission stalls live decodes by one chunk's compute,
+        never the whole prompt's prefill; the final chunk samples the
+        first tokens and installs the rows (TTFT lands there)."""
+        pc = self._prefill_carry
+        C = pc.C    # the carry's own width — see _PrefillCarry.C
+        P0 = pc.prefix.length if pc.prefix is not None else 0
+        off = pc.off
+        self._n_prefill_chunks += 1
+        self._admit_since_tick = True
+        kv, logits, toks_dev = self._dispatch_prefill_chunk(
+            P0, pc.S, off, C, pc.tokens[:, off: off + C], pc.ints,
+            pc.floats, pc.rings, pc.tables, pc.kv, pc.logits, pc.prefix)
+        if toks_dev is None:
+            pc.kv, pc.logits, pc.off = kv, logits, off + C
+            return
+        self._prefill_carry = None
+        if pc.prefix is not None:
+            self._n_prefix_admits += len(pc.chunk)
+            self._n_prefix_tokens += P0 * len(pc.chunk)
+        self._install_admitted(pc.chunk, pc.rows,
+                               pc.tokens.shape[0] - len(pc.chunk), toks_dev)
+
+    def _dispatch_prefill_chunk(self, P0: int, S: int, off: int, C: int,
+                                tokens, ints, floats, rings, tables, kv,
+                                logits, prefix) -> tuple:
+        """Run one continuation-chunk program (live admission and warmup
+        share this dispatch, so argument order cannot drift from the
+        compiled signatures). ``C``: the chunk width — the carry's
+        snapshot for live admissions, self.prefill_chunk for warmup.
+        Returns (carry_kv, carry_logits, None) for a non-final chunk and
+        (None, None, first_tokens_dev) for the final one."""
+        first, final = off == 0, off + C == S
+        prog = self._prefill_chunk_for(P0, S, off, C)
+        t = jnp.asarray(np.ascontiguousarray(tokens))
+        ij = jnp.asarray(ints)
+        paged = self.kv_mode == "paged"
+        shape_key = (P0, S, off, C, tokens.shape[0])
+        if first:
+            args = [self._params]
+            if P0:
+                args += [prefix.k, prefix.v]
+            args += [t, ij]
+            if paged:
+                args.append(jnp.asarray(tables))
+            args.append(self._cache)
+            kv, logits, self._cache = prog(*args)
+            self._chunk_shapes_run.add(shape_key)
+            return kv, logits, None
+        if not final:
+            args = [self._params, t, ij, kv, logits]
+            if paged:
+                args.append(jnp.asarray(tables))
+            args.append(self._cache)
+            kv, logits, self._cache = prog(*args)
+            self._chunk_shapes_run.add(shape_key)
+            return kv, logits, None
+        args = [self._params, t, ij, jnp.asarray(floats),
+                jnp.asarray(rings), kv, logits]
+        if paged:
+            args.append(jnp.asarray(tables))
+        args += [self._cache, self._keys, self._next_dev, self._temps_dev,
+                 self._top_ks_dev, self._top_ps_dev, self._ring_dev,
+                 self._rps_dev]
+        (toks_dev, self._cache, self._keys, self._next_dev,
+         self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+         self._ring_dev, self._rps_dev) = prog(*args)
+        self._chunk_shapes_run.add(shape_key)
+        return None, None, toks_dev
+
+    # graftcheck: runs-on _loop
+    def _note_admission_gap(self, now: float) -> None:
+        """Advance the decode_stall_ms tracker at a token-emitting
+        dispatch (decode tick or spec tick): the dispatch-to-dispatch
+        interval across an iteration that did admission work
+        (single-shot prefill or a continuation chunk) is the stall
+        clients saw. With chunking on this is bounded by one chunk's
+        compute — the number the tentpole exists to shrink
+        (pre-chunking, a 512-token admission put its WHOLE prefill in
+        this gap)."""
+        if self._last_decode_t is not None and self._admit_since_tick:
+            gap = (now - self._last_decode_t) * 1e3
+            if gap > self._decode_stall_ms:
+                self._decode_stall_ms = gap
+        self._last_decode_t = now
+        self._admit_since_tick = False
 
     def _dispatch_tick(self, allow_fuse: bool = True,
                        inflight: int = 0) -> tuple:
@@ -1830,6 +2409,7 @@ class BatchScheduler:
             self._n_fused_ticks += 1
             self._n_fused_steps += K
         now = time.monotonic()
+        self._note_admission_gap(now)
         if (self._last_dispatch is not None
                 and now - self._last_dispatch[0] < 0.25):
             # Steady-state per-STEP wall: the interval between dispatches
@@ -1945,6 +2525,10 @@ class BatchScheduler:
         self._n_decode_ticks += 1
         self._n_spec_ticks += 1
         self._last_dispatch = None    # spec wall is not decode-step wall
+        # A spec tick emits tokens like a decode tick: book any pending
+        # admission gap against it (the chunk's compute delayed THIS
+        # tick's emissions too), then restart the interval.
+        self._note_admission_gap(time.monotonic())
         active = tuple(s is not None for s in self._slots)
         if active != self._active_host:
             self._active_host = active
@@ -1982,6 +2566,14 @@ class BatchScheduler:
     def _append_token(self, slot: _Slot, row: int, tok: int) -> bool:
         """Record one sampled token; stream its text. Returns False when the
         request is finished (eos, stop string, length/context limits)."""
+        now = time.monotonic()
+        if slot.last_emit_t:
+            # Client-perceived inter-token gap (TBT): tokens inside one
+            # fused/spec burst land together (~0 ms), the burst boundary
+            # carries the dispatch interval plus any admission stall —
+            # exactly what the p95 must expose.
+            self._tbt_hist.observe((now - slot.last_emit_t) * 1e3)
+        slot.last_emit_t = now
         if tok in self._stop_ids:
             self._flush_text(slot, final=True)
             slot.finish()
@@ -2085,6 +2677,13 @@ class BatchScheduler:
             s.pages = None
             s.fail("internal error: serving state was reset")
         self._admit_carry = []
+        pc, self._prefill_carry = self._prefill_carry, None
+        if pc is not None:
+            # Half-prefilled rows were never installed in _slots; their
+            # pages also belong to the allocator being rebuilt.
+            for s in pc.chunk:
+                s.pages = None
+                s.fail("internal error: serving state was reset")
         self._reset_device_state()
 
     def _release(self, row: int) -> None:

@@ -12,8 +12,11 @@ Two layers of pinning:
   the scan: length frozen, ring writes dropped, feed held);
 - engine-level parity: the same requests through schedulers with
   fusion off vs on produce identical streams, and the adaptive-K
-  policy collapses to 1 whenever admissions are pending or a row is
-  within K tokens of a budget.
+  decision table holds: a row within K tokens of a budget always
+  collapses K to 1; pending admissions collapse K only with chunked
+  prefill disabled (with chunking on — the default — every admission
+  dispatch is bounded to one chunk, so fusion keeps ramping while a
+  backlog drains; see test_fuse_k_policy_decision_table).
 
 CPU-runnable by design (ci.sh runs this file under JAX_PLATFORMS=cpu);
 interpret-mode Pallas covers the paged kernels.
@@ -208,27 +211,89 @@ def _mk_slot(max_new=100, n_ids=0, ctx_len=10, ctx_budget=60) -> _Slot:
     return s
 
 
-def test_adaptive_k_collapses_while_admissions_pending():
-    sched = BatchScheduler(PARAMS, CFG, TOK, num_slots=2, max_seq=MAX_SEQ,
-                           decode_fuse_max=4)
-    try:
-        sched._slots[0] = _mk_slot()
-        # Admissions pending (queued request): K must collapse to 1.
-        sched._admit_q.put(object())
-        assert sched._choose_fuse_k(0) == 1
-        sched._admit_q.get_nowait()
-        # Clear: K ramps 2 -> 4 and holds at the cap.
-        assert sched._choose_fuse_k(0) == 2
-        assert sched._choose_fuse_k(0) == 4
-        assert sched._choose_fuse_k(0) == 4
-        # Carried admission chunks and page-starved waiters also collapse
-        # (and reset the ramp).
-        sched._admit_carry = [_mk_slot()]
-        assert sched._choose_fuse_k(0) == 1
-        sched._admit_carry = []
-        assert sched._choose_fuse_k(0) == 2
-    finally:
-        sched.stop()
+def _policy_probe(prefill_chunk, max_seq=MAX_SEQ):
+    """A scheduler whose loop thread is already joined: _choose_fuse_k
+    is probed as a pure policy function, so planting fake pending work
+    (a bare sentinel in _admit_q, a bodiless carry slot) can't race the
+    live loop's admission path, which would try to admit it."""
+    sched = BatchScheduler(PARAMS, CFG, TOK, num_slots=2, max_seq=max_seq,
+                           decode_fuse_max=4, prefill_chunk=prefill_chunk)
+    sched.stop()
+    return sched
+
+
+def test_fuse_k_policy_decision_table():
+    """Pin the fused-K decision table (scheduler._choose_fuse_k):
+
+    | prefill_chunk          | pending admission          | near-budget row | K     |
+    |------------------------|----------------------------|-----------------|-------|
+    | on, divides max_seq    | queued / carried / waiting | no              | ramps |
+    | on                     | any                        | yes             | 1     |
+    | on, max_seq % C != 0   | queued / carried / waiting | no              | 1     |
+    | off (0)                | queued / carried / waiting | no              | 1     |
+    | off                    | none                       | no              | ramps |
+
+    With chunking on, a backlog must NOT collapse K: every admission
+    dispatch is already bounded to one chunk's compute, so fusion keeps
+    amortising host dispatch while the backlog drains (the pre-chunking
+    rule held decode at K=1 for an entire drain). Only near-budget rows
+    (test_adaptive_k_respects_row_budgets) and live speculation — K=1
+    at the dispatch site via _dispatch_tick(allow_fuse=False) — still
+    defuse. With chunking off, the legacy whole-bucket prefill follows
+    the tick, so any pending admission collapses K and resets the ramp.
+    """
+    chunked = _policy_probe(prefill_chunk=64)
+    chunked._slots[0] = _mk_slot()
+    for plant, clear in (
+            (lambda: chunked._admit_q.put(object()),
+             lambda: chunked._admit_q.get_nowait()),
+            (lambda: chunked._admit_carry.append(_mk_slot()),
+             lambda: chunked._admit_carry.clear()),
+            (lambda: chunked._waiting.append(_mk_slot()),
+             lambda: chunked._waiting.clear())):
+        plant()
+        chunked._fuse_ramp = 1
+        # Pending admission alone: K keeps ramping 2 -> 4, holds at cap.
+        assert chunked._choose_fuse_k(0) == 2
+        assert chunked._choose_fuse_k(0) == 4
+        assert chunked._choose_fuse_k(0) == 4
+        # ...but a near-budget row still collapses K to 1.
+        chunked._slots[1] = _mk_slot(ctx_len=59, ctx_budget=60)
+        assert chunked._choose_fuse_k(0) == 1
+        chunked._slots[1] = None
+        clear()
+
+    # Chunking on but max_seq NOT a chunk multiple: the capped top
+    # bucket admits single-shot whole-bucket, so a pending admission may
+    # hide an unbounded prefill — the legacy collapse rule applies
+    # (conservative across all buckets in that config).
+    capped = _policy_probe(prefill_chunk=64, max_seq=200)
+    capped._slots[0] = _mk_slot()
+    capped._fuse_ramp = 4
+    capped._admit_q.put(object())
+    assert capped._choose_fuse_k(0) == 1
+    capped._admit_q.get_nowait()
+    assert capped._choose_fuse_k(0) == 2
+
+    single = _policy_probe(prefill_chunk=0)
+    single._slots[0] = _mk_slot()
+    # Chunking off: a queued request collapses K and resets the ramp.
+    single._fuse_ramp = 4
+    single._admit_q.put(object())
+    assert single._choose_fuse_k(0) == 1
+    single._admit_q.get_nowait()
+    assert single._choose_fuse_k(0) == 2
+    # Carried admission chunks and page-starved waiters also collapse.
+    single._admit_carry = [_mk_slot()]
+    assert single._choose_fuse_k(0) == 1
+    single._admit_carry = []
+    single._waiting = [_mk_slot()]
+    assert single._choose_fuse_k(0) == 1
+    single._waiting = []
+    # Clear: K ramps 2 -> 4 and holds at the cap.
+    assert single._choose_fuse_k(0) == 2
+    assert single._choose_fuse_k(0) == 4
+    assert single._choose_fuse_k(0) == 4
 
 
 def test_adaptive_k_respects_row_budgets():
