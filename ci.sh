@@ -61,8 +61,17 @@ if [ "${1:-}" = "full" ]; then
   XLA_FLAGS=--xla_force_host_platform_device_count=1 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_chunked_prefill.py -q -x || rc=1
 
+  # Multi-chunk flash-append kernel: the WHOLE file including the
+  # slow-marked long-window matrix (W in {2048, 4096} x int8/fp pools
+  # x both page sizes) at the real chunk budget, interpret mode.
+  # Excluded from the sweep below so each case executes exactly once.
+  echo "== flash-append kernel: edge geometry + long-window matrix (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_flash_append_geometry.py \
+    -q || rc=1
+
   echo "== full test suite"
-  python -m pytest tests/ -q || rc=1
+  python -m pytest tests/ -q \
+    --ignore=tests/test_flash_append_geometry.py || rc=1
 else
   # Fused-decode parity pinned explicitly on CPU: the K-fused-steps ≡
   # K-plain-ticks bit-identity contract (serve/scheduler.py
@@ -82,10 +91,19 @@ else
   XLA_FLAGS=--xla_force_host_platform_device_count=1 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_chunked_prefill.py -q -x || rc=1
 
+  # Multi-chunk flash-append kernel parity in interpret mode, pinned
+  # on CPU regardless of the host's accelerator (the edge-geometry
+  # cases; the slow long-window matrix runs in full mode). Excluded
+  # from the sweep below so each case executes exactly once.
+  echo "== flash-append kernel edge-geometry parity (interpret, CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_flash_append_geometry.py \
+    -q -x -m 'not slow' || rc=1
+
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
     --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_chunked_prefill.py \
+    --ignore=tests/test_flash_append_geometry.py \
     --ignore=tests/test_stress.py \
     --ignore=tests/test_serve_tp.py \
     --ignore=tests/test_mixtral_parity.py \

@@ -801,6 +801,13 @@ def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     ``lengths + S`` on the non-gather impls, which keep the per-layer
     write-then-attend ordering and read the drafts back from the pool
     (the scheduler sizes for ``kv_window + S``, covering both).
+
+    Unlike the decode tick, verify stays on the gather path at EVERY
+    window: the flash-append kernel is single-position (its online-
+    softmax state is seeded with one current token), and the verify
+    forward runs only when the scheduler's acceptance EMA says drafts
+    are landing — a multi-position flash verify is recorded headroom,
+    not a gap (docs/serving.md round-8).
     """
     from ..ops import paged_attention
     from ..ops.paged_attention import (_DEFAULT_IMPL,
@@ -892,6 +899,14 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
     carry a fixed cost that was measurable against the decode bandwidth
     bound. Non-gather attention impls keep the write-then-attend
     ordering (their kernels read the pool for every position).
+
+    Impl selection is delegated per layer call: paged_attention_append
+    itself promotes to the multi-chunk flash-append kernel at windows
+    >= PAGED_APPEND_FLASH_MIN_W (2048) on TPU — the round-8 long-window
+    default — and the decision is made ONCE per trace (the scan body
+    traces once), so the serving scheduler's per-window jitted programs
+    each bake in exactly one impl and warmup compiles the whole
+    gather/kernel ladder up front (serve/scheduler.warmup).
     """
     from ..ops import paged_attention
     from ..ops.paged_kv import PagedKVCache, write_decode, write_decode_burst

@@ -383,7 +383,11 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
                       active: Optional[jax.Array] = None,
                       *, pages: int, interpret: Optional[bool] = None):
     """llama.decode_step_paged with the MoE MLP (same contract; decode's
-    token count is tiny, so the expert bucket stays exact)."""
+    token count is tiny, so the expert bucket stays exact). Attention
+    impl selection — including the round-8 multi-chunk flash-append
+    default at W >= 2048 on TPU — rides along unchanged: the dispatch
+    lives in ops/paged_attention.paged_attention_append, below the
+    mlp_fn seam, so MoE long-window decode takes the same kernel."""
     return llama.decode_step_paged(params, config, tokens, cache, mesh,
                                    rules, active, pages=pages,
                                    interpret=interpret,

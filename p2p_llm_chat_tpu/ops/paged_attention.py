@@ -51,23 +51,61 @@ window (~0.5 ms/step at W=192), strictly smaller than any per-program
 overhead a Pallas grid can reach at these shapes. The calculus flips
 at long windows, where the materialise waste grows linearly with W
 (~33 ms of the 40 ms step at W=4096) and per-program overhead does
-not — the long-context kernel is real headroom (BASELINE.md round-5);
-the short-window step is at its floor (roofline in BASELINE.md).
+not — which is why the flash-APPEND kernel below owns that regime.
+
+Round-8 closure of the long-window regime (the round-5 verdict's
+top-ranked item): the round-5 flash-append kernel was pinned to the
+single-chunk band by a VMEM stack OOM — double-buffered WHOLE-CHUNK
+scratch plus whole-chunk bf16 dequant copies (20.7 MB measured at
+2048-token chunks) — so W > 2048 fell back to the gather path and its
+linear materialise waste (40.2 ms at W=4096 int8 B=32, 5.5x the ~7 ms
+byte bound). Two restructurings were prototyped, both holding TILES in
+VMEM instead of whole windows:
+
+- **(B, chunk) grid with cross-chunk online-softmax merge in VMEM
+  scratch accumulators** (split-K / flash-decoding shape, Dao et al.;
+  the paged pool walk is vLLM PagedAttention's): each program folds one
+  bounded chunk (1024 int8 / 512 bf16 tokens, 8.2 MB VMEM ceiling
+  including the double-buffered DMA slots and the chunk-local dequant
+  view) into (m, l, acc) scratch that persists across the chunk axis of
+  the grid; the next chunk's page DMAs issue before the current chunk's
+  compute, crossing row boundaries, so launch overhead amortises across
+  the grid instead of a kernel-internal chunk loop. **KEPT — the
+  winner**: W=4096 int8 B=32 measures 11.6-12.4 ms per step
+  (3.2-3.5x the gather path, 1.7x the byte bound) and W=8192 measures
+  21.8 ms, both page sizes within the session spread.
+- per-tile int8 dequant inside the softmax loop of the old (B,) grid
+  (the chunk stays int8 in VMEM; each [128, HD] tile converts in
+  registers as it feeds the MXU, so the whole-chunk bf16 copy never
+  exists). **DROPPED — the loser, recorded here**: the VMEM ceiling
+  clears (9.1 MB at 2048-token chunks) but the kernel-internal chunk
+  loop serialises DMA waits against the tile loop — W=4096 int8 B=32
+  measured 24.9 ms (2.1x the grid form) and the tile-granular
+  dequant added ~8% VPU time at W=2048 where the two shapes otherwise
+  tie.
+
+The grid kernel is now the DEFAULT dispatch for decode append at
+W >= ``PAGED_APPEND_FLASH_MIN_W`` (2048) on TPU; the gather path stays
+default below it and everywhere on CPU (non-interpret ``pallas_call``
+needs the hardware). See ``_flash_append_policy`` for the exact rule
+and docs/serving.md ("long-window kernel") for the dispatch table and
+measured ladder.
 """
 
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.env import env_int, env_or
+
 NEG_INF = -1e30
 
-_DEFAULT_IMPL = os.environ.get("PAGED_ATTN_IMPL", "gather")
+_DEFAULT_IMPL = env_or("PAGED_ATTN_IMPL", "gather")
 
 
 def _kernel(pt_ref, len_ref, layer_ref, q_ref, k_ref, v_ref, o_ref,
@@ -351,16 +389,18 @@ def _paged_append_kernel_call(q, k_cur, v_cur, k_pages, v_pages, k_scale,
     return out
 
 
-# Decode append-attention implementation default. "gather" (XLA) wins at
-# serving shapes and stays the default; the Pallas kernel
-# (PAGED_APPEND_IMPL=kernel) is kept for the record and for shape
-# regimes where it may win (very long windows). Measured on v5e,
-# bench-1b B=32 W=192, per step: XLA gather+attend ~1.0 ms; manual-DMA
-# kernel ~6.2 ms in DMA-descriptor issue alone (384 page copies); the
-# gather-fed block kernel ~1.8 ms (the GQA-via-selection-matmul form
-# spends 8x the MXU passes; per-head dots relayout instead). At rep=2
-# decode GQA, XLA's fused VPU math is simply the better tool.
-_APPEND_IMPL = os.environ.get("PAGED_APPEND_IMPL", "gather")
+# Decode append-attention implementation default at SHORT windows.
+# "gather" (XLA) wins at serving shapes and stays the default there; the
+# Pallas block kernel (PAGED_APPEND_IMPL=kernel) is kept for the record.
+# Measured on v5e, bench-1b B=32 W=192, per step: XLA gather+attend
+# ~1.0 ms; manual-DMA kernel ~6.2 ms in DMA-descriptor issue alone (384
+# page copies); the gather-fed block kernel ~1.8 ms (the GQA-via-
+# selection-matmul form spends 8x the MXU passes; per-head dots relayout
+# instead). At rep=2 decode GQA, XLA's fused VPU math is simply the
+# better tool — until the window is long enough that the gather's
+# materialise copy dominates, where the multi-chunk flash-append kernel
+# takes over by default (see _flash_append_policy).
+_APPEND_IMPL = env_or("PAGED_APPEND_IMPL", "gather")
 
 
 def _append_kernel_wanted() -> bool:
@@ -393,17 +433,19 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
     the pool per row (NOT including the current token). Returns
     [B, Hq, D] in q.dtype.
 
-    The XLA gather+merge below is the DEFAULT everywhere (it measured
-    fastest at short serving windows — see the module docstring's
-    round-4 history). Opt-ins, TPU only: ``PAGED_APPEND_IMPL=kernel``
-    selects the round-4 gathered-window Pallas kernel (_append_kernel);
-    ``PAGED_APPEND_IMPL=flash`` or ``PAGED_APPEND_FLASH_MIN_W=<tokens>``
-    selects the round-5 flash-append kernel
-    (_paged_attention_flash_append) — outright or above a window
-    threshold — which skips the gathered-window materialisation
-    (measured +13-18% at W=2048; see _FLASH_APPEND_MIN_W for its
-    regime and caveats). All paths compute the same f32 softmax over
-    the same score set.
+    The XLA gather+merge below is the DEFAULT at short windows and
+    everywhere on CPU (it measured fastest at short serving windows —
+    see the module docstring's round-4 history). At windows >=
+    ``PAGED_APPEND_FLASH_MIN_W`` (default 2048) on TPU the multi-chunk
+    flash-append kernel (_paged_attention_flash_append) is the default
+    instead: one HBM pass over the pages, no gathered-window
+    materialisation — the round-8 long-window win. Overrides:
+    ``PAGED_APPEND_IMPL=kernel`` pins the round-4 gathered-window block
+    kernel (_append_kernel); ``PAGED_APPEND_IMPL=flash`` pins the flash
+    kernel at every window; ``PAGED_APPEND_FLASH_MIN_W=0`` disables the
+    flash default (gather everywhere). See _flash_append_policy for the
+    exact rule. All paths compute the same f32 softmax over the same
+    score set.
     """
     B, Hq, D = q.shape
     Hkv = k_cur.shape[1]
@@ -414,18 +456,12 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
             cache.v_scale, cache.page_table, lengths, layer, pages=pages,
             quantized=cache.k_scale is not None, interpret=interpret)
     W = pages * cache.k.shape[2]
-    single_chunk = W <= max(cache.k.shape[2],
-                            _FLASH_CHUNK_TOK_BYTES
-                            // cache.k.dtype.itemsize)
-    if not interpret and single_chunk and _flash_append_wanted(W):
-        # Round-5 opt-in: one HBM pass over the pages instead of the
-        # gather's materialise-then-attend. Engaged ONLY in the
-        # single-chunk regime — the measured win regime; multi-chunk
-        # pipelines are either chunk-loop-bound or exceed the VMEM
-        # stack (see _FLASH_APPEND_MIN_W) — so deeper windows fall
-        # back to gather instead of regressing or failing to compile.
-        # Explicit interpret=True callers (CPU tests) drive the kernel
-        # directly.
+    if not interpret and _flash_append_wanted(W):
+        # Long-window default (round-8): the (B, chunk)-grid flash
+        # kernel reads each page exactly once per (layer, step) and
+        # holds only bounded tiles in VMEM, so there is no multi-chunk
+        # regime restriction any more. Explicit interpret=True callers
+        # (CPU tests) drive the kernel directly.
         return _paged_attention_flash_append(
             q, k_cur, v_cur, cache.k, cache.v, cache.k_scale,
             cache.v_scale, cache.page_table, lengths, layer, pages=pages,
@@ -650,76 +686,134 @@ def paged_attention_verify_append(q_blk, k_blk, v_blk, cache, lengths,
 # that is 1 MB per buffer side — 4 MB total with double buffering.
 _FLASH_CHUNK_PAGES = 8
 
-# Engage the flash APPEND kernel at windows >= this many tokens (TPU
-# only; <=0 = off, the DEFAULT; the dispatch additionally restricts it
-# to the single-chunk regime, so with the 2048-byte chunk budget the
-# effective window band is [MIN_W, 2048] for int8 pools). Round-5
-# status, measured at B=32 bench-1b int8, W=2048, vs the gather path's
-# 16.5 ms step: the kernel runs 13.5-14.4 ms (+13-18%, session/
-# page-size spread) with whole-window (single-chunk) DMAs, and loses
-# or cannot compile in every multi-chunk shape tried — 1024-token
-# chunks are chunk-loop-bound (21.5 ms), ps=64 pipelines are
-# DMA-descriptor-bound (the _append_kernel lesson), and 2048-token
-# double-buffered chunks exceed the 16 MB VMEM stack (20.7 MB
-# measured). Opt-in via PAGED_APPEND_FLASH_MIN_W=2048; the gather path
-# stays default and the deep-window materialise waste stays the
-# recorded headroom (BASELINE.md round-5).
-_FLASH_APPEND_MIN_W = int(os.environ.get("PAGED_APPEND_FLASH_MIN_W",
-                                         "0"))
+# Per-dtype chunk sizing for the flash-append DMA pipeline: bytes of
+# one (k or v) buffer side per token — the chunk token budget is
+# _FLASH_CHUNK_TOK_BYTES // pool_itemsize, i.e. 1024 int8 tokens /
+# 512 bf16 tokens / 256 f32 tokens per grid step. VMEM ceiling at
+# bench shapes (Hkv=8, D=128, HD=1024): double-buffered int8 k+v DMA
+# slots 4 MB + the chunk-local bf16 dequant view 4 MB + f32 softmax
+# state ~0.2 MB = 8.2 MB, comfortably under the 16 MB stack that the
+# round-5 whole-chunk design overflowed (20.7 MB). Module-level so
+# tests can shrink it to exercise many-chunk grids in interpret mode.
+_FLASH_CHUNK_TOK_BYTES = 1024
 
-# Per-dtype chunk sizing for the flash-append DMA pipeline (bytes of
-# one buffer side per token unit; see chunk_pages below).
-_FLASH_CHUNK_TOK_BYTES = 2048
+
+def _flash_append_min_w() -> int:
+    """Engage the flash append kernel at windows >= this many tokens
+    (TPU only; <=0 disables it and the gather path runs everywhere).
+    Read per dispatch decision — NOT frozen at import — so tests and
+    bench phases can flip ``PAGED_APPEND_FLASH_MIN_W`` at runtime (the
+    pattern serve/scheduler.py established for ``prefill_chunk``); each
+    jitted caller traces the decision once per static shape."""
+    return env_int("PAGED_APPEND_FLASH_MIN_W", 2048)
+
+
+def _flash_append_policy(window: int, append_impl: str,
+                         min_w: int) -> bool:
+    """The pure dispatch rule for the append path on TPU, split from
+    the platform guard so CPU tests can pin the decision table
+    hardware-free (tests/test_flash_append_geometry.py):
+
+    - ``PAGED_APPEND_IMPL=flash``  -> flash kernel at EVERY window;
+    - ``PAGED_APPEND_IMPL=kernel`` -> never (the round-4 block kernel
+      owns the dispatch upstream);
+    - otherwise flash iff ``min_w > 0 and window >= min_w`` — the
+      round-8 default boundary (min_w = 2048).
+    """
+    if append_impl == "flash":
+        return True
+    if append_impl == "kernel":
+        return False
+    return min_w > 0 and window >= min_w
 
 
 def _flash_append_wanted(window: int) -> bool:
     if jax.devices()[0].platform != "tpu":
         return False            # non-interpret pallas_call needs the TPU
+    return _flash_append_policy(window, _APPEND_IMPL,
+                                _flash_append_min_w())
+
+
+def effective_flash_min_w() -> int:
+    """The flash-append engagement boundary as ONE number, for gauges
+    and logs (serve/scheduler.py's ``paged_flash_min_w``): 0 = the
+    kernel cannot engage in this process (non-TPU platform, disabled,
+    or the block-kernel override), 1 = the flash override (every
+    window), else the min-W threshold. Kept next to
+    _flash_append_policy so the dispatch rule has exactly one home."""
+    if jax.devices()[0].platform != "tpu":
+        return 0
     if _APPEND_IMPL == "flash":
-        return True
+        return 1
     if _APPEND_IMPL == "kernel":
-        return False
-    return _FLASH_APPEND_MIN_W > 0 and window >= _FLASH_APPEND_MIN_W
+        return 0
+    return max(0, _flash_append_min_w())
 
 
 def _flash_append_kernel_body(quantized: bool, page_size: int, pages: int,
-                              chunk_pages: int, rep: int, scale: float):
-    """Build the flash-append kernel body (see _flash_kernel for the DMA
-    structure). Differences from the plain flash kernel:
+                              chunk_pages: int, num_chunks: int, rep: int,
+                              scale: float, compute_dtype):
+    """Build the multi-chunk flash-append kernel body: ONE program per
+    (row, chunk) of a ``(B, num_chunks)`` grid — the split-K /
+    flash-decoding shape (module docstring, round-8). The chunk axis is
+    the grid's minor dimension, so for a fixed row the chunk programs
+    run back to back and the online-softmax state (m, l, acc) lives in
+    VMEM **scratch accumulators** that persist across them — VMEM holds
+    one bounded chunk's tiles, never a whole window, which is what
+    cleared the round-5 VMEM stack OOM. Structure:
 
-    - **append semantics**: the online-softmax state INITIALISES with the
-      current token's term (m = s_cur, l = 1, acc = v_cur) — exactly the
-      extra softmax term paged_attention_append's gather path merges, so
-      pool writes still batch after the layer scan.
+    - **append semantics**: chunk 0 INITIALISES the scratch state with
+      the current token's term (m = s_cur, l = 1, acc = v_cur) — exactly
+      the extra softmax term paged_attention_append's gather path
+      merges, so pool writes still batch after the layer scan. The last
+      chunk normalises and writes the output block.
+    - **cross-program double buffering**: each program issues the NEXT
+      chunk's page DMAs (rolling over to the next row's chunk 0 at row
+      boundaries) before waiting on its own, into 2-slot DMA scratch
+      indexed by global step parity — the grid replaces the round-5
+      kernel-internal chunk loop, so launch overhead amortises across
+      programs and no program serialises a whole window's DMA waits.
+    - **partial last chunks / non-chunk-multiple windows**: the page
+      walk index clamps to ``pages - 1`` (a redundant re-fetch of the
+      last real page) instead of skipping the DMA — uninitialised VMEM
+      garbage can be NaN, and a NaN row poisons the p.v dot even at
+      zero probability; clamped rows carry positions >= the window and
+      mask to NEG_INF like any dead slot.
     - **int8 pools** (``quantized``): the per-page scale rows
       ([Hkv, ps_pad] f32, the head-major layout paged_kv.py stores for
-      kernel DMAs) ride the same double-buffered chunk pipeline; k
-      scales fold into the scores, v scales into the probabilities —
-      the same fold-outside-the-dots contract as the gather path, so
-      HBM sees int8 KV only.
+      kernel DMAs) ride the same DMA slots; k scales fold into the
+      scores, v scales into the probabilities — the same
+      fold-outside-the-dots contract as the gather path, so HBM sees
+      int8 KV only.
     - **selection-matmul GQA math** (from _append_kernel, the round-4
       VPU win): scores run as ONE [Ct, HD] x [HD, Hq] dot per chunk and
-      the softmax chain on full-width [Ct, Hq] arrays — per-kv-head
-      [rep=2, Ct] dots waste 6/8 sublanes on the VPU and measured ~2x
-      slower at long windows. The scale folds become one [Ct, Hkv] x
-      [Hkv, Hq] expander dot each instead of per-page segment concats.
+      the softmax chain on full-width [Ct, Hq] arrays; the scale folds
+      are one [Ct, Hkv] x [Hkv, Hq] expander dot each.
+    - ``compute_dtype``: bf16 on hardware (the MXU's preferred operand
+      dtype; int8 -> bf16 is the cheap unpack), f32 in interpret mode so
+      the CPU parity tests pin the kernel against the oracle at f32
+      precision instead of bf16 rounding.
     """
     def body(*refs):
         if quantized:
             (pt_ref, len_ref, layer_ref, q_ref, kc_ref, vc_ref, k_hbm,
              v_hbm, ks_hbm, vs_hbm, o_ref, kbuf, vbuf, ksbuf, vsbuf,
-             sems) = refs
+             m_ref, l_ref, acc_ref, sems) = refs
         else:
             (pt_ref, len_ref, layer_ref, q_ref, kc_ref, vc_ref, k_hbm,
-             v_hbm, o_ref, kbuf, vbuf, sems) = refs
+             v_hbm, o_ref, kbuf, vbuf, m_ref, l_ref, acc_ref, sems) = refs
             ksbuf = vsbuf = ks_hbm = vs_hbm = None
         b = pl.program_id(0)
+        c = pl.program_id(1)
         ly = layer_ref[0]
         length = len_ref[b]
-        num_chunks = -(-pages // chunk_pages)
 
-        def dma(slot: int, c: int, i: int):
-            page = pt_ref[b, c * chunk_pages + i]
+        def dma(slot, bb, cc, i: int):
+            # Clamped page-walk index: see the docstring's partial-chunk
+            # note. pt entries past a row's allocation are 0 (garbage
+            # page) by the pool contract, so every fetch is in bounds.
+            j = jnp.minimum(cc * chunk_pages + i, pages - 1)
+            page = pt_ref[bb, j]
             copies = [
                 pltpu.make_async_copy(k_hbm.at[ly, page], kbuf.at[slot, i],
                                       sems.at[0, slot, i]),
@@ -737,12 +831,35 @@ def _flash_append_kernel_body(quantized: bool, page_size: int, pages: int,
                 ]
             return copies
 
-        def start_chunk(slot: int, c: int) -> None:
-            for i in range(min(chunk_pages, pages - c * chunk_pages)):
-                for d in dma(slot, c, i):
+        def start_chunk(slot, bb, cc) -> None:
+            for i in range(chunk_pages):
+                for d in dma(slot, bb, cc, i):
                     d.start()
 
-        start_chunk(0, 0)
+        def wait_chunk(slot, bb, cc) -> None:
+            for i in range(chunk_pages):
+                for d in dma(slot, bb, cc, i):
+                    d.wait()
+
+        # Global step index orders the whole grid's chunk walk; its
+        # parity picks the DMA slot (num_chunks may be odd, so parity
+        # must run THROUGH row boundaries, not reset per row).
+        step = b * num_chunks + c
+        slot = jax.lax.rem(step, 2)
+
+        @pl.when(step == 0)
+        def _warmup():
+            start_chunk(0, b, c)
+
+        # Prefetch the next chunk — the next row's chunk 0 at a row
+        # boundary — before waiting on our own.
+        nb = jnp.where(c + 1 == num_chunks, b + 1, b)
+        nc = jnp.where(c + 1 == num_chunks, 0, c + 1)
+
+        @pl.when(step + 1 < pl.num_programs(0) * pl.num_programs(1))
+        def _prefetch():
+            start_chunk(jax.lax.rem(step + 1, 2), nb, nc)
+
         q = q_ref[0].astype(jnp.float32)                 # [Hq, D]
         Hq, D = q.shape
         Hkv = Hq // rep
@@ -752,75 +869,74 @@ def _flash_append_kernel_body(quantized: bool, page_size: int, pages: int,
         # (_gqa_selection_matrices): the round-4 VPU win's machinery.
         sel, blockm, blockm_t, expt = _gqa_selection_matrices(
             Hq, Hkv, D, rep)
+        sel_c = sel.astype(compute_dtype)
 
         # Q stacked into its kv block: [HD, Hq].
-        q_cols = jax.lax.dot(sel, q.T.astype(jnp.bfloat16),
+        q_cols = jax.lax.dot(sel_c, q.T.astype(compute_dtype),
                              preferred_element_type=jnp.float32)
-        q_blk = jnp.where(blockm, q_cols.astype(jnp.bfloat16),
-                          jnp.zeros((), jnp.bfloat16))           # [HD, Hq]
+        q_blk = jnp.where(blockm, q_cols.astype(compute_dtype),
+                          jnp.zeros((), compute_dtype))          # [HD, Hq]
 
-        # Append init: state = the current token's softmax term at full
-        # precision (p_cur = exp(s_cur - m) = 1 at m = s_cur). State
-        # layout matches the chunk math: m/l [1, Hq], acc [Hq, D].
-        kcur = jax.lax.dot(expt, kc_ref[0].astype(jnp.float32),
-                           preferred_element_type=jnp.float32)   # [Hq, D]
-        vcur = jax.lax.dot(expt, vc_ref[0].astype(jnp.float32),
-                           preferred_element_type=jnp.float32)
-        m = jnp.sum(q * kcur, axis=-1, keepdims=True).T * scale  # [1, Hq]
-        l = jnp.ones((1, Hq), jnp.float32)
-        acc = vcur                                               # [Hq, D]
+        @pl.when(c == 0)
+        def _seed():
+            # Append init: state = the current token's softmax term at
+            # FULL precision (p_cur = exp(s_cur - m) = 1 at m = s_cur).
+            # State layout matches the chunk math: m/l [1, Hq],
+            # acc [Hq, D].
+            kcur = jax.lax.dot(expt, kc_ref[0].astype(jnp.float32),
+                               preferred_element_type=jnp.float32)
+            vcur = jax.lax.dot(expt, vc_ref[0].astype(jnp.float32),
+                               preferred_element_type=jnp.float32)
+            m_ref[:] = jnp.sum(q * kcur, axis=-1,
+                               keepdims=True).T * scale          # [1, Hq]
+            l_ref[:] = jnp.ones((1, Hq), jnp.float32)
+            acc_ref[:] = vcur                                    # [Hq, D]
 
-        for c in range(num_chunks):
-            slot = c % 2
-            if c + 1 < num_chunks:
-                start_chunk((c + 1) % 2, c + 1)
-            n_pages = min(chunk_pages, pages - c * chunk_pages)
-            for i in range(n_pages):
-                for d in dma(slot, c, i):
-                    d.wait()
-            # bf16 dot inputs: int8 -> bf16 is the cheap unpack and the
-            # MXU's preferred operand dtype; accumulation stays f32.
-            Ct = n_pages * page_size
-            kflat = kbuf[slot][:n_pages].reshape(
-                Ct, HD).astype(jnp.bfloat16)
-            vflat = vbuf[slot][:n_pages].reshape(
-                Ct, HD).astype(jnp.bfloat16)
-            s = jax.lax.dot(kflat, q_blk,
-                            preferred_element_type=jnp.float32) * scale
-            if quantized:
-                # [Ct, Hkv] scale columns -> [Ct, Hq] via the expander
-                # dot (one MXU op; per-page segment concats measured
-                # overhead-bound on the VPU).
-                sk = jnp.concatenate(
-                    [ksbuf[slot][i, :, :page_size].T
-                     for i in range(n_pages)], axis=0)           # [Ct, Hkv]
-                s = s * jax.lax.dot(sk, expt.T,
-                                    preferred_element_type=jnp.float32)
-            pos = c * chunk_pages * page_size + jax.lax.broadcasted_iota(
-                jnp.int32, (Ct, 1), dimension=0)
-            s = jnp.where(pos < length, s, NEG_INF)              # [Ct, Hq]
+        wait_chunk(slot, b, c)
+        Ct = chunk_pages * page_size
+        kflat = kbuf[slot].reshape(Ct, HD).astype(compute_dtype)
+        vflat = vbuf[slot].reshape(Ct, HD).astype(compute_dtype)
+        s = jax.lax.dot(kflat, q_blk,
+                        preferred_element_type=jnp.float32) * scale
+        if quantized:
+            # [Ct, Hkv] scale columns -> [Ct, Hq] via the expander dot
+            # (one MXU op; per-page segment concats measured
+            # overhead-bound on the VPU).
+            sk = jnp.concatenate(
+                [ksbuf[slot][i, :, :page_size].T
+                 for i in range(chunk_pages)], axis=0)           # [Ct, Hkv]
+            s = s * jax.lax.dot(sk, expt.T,
+                                preferred_element_type=jnp.float32)
+        pos = c * chunk_pages * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (Ct, 1), dimension=0)
+        s = jnp.where(pos < length, s, NEG_INF)                  # [Ct, Hq]
 
-            m_cur = jnp.maximum(m, jnp.max(s, axis=0, keepdims=True))
-            alpha = jnp.exp(m - m_cur)                           # [1, Hq]
-            probs = jnp.exp(s - m_cur)                           # [Ct, Hq]
-            # Denominator sums the UNSCALED probabilities (v scales fold
-            # into the p.v dot only — the gather path's contract).
-            l = l * alpha + jnp.sum(probs, axis=0, keepdims=True)
-            if quantized:
-                sv = jnp.concatenate(
-                    [vsbuf[slot][i, :, :page_size].T
-                     for i in range(n_pages)], axis=0)           # [Ct, Hkv]
-                probs = probs * jax.lax.dot(
-                    sv, expt.T, preferred_element_type=jnp.float32)
-            out_full = jax.lax.dot(probs.T.astype(jnp.bfloat16), vflat,
-                                   preferred_element_type=jnp.float32)
-            out_full = jnp.where(blockm_t, out_full, 0.0)        # [Hq, HD]
-            acc = acc * alpha.T + jax.lax.dot(
-                out_full.astype(jnp.bfloat16), sel,
-                preferred_element_type=jnp.float32)              # [Hq, D]
-            m = m_cur
+        m_prev = m_ref[:]                                        # [1, Hq]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)                          # [1, Hq]
+        probs = jnp.exp(s - m_cur)                               # [Ct, Hq]
+        # Denominator sums the UNSCALED probabilities (v scales fold
+        # into the p.v dot only — the gather path's contract).
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(probs, axis=0,
+                                              keepdims=True)
+        if quantized:
+            sv = jnp.concatenate(
+                [vsbuf[slot][i, :, :page_size].T
+                 for i in range(chunk_pages)], axis=0)           # [Ct, Hkv]
+            probs = probs * jax.lax.dot(
+                sv, expt.T, preferred_element_type=jnp.float32)
+        out_full = jax.lax.dot(probs.T.astype(compute_dtype), vflat,
+                               preferred_element_type=jnp.float32)
+        out_full = jnp.where(blockm_t, out_full, 0.0)            # [Hq, HD]
+        acc_ref[:] = acc_ref[:] * alpha.T + jax.lax.dot(
+            out_full.astype(compute_dtype), sel_c,
+            preferred_element_type=jnp.float32)                  # [Hq, D]
+        m_ref[:] = m_cur
 
-        o_ref[0] = (acc / l.T).astype(o_ref.dtype)
+        @pl.when(c == num_chunks - 1)
+        def _finalise():
+            # l >= 1 always: the current token's own term seeds it.
+            o_ref[0] = (acc_ref[:] / l_ref[:].T).astype(o_ref.dtype)
 
     return body
 
@@ -831,33 +947,40 @@ def _paged_attention_flash_append(q, k_cur, v_cur, k_pages, v_pages,
                                   k_scale, v_scale, page_table, lengths,
                                   layer, *, pages: int, quantized: bool,
                                   interpret: bool = False):
-    """Flash-append dispatch: grid (B,), manual double-buffered page (and
-    scale-row) DMAs, online softmax seeded with the current token. HBM
-    reads each page exactly once per (layer, step) — no gathered-window
-    materialisation — which is what makes it the long-window win
-    (BASELINE.md round-5); below _FLASH_APPEND_MIN_W the gather path's
-    XLA fusion amortises better and stays default."""
+    """Multi-chunk flash-append dispatch: grid ``(B, num_chunks)``, one
+    bounded chunk of manually-DMA'd pages (and scale rows) per program,
+    online softmax carried in VMEM scratch across the chunk axis and
+    seeded with the current token (_flash_append_kernel_body). HBM reads
+    each page exactly once per (layer, step) — no gathered-window
+    materialisation — which is what makes it the long-window win and,
+    since round 8, the DEFAULT dispatch at W >= 2048 on TPU; below
+    ``_flash_append_min_w()`` the gather path's XLA fusion amortises
+    better and stays default (module docstring has the measured
+    ladder)."""
     B, Hq, D = q.shape
     L, N, page_size, Hkv, _ = k_pages.shape
     rep = Hq // Hkv
     scale = 1.0 / (D ** 0.5)
     pt = page_table[:, :pages].astype(jnp.int32)
     layer = jnp.asarray(layer, jnp.int32).reshape(1)
-    # Chunk budget in TOKENS, not pages, and as LARGE as VMEM allows:
-    # measured at W=2048/B=32 the chunk-loop iteration cost dominates —
-    # 512-token chunks ran 21.5 ms where whole-window chunks ran
-    # 13.5-14.4 ms. The byte budget (~2048 int8-token-equivalents,
-    # 8.4 MB double-buffered k+v at bench shapes) derives per dtype;
-    # module-level so tests can shrink it to exercise multi-chunk
-    # pipelines in interpret mode.
+    # Chunk budget in TOKENS, bounded by the VMEM stack, NOT by the
+    # window: _FLASH_CHUNK_TOK_BYTES derives the per-dtype chunk (1024
+    # int8 / 512 bf16 / 256 f32 tokens). The grid — not a bigger chunk —
+    # is what amortises per-chunk overhead now, so chunks never grow
+    # with W and the round-5 whole-chunk VMEM OOM cannot recur.
     tok_budget = max(page_size,
                      _FLASH_CHUNK_TOK_BYTES // k_pages.dtype.itemsize)
     chunk_pages = max(1, min(pages, tok_budget // page_size))
+    num_chunks = -(-pages // chunk_pages)
+    # bf16 math on hardware; f32 in interpret mode so CPU parity tests
+    # pin against the oracle at full precision (the body's dataflow is
+    # identical — only the dot operand dtype changes).
+    compute_dtype = jnp.float32 if interpret else jnp.bfloat16
 
     in_specs = [
-        pl.BlockSpec((1, Hq, D), lambda b, pt, ln, ly: (b, 0, 0)),
-        pl.BlockSpec((1, Hkv, D), lambda b, pt, ln, ly: (b, 0, 0)),
-        pl.BlockSpec((1, Hkv, D), lambda b, pt, ln, ly: (b, 0, 0)),
+        pl.BlockSpec((1, Hq, D), lambda b, c, pt, ln, ly: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda b, c, pt, ln, ly: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda b, c, pt, ln, ly: (b, 0, 0)),
         pl.BlockSpec(memory_space=pl.ANY),      # k pool stays in HBM
         pl.BlockSpec(memory_space=pl.ANY),      # v pool stays in HBM
     ]
@@ -879,18 +1002,26 @@ def _paged_attention_flash_append(q, k_cur, v_cur, k_pages, v_pages,
             pltpu.VMEM((2, chunk_pages, Hkv, ps_pad), jnp.float32),
         ]
         n_sem = 4
+    # Cross-chunk online-softmax state (persists across the grid's
+    # chunk axis; re-seeded at every row's chunk 0).
+    scratch += [
+        pltpu.VMEM((1, Hq), jnp.float32),       # running max m
+        pltpu.VMEM((1, Hq), jnp.float32),       # running sum l
+        pltpu.VMEM((Hq, D), jnp.float32),       # unnormalised acc
+    ]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,       # page_table, lengths, layer
-        grid=(B,),
+        grid=(B, num_chunks),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, Hq, D), lambda b, pt, ln, ly: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, Hq, D),
+                               lambda b, c, pt, ln, ly: (b, 0, 0)),
         scratch_shapes=scratch + [
             pltpu.SemaphoreType.DMA((n_sem, 2, chunk_pages))],
     )
     return pl.pallas_call(
         _flash_append_kernel_body(quantized, page_size, pages, chunk_pages,
-                                  rep, scale),
+                                  num_chunks, rep, scale, compute_dtype),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hq, D), q.dtype),
         interpret=interpret,

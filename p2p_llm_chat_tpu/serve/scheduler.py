@@ -292,6 +292,13 @@ class BatchScheduler:
                     "kv_quant=True requires the gather attention impl; "
                     f"PAGED_ATTN_IMPL={_pa._DEFAULT_IMPL!r} is set")
         self.kv_quant = kv_quant
+        # The gather->flash-append boundary this process's programs will
+        # bake in at trace time. Snapshotted again when warmup records
+        # its ladder (the env toggle is runtime-flippable by design —
+        # bench sweeps do — but the LIVE programs keep whatever they
+        # traced, so the gauge must report the compiled-in value, not
+        # the current env).
+        self._paged_flash_min_w = self._flash_min_w()
         if admit_chunk is not None and admit_chunk < 1:
             raise ValueError(f"admit_chunk must be >= 1, got {admit_chunk}")
         self.admit_chunk = admit_chunk
@@ -1272,10 +1279,23 @@ class BatchScheduler:
         # (_serving_bucket) — recorded only after every program compiled.
         def _record():
             self._warmed_buckets = buckets
+            # Long-window kernel ladder: name which warmed windows baked
+            # in the multi-chunk flash-append kernel (W >= min_w on TPU
+            # — ops/paged_attention._flash_append_policy). The windows
+            # loop above compiled BOTH sides of the boundary, so a live
+            # batch promoting from a gather window into a kernel window
+            # mid-serving never compiles over active streams.
+            flash_note = ""
+            if self.kv_mode == "paged":
+                min_w = self._paged_flash_min_w = self._flash_min_w()
+                kernel_ws = [w for w in windows if min_w and w >= min_w]
+                if kernel_ws:
+                    flash_note = (f", flash-append kernel at windows "
+                                  f"{kernel_ws} (min_w {min_w})")
             log.info("warmup compiled: admit %s x buckets %s, decode "
                      "windows %s, prefill chunk %d (%d continuation "
-                     "programs)", chunk_sizes, buckets, windows,
-                     self.prefill_chunk, n_chunk_jobs)
+                     "programs)%s", chunk_sizes, buckets, windows,
+                     self.prefill_chunk, n_chunk_jobs, flash_note)
         steps.append(_record)
         # Drain the dispatch queue at the end: warmup executions (and the
         # axon tunnel's deferred per-program loads) are async — without a
@@ -1436,7 +1456,15 @@ class BatchScheduler:
         """Compile+run the decode (and spec) program for one window on
         live state as a parked-row no-op. The programs split every row's
         PRNG key unconditionally, so live rows' keys are restored after —
-        a mid-traffic warmup must not perturb seeded requests' outputs."""
+        a mid-traffic warmup must not perturb seeded requests' outputs.
+
+        Each window's program bakes in its attention impl at trace time
+        (paged mode: gather below PAGED_APPEND_FLASH_MIN_W, the
+        multi-chunk flash-append kernel at and above it on TPU), so
+        running this across the default whole ladder up to max_seq
+        warms the kernel's Mosaic compiles at every long-window bucket
+        — window promotion under live traffic is always a cache hit,
+        on either side of the gather/kernel boundary."""
         B = self.num_slots
         # graftcheck: sync-ok host bool list, no device readback
         live = np.array([s is not None for s in self._slots], bool)
@@ -1933,7 +1961,29 @@ class BatchScheduler:
         if self.kv_mode == "paged":
             out["serve_kv_free_pages"] = self._alloc.free_pages
             out["serve_kv_total_pages"] = self.num_pages - 1
+            # The gather->flash-append promotion boundary (0 = kernel
+            # cannot engage: CPU / disabled / block-kernel override;
+            # 1 = the flash override, every window): operators
+            # correlating a step-time knee at a window boundary read the
+            # value the compiled ladder baked in — snapshotted at
+            # construction and at warmup, NOT the live env (the toggle
+            # is runtime-flippable; traced programs are not).
+            out["paged_flash_min_w"] = self._paged_flash_min_w
         return out
+
+    @staticmethod
+    def _flash_min_w() -> int:
+        """Window threshold at which this process's paged decode
+        programs dispatch the multi-chunk flash-append kernel instead of
+        the gather path: 0 = cannot engage (CPU, disabled, block-kernel
+        override), 1 = the flash override (every window). One source of
+        truth: ops/paged_attention.effective_flash_min_w, next to the
+        dispatch policy itself."""
+        import importlib
+        # ops/__init__ rebinds `paged_attention` to the FUNCTION;
+        # importlib reaches the module.
+        _pa = importlib.import_module("p2p_llm_chat_tpu.ops.paged_attention")
+        return _pa.effective_flash_min_w()
 
     def _try_reserve(self, slot: _Slot) -> bool:
         """Paged mode: claim the slot's page budget (prompt + generation

@@ -241,15 +241,20 @@ def test_two_process_embed_matches_oracle():
 
 
 def test_two_process_batched_distinct_requests():
-    """The round-4 verdict's 'done' bar: 4+ concurrent distinct requests
-    at dp=2 across two OS processes, outputs oracle-exact, and a
-    throughput assertion showing >1 request per model pass."""
+    """The round-4 verdict's 'done' bar, tightened per round-5 item #7:
+    4 concurrent distinct requests at dp=2 across two OS processes,
+    outputs oracle-exact, and a RELATIVE-throughput assertion — the
+    concurrent batch completes in < 0.6x the serialized single-row
+    time over the same warmed programs (a "requests > passes" counter
+    alone cannot distinguish real batching wins from bookkeeping)."""
     coord = f"127.0.0.1:{_free_port()}"
     serve_port = _free_port()
     # Generous admission window so concurrent requests coalesce reliably
-    # even on a loaded CI box.
-    procs = [_spawn(0, coord, serve_port, window_ms=500),
-             _spawn(1, coord, serve_port, window_ms=500)]
+    # even on a loaded CI box. ONE constant: the throughput accounting
+    # below subtracts this same window from the serialized phase.
+    window_ms = 500
+    procs = [_spawn(0, coord, serve_port, window_ms=window_ms),
+             _spawn(1, coord, serve_port, window_ms=window_ms)]
     try:
         url = f"http://127.0.0.1:{serve_port}"
         _wait_up(url, procs)
@@ -270,6 +275,25 @@ def test_two_process_batched_distinct_requests():
              "options": {"num_predict": 8, "temperature": 0.8,
                          "top_k": 16, "seed": 1234}},
         ]
+        wants = [
+            _oracle(r["prompt"], 8,
+                    temperature=r["options"].get("temperature", 0.0),
+                    top_k=r["options"].get("top_k", 0),
+                    seed=r["options"].get("seed", 0))
+            for r in reqs
+        ]
+
+        # Serialized reference: the same N requests one at a time over
+        # the already-warmed programs — each pays its own admission
+        # window and its own lockstep round. This is the denominator of
+        # the relative-throughput bar below.
+        t0 = time.monotonic()
+        serial = [_post(url, dict(model="tiny", stream=False, **r))
+                  for r in reqs]
+        t_serial = time.monotonic() - t0
+        for i, r in enumerate(serial):
+            assert r["response"] == wants[i], (i, r["response"], wants[i])
+
         results = [None] * len(reqs)
         errors = []
         embed_resp = {}
@@ -299,30 +323,44 @@ def test_two_process_batched_distinct_requests():
         threads = [threading.Thread(target=worker, args=(i,))
                    for i in range(len(reqs))]
         threads.append(threading.Thread(target=embed_worker))
+        t0 = time.monotonic()
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=180)
+        t_concurrent = time.monotonic() - t0
         assert not errors, errors
         assert all(r is not None for r in results)
         assert len(embed_resp.get("embeddings", [])) == 1
 
         for i, r in enumerate(results):
-            o = reqs[i]["options"]
-            want = _oracle(reqs[i]["prompt"], 8,
-                           temperature=o.get("temperature", 0.0),
-                           top_k=o.get("top_k", 0),
-                           seed=o.get("seed", 0))
-            assert r["response"] == want, (i, r["response"], want)
+            assert r["response"] == wants[i], (i, r["response"], wants[i])
 
         after = _metrics(url)
         served = after["serve_multihost_requests"] \
             - base["serve_multihost_requests"]
         rounds = after["serve_multihost_batched_rounds"] \
             - base["serve_multihost_batched_rounds"]
-        assert served == len(reqs)
-        # dp=2 rows, 4 distinct requests: batching must have packed >1
-        # request into at least one lockstep round.
+        assert served == 2 * len(reqs)
+        # dp=2 rows: at least one lockstep round must have packed >1
+        # request (the serialized phase contributes exactly N rounds,
+        # so rounds < served requires the concurrent phase to batch).
         assert rounds < served, (rounds, served)
+        # Round-5 item #7: the batch must be FASTER, not merely packed —
+        # N distinct requests at dp=2 in under 0.6x the serialized time.
+        # The serialized phase pays the FULL admission window per
+        # request (no partner ever arrives), a configured sleep, not
+        # model work — subtract it, or the bar is vacuous (wall-vs-wall
+        # passes even with batching broken, since N windows dwarf the
+        # rounds). The concurrent phase keeps its (early-closing)
+        # window inside the measurement and gets ONE window of slack
+        # for the raced embed round, so a batching regression — which
+        # doubles the model passes — still trips the 0.6 factor for
+        # any per-round cost.
+        win_s = window_ms / 1000.0
+        serial_compute = t_serial - len(reqs) * win_s
+        assert serial_compute > 0, (t_serial, "window accounting broke")
+        assert t_concurrent < 0.6 * serial_compute + win_s, \
+            (t_concurrent, t_serial, serial_compute)
     finally:
         _shutdown(procs)

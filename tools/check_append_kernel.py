@@ -1,8 +1,11 @@
-"""TPU parity check: Pallas append-attention kernel vs XLA gather path.
+"""TPU parity check: Pallas append-attention kernels vs XLA gather path.
 
-Runs both implementations of ops/paged_attention.paged_attention_append
-on the real chip over random pools (bf16 and int8) and asserts closeness.
-CPU tests can't cover the Mosaic lowering; this is the hardware check.
+Runs both Pallas implementations of
+ops/paged_attention.paged_attention_append — the round-4 gathered-window
+block kernel and the round-8 multi-chunk flash-append kernel — on the
+real chip over random pools (bf16 and int8) and asserts closeness to
+the gather path. CPU tests can't cover the Mosaic lowering; this is the
+hardware check.
 """
 
 from __future__ import annotations
@@ -27,9 +30,33 @@ from p2p_llm_chat_tpu.ops.paged_kv import (PagedKVCache,  # noqa: E402
                                            write_prefill_row)
 
 
-def run(quantized: bool, B=32, pages=3, ps=64) -> None:
+def _block_kernel(q, k_cur, v_cur, cache, lens, layer, *, pages,
+                  quantized):
+    return pa._paged_append_kernel_call(
+        q, k_cur, v_cur, cache.k, cache.v, cache.k_scale, cache.v_scale,
+        cache.page_table, lens, layer, pages=pages, quantized=quantized)
+
+
+def _flash_kernel(q, k_cur, v_cur, cache, lens, layer, *, pages,
+                  quantized):
+    return pa._paged_attention_flash_append(
+        q, k_cur, v_cur, cache.k, cache.v, cache.k_scale, cache.v_scale,
+        cache.page_table, lens, layer, pages=pages, quantized=quantized)
+
+
+def run(quantized: bool, B=32, pages=3, ps=64, *, kernel=_block_kernel,
+        label="block", seed=0) -> None:
+    """Shared harness: random bf16/int8 pool filled through the real
+    splice op, ``kernel`` vs the gather append path at first/last layer.
+
+    Defaults check the round-4 block kernel at a serving window; the
+    __main__ matrix also runs the round-8 multi-chunk flash kernel at
+    pages=48 (W=3072: 3 chunks of 1024 int8 tokens / 6 of 512 bf16 —
+    the cross-chunk scratch merge, slot parity through row boundaries,
+    and the clamped partial chunk all execute on real Mosaic, not just
+    in interpret mode)."""
     cfg = get_config("bench-1b")
-    rng = np.random.default_rng(0)
+    rng = np.random.default_rng(seed)
     mppr = pages
     num_pages = B * mppr + 1
     cache = PagedKVCache.create(cfg, B, num_pages, ps,
@@ -39,8 +66,7 @@ def run(quantized: bool, B=32, pages=3, ps=64) -> None:
     for b in range(B):
         n = int(rng.integers(1, pages * ps - 1))
         lengths.append(n)
-        table = jnp.asarray(
-            np.pad(1 + b * mppr + np.arange(mppr), (0, 0)), jnp.int32)
+        table = jnp.asarray(1 + b * mppr + np.arange(mppr), jnp.int32)
         rk = jnp.asarray(rng.normal(size=(cfg.num_layers, pages * ps,
                                           cfg.num_kv_heads, cfg.head_dim)),
                          jnp.bfloat16)
@@ -55,26 +81,44 @@ def run(quantized: bool, B=32, pages=3, ps=64) -> None:
     v_cur = jnp.asarray(rng.normal(size=k_cur.shape), jnp.bfloat16)
 
     for layer in (0, cfg.num_layers - 1):
-        kern = pa._paged_append_kernel_call(
-            q, k_cur, v_cur, cache.k, cache.v, cache.k_scale, cache.v_scale,
-            cache.page_table, lens, jnp.asarray(layer), pages=pages,
-            quantized=quantized)
+        kern = kernel(q, k_cur, v_cur, cache, lens, jnp.asarray(layer),
+                      pages=pages, quantized=quantized)
+        # Pin the reference to the XLA gather path on BOTH dispatch
+        # axes: _APPEND_IMPL picks the impl family, and the min-W
+        # toggle must be 0 or the round-8 default would route the
+        # "reference" itself to the flash kernel at the long windows
+        # run_flash uses (W=3072 >= 2048) — a vacuous self-comparison.
         saved = pa._APPEND_IMPL
+        saved_min_w = os.environ.get("PAGED_APPEND_FLASH_MIN_W")
         pa._APPEND_IMPL = "gather"
+        os.environ["PAGED_APPEND_FLASH_MIN_W"] = "0"
         try:
             ref = pa.paged_attention_append(q, k_cur, v_cur, cache, lens,
                                             jnp.asarray(layer), pages=pages)
         finally:
             pa._APPEND_IMPL = saved
+            if saved_min_w is None:
+                os.environ.pop("PAGED_APPEND_FLASH_MIN_W", None)
+            else:
+                os.environ["PAGED_APPEND_FLASH_MIN_W"] = saved_min_w
         kn, rn = np.asarray(kern, np.float32), np.asarray(ref, np.float32)
         err = np.max(np.abs(kn - rn))
         denom = np.max(np.abs(rn)) or 1.0
-        print(f"quantized={quantized} layer={layer}: max abs err {err:.5f} "
-              f"(rel {err/denom:.5f})")
-        assert err / denom < 2e-2, "kernel diverges from gather path"
+        print(f"{label} quantized={quantized} layer={layer}: max abs err "
+              f"{err:.5f} (rel {err/denom:.5f})")
+        assert err / denom < 2e-2, f"{label} kernel diverges from gather path"
+
+
+def run_flash(quantized: bool, B=32, pages=48, ps=64) -> None:
+    """The multi-chunk flash-append kernel at a long (multi-chunk)
+    window — see run()'s docstring for what that exercises."""
+    run(quantized, B, pages, ps, kernel=_flash_kernel, label="flash",
+        seed=1)
 
 
 if __name__ == "__main__":
     run(quantized=True)
     run(quantized=False)
+    run_flash(quantized=True)
+    run_flash(quantized=False)
     print("append kernel parity OK")
