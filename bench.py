@@ -716,6 +716,14 @@ def main() -> None:
         chunk_saved, sched.prefill_chunk = sched.prefill_chunk, 0
         mixed_stats["single_shot"] = mixed_phase("single-shot")
         sched.prefill_chunk = chunk_saved
+    # Overload/robustness gauges for the JSON row: shed counts make
+    # overload runs visible in BENCH_*.json (0 on a healthy run — the
+    # bench's own load must never shed under the default queue bound),
+    # and a nonzero loop_stall_ms flags a scheduler-loop stall past the
+    # watchdog budget during the run.
+    final_snap = sched.metrics_snapshot()
+    requests_shed = final_snap.get("requests_shed_total", 0)
+    loop_stall_ms = final_snap.get("loop_stall_ms", 0.0)
     sched.stop()
 
     result = {
@@ -762,6 +770,11 @@ def main() -> None:
             # not the whole prompt's prefill).
             "prefill_chunk": sched.prefill_chunk or None,
             "mixed_load": mixed_stats or None,
+            # Overload shedding + loop watchdog (ISSUE 5): shed requests
+            # (503 fast-fail at the queue bound) and the max over-budget
+            # scheduler-loop iteration. Both 0 on a healthy run.
+            "requests_shed": requests_shed,
+            "loop_stall_ms": loop_stall_ms or None,
             # Long-window sweep (BENCH_LONG_W): per (window, impl) step
             # time vs the HBM bytes bound; flash rows carry their
             # speedup over the gather path — the round-8 acceptance

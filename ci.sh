@@ -69,9 +69,17 @@ if [ "${1:-}" = "full" ]; then
   JAX_PLATFORMS=cpu python -m pytest tests/test_flash_append_geometry.py \
     -q || rc=1
 
+  # Fault injection: the WHOLE chaos suite including the slow-marked
+  # HTTP chaos matrix and the directory-outage leg (nodes degrade to
+  # the DHT rung and recover after a restart). Pinned on CPU, excluded
+  # from the sweep below so each case executes exactly once.
+  echo "== failpoint chaos suite + HTTP chaos matrix (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_failpoints.py -q || rc=1
+
   echo "== full test suite"
   python -m pytest tests/ -q \
-    --ignore=tests/test_flash_append_geometry.py || rc=1
+    --ignore=tests/test_flash_append_geometry.py \
+    --ignore=tests/test_failpoints.py || rc=1
 else
   # Fused-decode parity pinned explicitly on CPU: the K-fused-steps ≡
   # K-plain-ticks bit-identity contract (serve/scheduler.py
@@ -99,11 +107,21 @@ else
   JAX_PLATFORMS=cpu python -m pytest tests/test_flash_append_geometry.py \
     -q -x -m 'not slow' || rc=1
 
+  # Fault injection (tier-1 leg): every failpoint site armed and its
+  # degradation contract asserted on CPU/interpret — no deadlock,
+  # well-formed errors, shed = fast 503, oracle-exact recovery. The
+  # slow-marked HTTP chaos matrix runs in full mode. Excluded from the
+  # sweep below so each case executes exactly once.
+  echo "== failpoint degradation contracts (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_failpoints.py -q -x \
+    -m 'not slow' || rc=1
+
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
     --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_chunked_prefill.py \
     --ignore=tests/test_flash_append_geometry.py \
+    --ignore=tests/test_failpoints.py \
     --ignore=tests/test_stress.py \
     --ignore=tests/test_serve_tp.py \
     --ignore=tests/test_mixtral_parity.py \

@@ -28,7 +28,9 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from .proto import now_rfc3339, parse_ts
+from .utils.backoff import with_retries
 from .utils.env import env_or
+from .utils.failpoints import failpoint, load_env as load_failpoints_env
 from .utils.http import HttpServer, Request, Response, Router, http_json
 from .utils.log import get_logger
 
@@ -86,6 +88,8 @@ class DirectoryService:
     stale-record eviction (0 = never, the reference behavior)."""
 
     def __init__(self, addr: Optional[str] = None, ttl_seconds: float = 0.0) -> None:
+        # Eager FAIL_POINTS parse: malformed chaos config fails at boot.
+        load_failpoints_env()
         self.addr_cfg = addr if addr is not None else env_or("ADDR", ":8080")
         if self.addr_cfg.startswith(":"):
             # The reference directory binds all interfaces for ":8080"
@@ -156,22 +160,44 @@ class DirectoryService:
 
 class DirectoryClient:
     """HTTP client for the directory (go/cmd/node/main.go:50-95).
-    5 s timeout matches the reference's client (main.go:175)."""
+    5 s per-attempt timeout matches the reference's client (main.go:175);
+    on top of that one-shot contract, transient CONNECTION failures now
+    retry with jittered exponential backoff (utils/backoff) inside a
+    total wall budget — a directory mid-restart costs milliseconds of
+    retry, not an outage, while HTTP-level answers (404 not-found) still
+    return immediately. Each RPC carries a named failpoint so the chaos
+    suite can fault-inject the whole directory rung."""
 
-    def __init__(self, base_url: str, timeout: float = 5.0) -> None:
+    def __init__(self, base_url: str, timeout: float = 5.0,
+                 attempts: int = 3, retry_budget_s: float = 8.0) -> None:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.attempts = attempts
+        self.retry_budget_s = retry_budget_s
+
+    def _call(self, site: str, fn):
+        def attempt():
+            act = failpoint(site)
+            if act is not None and act.kind in ("drop", "error"):
+                raise ConnectionError(
+                    act.msg or f"injected fault: {site}")
+            return fn()
+        return with_retries(attempt, attempts=self.attempts,
+                            base_s=0.15, max_s=1.5,
+                            retry_on=(ConnectionError,),
+                            budget_s=self.retry_budget_s)
 
     def register(self, username: str, peer_id: str, addrs: list[str]) -> None:
-        http_json("POST", f"{self.base_url}/register",
-                  {"username": username, "peer_id": peer_id, "addrs": addrs},
-                  timeout=self.timeout)
+        self._call("p2p.directory.register", lambda: http_json(
+            "POST", f"{self.base_url}/register",
+            {"username": username, "peer_id": peer_id, "addrs": addrs},
+            timeout=self.timeout))
 
     def lookup(self, username: str) -> DirectoryRecord:
         import urllib.parse
         q = urllib.parse.urlencode({"username": username})
-        status, body = http_json("GET", f"{self.base_url}/lookup?{q}",
-                                 timeout=self.timeout)
+        status, body = self._call("p2p.directory.lookup", lambda: http_json(
+            "GET", f"{self.base_url}/lookup?{q}", timeout=self.timeout))
         return DirectoryRecord(
             username=body.get("username", username),
             peer_id=body.get("peer_id", ""),

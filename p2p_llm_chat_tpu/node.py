@@ -58,6 +58,7 @@ from typing import Optional
 
 from .directory import DirectoryClient
 from .inbox import Inbox
+from .utils.backoff import Backoff
 from .p2p import Identity, Multiaddr, P2PHost
 from .p2p.dht import DHTNode, parse_seeds
 from .p2p.natpmp import PortMapper
@@ -86,6 +87,9 @@ class ChatNode:
         dht_addr: Optional[str] = None,
         dht_bootstrap: Optional[str] = None,
     ) -> None:
+        # Eager FAIL_POINTS parse: malformed chaos config fails at boot.
+        from .utils.failpoints import load_env as load_failpoints_env
+        load_failpoints_env()
         # Env-var defaults keep the reference's exact config surface
         # (go/cmd/node/main.go:131-134).
         self.username = username if username is not None else env_or("MYNAMEIS", "anon")
@@ -397,9 +401,17 @@ class ChatNode:
 
     def _reregister_loop(self) -> None:
         """Periodically re-register so an (in-memory, record-losing)
-        directory restart relearns this node; failures back off
-        exponentially up to 8x the interval and never crash the node —
-        only the STARTUP registration is fatal (main.go:184 parity)."""
+        directory restart relearns this node; failures back off with
+        jittered exponential delays up to 8x the interval (utils/backoff
+        — the jitter keeps a fleet of nodes from hammering a restarted
+        directory in lockstep) and never crash the node — only the
+        STARTUP registration is fatal (main.go:184 parity). Failure logs
+        are bounded to one WARNING per outage (state-change logging: an
+        hours-long outage is one 'lost' line and one 'recovered' line,
+        not one line per attempt)."""
+        backoff = Backoff(base_s=self.reregister_s,
+                          max_s=self.reregister_s * 8, jitter=0.25)
+        dir_ok = True
         delay = self.reregister_s
         while not self._closed.wait(delay):
             try:
@@ -410,11 +422,23 @@ class ChatNode:
                 continue
             try:
                 self.dir.register(self.username, self.host.peer_id, addrs)
+                if not dir_ok:
+                    dir_ok = True
+                    log.info("directory %s reachable again; re-registered",
+                             self.directory_url)
+                backoff.reset()
                 delay = self.reregister_s
             except Exception as e:  # noqa: BLE001 — outage, keep trying
-                delay = min(delay * 2, self.reregister_s * 8)
-                log.debug("re-register failed (%s); next attempt in %.0fs",
-                          e, delay)
+                delay = backoff.next()
+                if dir_ok:
+                    dir_ok = False
+                    log.warning("re-register failed (%s); backing off "
+                                "(next attempt in %.0fs, then "
+                                "exponentially up to %.0fs)",
+                                e, delay, self.reregister_s * 8)
+                else:
+                    log.debug("re-register still failing (%s); next "
+                              "attempt in %.0fs", e, delay)
             # Renew the NAT-PMP mapping before it lapses (half-lifetime
             # cadence is tracked inside the mapper); a changed grant
             # (gateway reboot, reassigned port) is re-advertised so the

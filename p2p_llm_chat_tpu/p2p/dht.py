@@ -68,6 +68,8 @@ from typing import Callable, Optional
 from cryptography.exceptions import InvalidSignature
 
 from .identity import Identity, peer_id_to_public_key
+from ..utils.backoff import Backoff, note_retry
+from ..utils.failpoints import failpoint
 from ..utils.log import get_logger
 
 log = get_logger("dht")
@@ -469,7 +471,25 @@ class DHTNode:
             self._pending[rid] = (ev, hits, (dst_ip, dst[1]))
         try:
             per_try = self.rpc_timeout_s if timeout_s is None else timeout_s
-            for _ in range(max(1, attempts)):
+            # Jittered backoff between retries (utils/backoff): every
+            # node retrying a just-restarted seed at the same instant is
+            # a thundering herd; the jitter decorrelates them. Bounded:
+            # the extra sleep stays well under one rpc timeout, so the
+            # lookup wall budgets (_iterate / the /send handler) hold.
+            bo = Backoff(base_s=per_try / 8, max_s=per_try / 2, jitter=0.5)
+            for i in range(max(1, attempts)):
+                # Failpoint: one RPC attempt. ``drop`` = this datagram is
+                # lost on the wire (the caller sees a timeout-shaped None
+                # without waiting out the real timeout — fast chaos);
+                # ``delay`` injects network latency before the send.
+                act = failpoint("p2p.dht.rpc")
+                if act is not None and act.kind == "drop":
+                    if i + 1 >= max(1, attempts):
+                        return None
+                    continue       # counted by the follow-up attempt below
+                if i > 0:
+                    note_retry()
+                    time.sleep(bo.next())
                 self._send(dict(msg), dst)
                 if ev.wait(per_try):
                     return hits[0][0]

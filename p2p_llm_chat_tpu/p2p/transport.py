@@ -50,6 +50,7 @@ from cryptography.hazmat.primitives.asymmetric.x25519 import (
 from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
 from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 
+from ..utils.failpoints import failpoint
 from ..utils.log import get_logger
 from .addr import Multiaddr
 from .identity import Identity, peer_id_to_public_key, public_key_to_peer_id
@@ -196,6 +197,14 @@ def _x25519_pub_bytes(priv: X25519PrivateKey) -> bytes:
 
 def dialer_handshake(sock: socket.socket, identity: Identity,
                      expected_peer_id: Optional[str]) -> SecureStream:
+    # Failpoint: the secure-channel dial handshake. ``drop``/``error``
+    # surface as the HandshakeError every dial path already degrades on
+    # (node._deliver collects it and tries the next advertised addr);
+    # ``raise`` exercises the same paths with an unexpected fault.
+    act = failpoint("p2p.transport.handshake")
+    if act is not None and act.kind in ("drop", "error"):
+        raise HandshakeError(
+            act.msg or "injected fault: p2p.transport.handshake")
     sock.settimeout(HANDSHAKE_TIMEOUT)
     eph = X25519PrivateKey.generate()
     eph_d = _x25519_pub_bytes(eph)

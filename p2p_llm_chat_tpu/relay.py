@@ -46,6 +46,8 @@ from .p2p.transport import (
     send_json_frame,
 )
 from .utils.env import env_int, env_or
+from .utils.failpoints import (FailpointError, failpoint,
+                               load_env as load_failpoints_env)
 from .utils.log import get_logger
 from .utils import native
 
@@ -102,6 +104,8 @@ class RelayService:
                  reserve_ts_window_s: float = RESERVE_TS_WINDOW_S,
                  stale_after_s: float = RESERVATION_STALE_S,
                  sweep_interval_s: float = SWEEP_INTERVAL_S) -> None:
+        # Eager FAIL_POINTS parse: malformed chaos config fails at boot.
+        load_failpoints_env()
         addr = addr if addr is not None else env_or("RELAY_ADDR", "127.0.0.1:4100")
         host, _, port = addr.rpartition(":")
         self._host = host or "127.0.0.1"
@@ -230,6 +234,19 @@ class RelayService:
             if msg is None:
                 conn.close()
                 return
+            # Failpoint: the relay control plane. ``drop`` discards the
+            # control frame and closes the connection (the client sees a
+            # dead relay and falls back to direct/punch paths); ``error``
+            # answers a well-formed refusal; ``raise`` rides the except
+            # below (connection closed, relay keeps serving others).
+            act = failpoint("p2p.relay.control")
+            if act is not None and act.kind in ("drop", "error"):
+                if act.kind == "error":
+                    send_json_frame(conn, {
+                        "ok": False,
+                        "error": act.msg or "injected fault"})
+                conn.close()
+                return
             mtype = msg.get("type")
             if mtype == RELAY_RESERVE:
                 self._handle_reserve(conn, msg)
@@ -242,7 +259,8 @@ class RelayService:
             else:
                 send_json_frame(conn, {"ok": False, "error": "unknown type"})
                 conn.close()
-        except (OSError, ValueError, json.JSONDecodeError) as e:
+        except (OSError, ValueError, json.JSONDecodeError,
+                FailpointError) as e:
             log.debug("relay conn error: %s", e)
             try:
                 conn.close()

@@ -31,11 +31,15 @@ import time
 from typing import Iterator, Optional
 
 from ..proto import now_rfc3339
+from ..utils import backoff as _backoff
+from ..utils import failpoints as _failpoints
 from ..utils.env import env_or
+from ..utils.failpoints import failpoint
 from ..utils.http import HttpServer, Request, Response, Router
 from ..utils.log import get_logger
 from ..utils.metrics import Registry
-from .backend import Backend, GenerateOptions, GenerateRequest, RequestStats
+from .backend import (Backend, GenerateOptions, GenerateRequest,
+                      OverloadError, RequestStats)
 
 log = get_logger("serve.api")
 
@@ -63,11 +67,20 @@ class OllamaServer:
     def __init__(self, backend: Backend, addr: Optional[str] = None,
                  registry: Optional[Registry] = None) -> None:
         self.backend = backend
+        # Eager FAIL_POINTS parse: a malformed chaos config must fail
+        # HERE, at boot, not as a ValueError at some arbitrary deep
+        # failpoint() mid-serving (where it would surface as one buried
+        # warmup-failure log line and a permanently-warming /readyz).
+        _failpoints.load_env()
         # 11434 is Ollama's default port; SERVE_ADDR overrides.
         self.addr_cfg = addr if addr is not None else env_or("SERVE_ADDR", "127.0.0.1:11434")
         self.metrics = registry or Registry()
         self._m_requests = self.metrics.counter("serve_requests_total")
         self._m_errors = self.metrics.counter("serve_errors_total")
+        # HTTP-plane view of overload shedding (the scheduler's own
+        # requests_shed_total arrives via the backend snapshot): how many
+        # 503s THIS front returned.
+        self._m_shed = self.metrics.counter("serve_requests_shed_total")
         self._m_tokens = self.metrics.counter("serve_completion_tokens_total")
         self._m_inflight = self.metrics.gauge("serve_inflight_requests")
         self._m_ttft = self.metrics.histogram("serve_ttft_seconds")
@@ -94,10 +107,33 @@ class OllamaServer:
             200, "Ollama is running", content_type="text/plain"))
         self.router.add("HEAD", "/", lambda r: Response(200, ""))
         self.router.add("GET", "/metrics", self._metrics)
+        # Liveness vs readiness are DISTINCT probes: /healthz answers
+        # "is the process up" (static 200 — a restart won't fix a
+        # warming server, so an orchestrator must not kill it for being
+        # slow to compile), while /readyz answers "should a load
+        # balancer route traffic here" (503 until the backend's warmup
+        # completes — routing earlier puts tens-of-seconds compiles on
+        # real requests' TTFT).
         self.router.add("GET", "/healthz", lambda r: Response(200, {"status": "ok"}))
+        self.router.add("GET", "/readyz", self._readyz)
         self._server: Optional[HttpServer] = None
 
     # -- helpers -------------------------------------------------------------
+
+    def _readyz(self, req: Request) -> Response:
+        """Readiness: backends exposing ``ready()`` (the TPU engine —
+        warmup-gated; multi-model fronts AND their engines) gate the
+        answer; backends without it (FakeLLM) are ready when live."""
+        fn = getattr(self.backend, "ready", None)
+        try:
+            ok = bool(fn()) if callable(fn) else True
+        except Exception:   # noqa: BLE001 — a broken probe is "not ready"
+            log.exception("readiness probe failed")
+            ok = False
+        if ok:
+            return Response(200, {"status": "ready"})
+        return Response(503, {"status": "warming"},
+                        headers={"Retry-After": "2"})
 
     def _resolve(self, model: str):
         """Backend for a request's model tag: multi-model backends
@@ -124,6 +160,18 @@ class OllamaServer:
                     lines.append(f"# TYPE {base} {kind}\n")
                 lines.append(f"{name} {v}\n")
             text += "".join(lines)
+        # Robustness-plane series (process-global): per-site failpoint
+        # hit counters (absent entirely when no site ever fired — a
+        # production scrape showing ANY failpoint_hits_total series means
+        # fault injection is armed) and the shared retry counter from
+        # utils/backoff (directory/DHT clients).
+        fp = _failpoints.snapshot()
+        if fp:
+            text += "# TYPE failpoint_hits_total counter\n" + "".join(
+                f'failpoint_hits_total{{site="{site}"}} {n}\n'
+                for site, n in sorted(fp.items()))
+        text += ("# TYPE retry_attempts_total counter\n"
+                 f"retry_attempts_total {_backoff.retries_total()}\n")
         return Response(200, text, content_type="text/plain; version=0.0.4")
 
     def _finalize_record(self, model: str, stats: RequestStats,
@@ -158,6 +206,14 @@ class OllamaServer:
         ``with_context``: /api/generate's conversation-state round trip
         (request ``context`` ids prepended, final record returns the
         updated ids — Ollama's stateless continuation contract)."""
+        # Failpoint: the request-parse/validate site. ``error`` returns
+        # a well-formed Ollama error record; ``raise`` rides the
+        # router's handler-error envelope (also a well-formed 500).
+        act = failpoint("serve.api.parse")
+        if act is not None and act.kind == "error":
+            self._m_errors.inc()
+            return Response(500, {"error": act.msg
+                                  or "injected fault: serve.api.parse"})
         model = str(req_body.get("model") or self.backend.name)
         opts = GenerateOptions.from_ollama(req_body.get("options"))
         stream = req_body.get("stream")
@@ -182,9 +238,28 @@ class OllamaServer:
         self._m_inflight.add(1)
         started = time.monotonic()
 
+        # Submit happens HERE, before the stream/non-stream split: the
+        # scheduler's overload check is eager (fast-fail shedding), so a
+        # request shed at capacity gets its 503 + Retry-After in
+        # milliseconds — never a queue-deadline burn, and never a
+        # mid-NDJSON error record after a 200 status already went out.
+        try:
+            deltas = backend.generate_stream(greq, stats)
+        except OverloadError as e:
+            self._m_inflight.add(-1)
+            self._m_shed.inc()
+            return Response(
+                503, {"error": str(e)},
+                headers={"Retry-After": str(max(1, round(e.retry_after_s)))})
+        except Exception as e:  # noqa: BLE001
+            self._m_errors.inc()
+            self._m_inflight.add(-1)
+            log.exception("submit failed")
+            return Response(500, {"error": str(e)})
+
         if not stream:
             try:
-                text = "".join(backend.generate_stream(greq, stats))
+                text = "".join(deltas)
             except Exception as e:  # noqa: BLE001
                 self._m_errors.inc()
                 self._m_inflight.add(-1)
@@ -200,7 +275,14 @@ class OllamaServer:
 
         def ndjson() -> Iterator[bytes]:
             try:
-                for delta in backend.generate_stream(greq, stats):
+                for delta in deltas:
+                    # Failpoint: the per-delta stream-yield site. ``drop``
+                    # discards this chunk (truncated-looking text, stream
+                    # still terminates cleanly); ``raise`` exercises the
+                    # mid-stream error record below.
+                    act = failpoint("serve.api.stream")
+                    if act is not None and act.kind == "drop":
+                        continue
                     chunk = {"model": model, "created_at": now_rfc3339(),
                              key: wrap(delta), "done": False}
                     yield (json.dumps(chunk) + "\n").encode()

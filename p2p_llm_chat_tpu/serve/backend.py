@@ -18,6 +18,18 @@ from dataclasses import dataclass, field
 from typing import Iterator, Optional, Protocol, runtime_checkable
 
 
+class OverloadError(RuntimeError):
+    """Admission queue at capacity: the request is shed at submit time
+    (fast-fail) instead of burning the queue deadline in line. The HTTP
+    front maps it to ``503`` with a ``Retry-After`` header — well-formed
+    backpressure a client can act on, in milliseconds rather than
+    ``queue_timeout_s``."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
 @dataclass
 class GenerateOptions:
     """Sampling options (subset of Ollama's ``options`` object)."""

@@ -24,6 +24,10 @@ Env surface (reference-style env-first config, utils/env.py):
 ``SERVE_MAX_SEQ``, ``SERVE_TP``, ``LLM_MODEL`` (served model tag),
 ``SERVE_KV`` (dense|paged), ``SERVE_PAGE_SIZE``, ``SERVE_PAGES``,
 ``SERVE_ADMIT_CHUNK``, ``SERVE_QUEUE_TIMEOUT`` (seconds, 0 disables),
+``SERVE_QUEUE_MAX`` (admission-queue depth bound for overload shedding:
+unset = 8 x SERVE_SLOTS, 0 = unbounded; at the bound, submits fast-fail
+with 503 + Retry-After instead of burning the queue deadline),
+``SERVE_LOOP_BUDGET_MS`` (scheduler-loop watchdog budget; 0 disables),
 ``SERVE_QUANT`` (int8 = weight-only quantization, models/quant.py),
 ``SERVE_SPEC`` (K>0 = speculative decoding with prompt-lookup drafts),
 ``SERVE_FUSE`` (fused multi-step decode: up to K decode steps per device
@@ -83,7 +87,8 @@ class TPUEngine:
                  prefix_texts: tuple[str, ...] = (SUGGEST_PREFIX,),
                  kv_quant: bool = False,
                  decode_fuse_max: int = 4,
-                 prefill_chunk: int = 256) -> None:
+                 prefill_chunk: int = 256,
+                 queue_max: Optional[int] = None) -> None:
         self.name = name or config.name
         self.config = config
         self.prefix_texts = tuple(prefix_texts) if prefix_cache else ()
@@ -100,7 +105,8 @@ class TPUEngine:
                                         prefix_cache=prefix_cache,
                                         kv_quant=kv_quant,
                                         decode_fuse_max=decode_fuse_max,
-                                        prefill_chunk=prefill_chunk)
+                                        prefill_chunk=prefill_chunk,
+                                        queue_max=queue_max)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
@@ -193,12 +199,23 @@ class TPUEngine:
                 log.exception("warmup failed")
 
         if background:
+            # Not-ready from THIS call, not from when the thread gets
+            # scheduled: a /readyz poll racing the spawn must never see
+            # a ready engine whose warmup is about to start.
+            self.scheduler.note_warmup_pending()
             threading.Thread(target=_run, daemon=True, name="warmup").start()
         else:
             _run()
 
     def models(self) -> list[str]:
         return [self.name]
+
+    def ready(self) -> bool:
+        """Readiness for /readyz: the scheduler loop is live and any
+        started warmup has completed (background warmup is the default
+        boot path — routing traffic mid-warmup lands compiles on real
+        requests' TTFT)."""
+        return self.scheduler.ready
 
     def metrics_snapshot(self) -> dict[str, float]:
         """Serving-plane gauges (batch occupancy, queue depth, KV pool)
@@ -236,6 +253,12 @@ def build_engine_from_env() -> Backend:
     # reference client's 60 s LLM timeout (web/streamlit_app.py:95).
     qt = float(env_or("SERVE_QUEUE_TIMEOUT", "60"))
     queue_timeout_s = qt if qt > 0 else None
+    # Overload shedding: admission-queue depth bound. Unset = auto
+    # (8 x SERVE_SLOTS — see scheduler.queue_max); 0 = unbounded legacy
+    # queue (requests at capacity burn the deadline instead of a fast
+    # 503 + Retry-After).
+    qm = env_int("SERVE_QUEUE_MAX", -1)
+    queue_max = None if qm < 0 else qm
     spec_k = env_int("SERVE_SPEC", 0)
     # Fused multi-step decode: up to this many decode steps per device
     # dispatch (adaptive — see scheduler.decode_fuse_max). 1 disables.
@@ -301,7 +324,8 @@ def build_engine_from_env() -> Backend:
                          prefix_texts=prefix_texts, name=name,
                          kv_quant=bool(kv_quant),
                          decode_fuse_max=decode_fuse_max,
-                         prefill_chunk=prefill_chunk)
+                         prefill_chunk=prefill_chunk,
+                         queue_max=queue_max)
 
     def warmup_buckets():
         warmup = env_or("SERVE_WARMUP", "128,256")

@@ -69,6 +69,17 @@ class MultiBackend:
                 out[f'{k}{{model="{esc}"}}'] = v
         return out
 
+    def ready(self) -> bool:
+        """/readyz gating: the front is ready only when EVERY engine is
+        (requests route by tag — a half-warmed fleet would serve some
+        tags with cold-compile TTFTs). Backends without a probe count
+        as ready."""
+        for b in self.backends.values():
+            fn = getattr(b, "ready", None)
+            if callable(fn) and not fn():
+                return False
+        return True
+
     def warmup(self, *args, **kwargs) -> None:
         for b in self.backends.values():
             fn = getattr(b, "warmup", None)

@@ -58,8 +58,11 @@ from ..models.layers import causal_mask
 from ..models.llama import KVCache
 from ..models.sampling import sample_batched, sample_step_batched
 from ..tokenizer import Tokenizer
+from ..utils.env import env_float
+from ..utils.failpoints import failpoint
 from ..utils.log import get_logger
-from .backend import GenerateRequest, RequestStats, normalize_request
+from .backend import (GenerateRequest, OverloadError, RequestStats,
+                      normalize_request)
 from .prefix import PrefixEntry, PrefixStore
 
 log = get_logger("serve.scheduler")
@@ -118,6 +121,12 @@ class _Slot:
     prefix: Optional[PrefixEntry] = None               # cached-prefix admission
     prefix_checked: bool = False                       # match() ran for this slot
     last_emit_t: float = 0.0                           # inter-token gap tracking
+    # Admission-queue depth accounting (overload shedding): on_depart
+    # fires exactly once, at the earlier of batch-row install or any
+    # terminal outcome — the depth gauge must count submitted-but-not-
+    # yet-admitted requests only, and warmup jobs share the same queue.
+    on_depart: Optional[object] = None
+    departed: bool = False
 
     def push(self, delta: str) -> None:
         if delta:
@@ -125,7 +134,14 @@ class _Slot:
 
     done: bool = False                                 # finish() has run
 
+    def depart(self) -> None:
+        if not self.departed:
+            self.departed = True
+            if self.on_depart is not None:
+                self.on_depart()
+
     def finish(self) -> None:
+        self.depart()
         self.done = True
         if self.stats is not None and self.stats.total_s is None:
             self.stats.total_s = time.monotonic() - self.req.arrival_time
@@ -169,6 +185,39 @@ class _PrefillCarry:
     tables: Optional["np.ndarray"]  # [R,mppr] page maps (paged mode)
 
 
+class _SlotStream:
+    """Iterator over a submitted request's deltas. submit() enqueues the
+    slot EAGERLY (the overload check must run at call time), so the
+    cancel path can no longer live only in the consuming generator's
+    ``finally`` — a generator closed or GC'd before its first next()
+    never runs its body, which would leave an orphaned queued request
+    decoding to completion for nobody. This wrapper cancels the slot on
+    close() and on GC even when iteration never started (idempotent:
+    cancelled.set() on a finished slot is a no-op)."""
+
+    __slots__ = ("_gen", "_slot")
+
+    def __init__(self, gen, slot) -> None:
+        self._gen = gen
+        self._slot = slot
+
+    def __iter__(self) -> "_SlotStream":
+        return self
+
+    def __next__(self) -> str:
+        return next(self._gen)
+
+    def close(self) -> None:
+        self._slot.cancelled.set()
+        self._gen.close()
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:   # noqa: BLE001 — interpreter-shutdown GC
+            pass
+
+
 class _WarmupJob:
     """A closure executed ON the scheduler thread (posted via the admit
     queue). Warmup dispatches the real programs against the live device
@@ -207,7 +256,9 @@ class BatchScheduler:
                  prefix_promote_after: int = 2,
                  kv_quant: bool = False,
                  decode_fuse_max: int = 4,
-                 prefill_chunk: int = 256) -> None:
+                 prefill_chunk: int = 256,
+                 queue_max: Optional[int] = None,
+                 loop_budget_ms: Optional[float] = None) -> None:
         """``admit_chunk``: burst-admission width. None (default) admits a
         backlog burst through one full-width prefill (minimal dispatches —
         best p95/throughput); a fixed power-of-two (e.g. 8) staggers the
@@ -220,6 +271,26 @@ class BatchScheduler:
         with an error instead of waiting forever (the reference's client
         gives up at 60 s — web/streamlit_app.py:95 — so holding its
         request longer only wastes pool space). None disables.
+
+        ``queue_max``: admission-queue depth bound (overload shedding).
+        A submit() arriving with this many requests already queued
+        (submitted, not yet in a batch row) fails IMMEDIATELY with
+        :class:`OverloadError` — the HTTP front maps it to ``503 +
+        Retry-After`` — instead of burning ``queue_timeout_s`` in line
+        only to expire. None (default) sizes to ``8 * num_slots`` (the
+        batch churning several times over is work the deadline can
+        plausibly still cover; deeper than that, the tail would expire
+        anyway and fast-failing is strictly kinder to clients). 0
+        disables (unbounded legacy queue). Shed requests count in
+        ``requests_shed_total``.
+
+        ``loop_budget_ms``: scheduler-loop watchdog budget. An
+        iteration of the serving loop that exceeds this wall budget
+        (a mid-serving compile, a wedged device call, a pathological
+        host stall) is logged once per stall episode and exported as
+        the ``loop_stall_ms`` max gauge — the liveness signal an
+        operator alerts on. None reads ``SERVE_LOOP_BUDGET_MS``
+        (default 5000); 0 disables.
 
         ``spec_k``: speculative decoding (prompt-lookup drafting,
         utils/draft.py): each tick verifies up to K drafted tokens per
@@ -303,6 +374,32 @@ class BatchScheduler:
             raise ValueError(f"admit_chunk must be >= 1, got {admit_chunk}")
         self.admit_chunk = admit_chunk
         self.queue_timeout_s = queue_timeout_s
+        # Overload shedding (see docstring): depth counts REQUESTS only
+        # (warmup jobs share _admit_q, and a background 8B warmup is
+        # hundreds of queued jobs — counting them would shed every
+        # request at boot). The counter moves on submit and on each
+        # slot's depart (install or terminal), from HTTP threads and
+        # the scheduler thread both, hence the lock.
+        if queue_max is not None and queue_max < 0:
+            raise ValueError(f"queue_max must be >= 0, got {queue_max}")
+        self.queue_max = (8 * num_slots if queue_max is None else queue_max)
+        self._depth_mu = threading.Lock()
+        self._queued_requests = 0     # guarded-by: _depth_mu
+        self._n_shed = 0              # guarded-by: _depth_mu
+        # Scheduler-loop watchdog (see docstring).
+        self.loop_budget_ms = (env_float("SERVE_LOOP_BUDGET_MS", 5000.0)
+                               if loop_budget_ms is None else loop_budget_ms)
+        self._loop_stall_ms = 0.0     # owned-by: _loop
+        self._loop_stalled = False    # owned-by: _loop
+        # Heartbeat: start time of the CURRENT loop iteration (written
+        # by _loop each pass, read by metrics_snapshot) — lets the gauge
+        # expose an in-flight stall a wedged iteration would otherwise
+        # only report after it ends (i.e. never, for a hung device
+        # call). Torn reads of a float are harmless for a gauge.
+        self._loop_beat: Optional[float] = None
+        # Readiness (/readyz): warmup gating — see the ``ready`` property.
+        self._warmup_started = False
+        self._warmup_done_at: Optional[float] = 0.0
         self.spec_k = spec_k
         self.config = config
         self.tokenizer = tokenizer
@@ -1169,6 +1266,11 @@ class BatchScheduler:
         job completes and re-raises the first error, from any thread."""
         if self._closed.is_set():
             raise RuntimeError("scheduler is stopped")
+        # /readyz gating: once a warmup has STARTED, the scheduler
+        # reports not-ready until it completes (uncompiled programs mean
+        # tens-of-seconds TTFT on TPU — a load balancer must not route
+        # here yet). A scheduler that never warms is ready immediately.
+        self.note_warmup_pending()
         if chunk_sizes is None:
             if self.admit_chunk:
                 # A fixed admit width is the ONLY program admission uses.
@@ -1350,6 +1452,9 @@ class BatchScheduler:
             if head is None or self._closed.is_set():
                 return
             try:
+                # Failpoint: a failed promotion build is dropped (it is
+                # an optimization) — serving must be untouched.
+                failpoint("serve.scheduler.promote")
                 k, v = self._build_prefix_kv(head)
                 self._promote_done.put((head, k, v))
             except Exception:   # noqa: BLE001 — promotion is optional
@@ -1592,22 +1697,76 @@ class BatchScheduler:
 
     # -- client side (HTTP threads) ------------------------------------------
 
+    def note_warmup_pending(self) -> None:
+        """Flip /readyz to not-ready NOW, atomically (both flags before
+        any other warmup work — a readiness poll landing between 'started'
+        and 'done nulled' must never read ready). Called at warmup()'s
+        entry, and by callers that DEFER the warmup to a background
+        thread (serve/engine.py) so the thread-spawn gap is covered
+        too."""
+        self._warmup_done_at = None
+        self._warmup_started = True
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (distinct from liveness): the loop thread is up AND
+        any started warmup has completed — /readyz gates on this, so a
+        load balancer never routes traffic at a scheduler whose first
+        compiles would land on real requests' TTFT. A scheduler that
+        never warms is ready as soon as its thread runs."""
+        if self._closed.is_set() or not self._thread.is_alive():
+            return False
+        return not self._warmup_started or self._warmup_done_at is not None
+
+    def _queue_depth(self) -> int:
+        with self._depth_mu:
+            return self._queued_requests
+
     def submit(self, req: GenerateRequest,
                stats: Optional[RequestStats] = None) -> Iterator[str]:
         """Enqueue a request; yield text deltas until completion. Closing
-        the iterator early (client gone) cancels the request."""
+        the iterator early (client gone) cancels the request.
+
+        Runs the overload check EAGERLY (this is a plain function
+        returning a generator, not itself a generator): at queue_max
+        pending requests the caller gets :class:`OverloadError` in
+        microseconds — well-formed backpressure — instead of a slot that
+        waits out the queue deadline. Admission/enqueue also happens
+        here, so arrival order is the submit() call order."""
         if self._closed.is_set():
             raise RuntimeError("scheduler is stopped")
+        if self.queue_max:
+            with self._depth_mu:
+                if self._queued_requests >= self.queue_max:
+                    self._n_shed += 1
+                    shed = True
+                else:
+                    self._queued_requests += 1
+                    shed = False
+            if shed:
+                raise OverloadError(
+                    f"server at capacity: {self.queue_max} requests "
+                    "already queued; retry later")
+            on_depart = self._note_depart
+        else:
+            on_depart = None
         opts = req.options
         seed = opts.seed if opts.seed is not None else time.monotonic_ns()
         slot = _Slot(req=req, stats=stats, out_q=queue.Queue(),
-                     seed=int(seed) % (2 ** 31))
+                     seed=int(seed) % (2 ** 31), on_depart=on_depart)
         self._admit_q.put(slot)
         if self._closed.is_set():
             # stop() may have drained the queue between our closed-check and
             # the put; finish defensively so the consumer can never hang (a
             # duplicate None from stop()'s own drain is harmless).
             slot.finish()
+        return _SlotStream(self._consume(slot), slot)
+
+    def _note_depart(self) -> None:
+        with self._depth_mu:
+            self._queued_requests -= 1
+
+    def _consume(self, slot: _Slot) -> Iterator[str]:
         try:
             while True:
                 delta = slot.out_q.get()
@@ -1692,6 +1851,8 @@ class BatchScheduler:
         ids — and flush the pipeline first."""
         pending: Optional[tuple] = None   # (toks_dev, snapshot, K)
         while not self._closed.is_set():
+            it_start = time.monotonic()
+            self._loop_beat = it_start
             try:
                 self._drain_stall_reset()
                 # Admission inside the same recovery envelope as decode: an
@@ -1763,6 +1924,49 @@ class BatchScheduler:
                 log.exception("decode tick failed; failing in-flight requests")
                 pending = None
                 self._fail_all_and_reset()
+            finally:
+                self._watchdog(it_start)
+
+    # graftcheck: runs-on _loop
+    def _watchdog(self, it_start: float) -> None:
+        """Loop-iteration watchdog: an iteration past the budget (a
+        mid-serving compile, a wedged device call, a host stall) updates
+        the ``loop_stall_ms`` max gauge and logs ONCE per stall episode
+        — enter and recover each log one line, never one per iteration
+        (a minutes-long warmup would otherwise spam hundreds). Blocked-
+        idle iterations cap at the admission poll timeout (~0.2 s), so
+        idleness never reads as a stall."""
+        budget = self.loop_budget_ms
+        if not budget:
+            return
+        dur_ms = (time.monotonic() - it_start) * 1e3
+        if dur_ms > budget:
+            if dur_ms > self._loop_stall_ms:
+                self._loop_stall_ms = dur_ms
+            if not self._loop_stalled:
+                self._loop_stalled = True
+                log.warning("scheduler loop iteration took %.0f ms "
+                            "(budget %.0f ms)", dur_ms, budget)
+        elif self._loop_stalled:
+            self._loop_stalled = False
+            log.info("scheduler loop recovered (last iteration %.0f ms)",
+                     dur_ms)
+
+    # graftcheck: lock-ok advisory gauge — torn reads of the loop-owned float are harmless for /metrics
+    def _live_loop_stall_ms(self) -> float:
+        """Completed-iteration max (``_loop_stall_ms``) folded with the
+        in-flight iteration's age when over budget — readable from any
+        thread, so a permanently wedged iteration is visible on /metrics
+        WHILE it is wedged."""
+        stall = self._loop_stall_ms
+        beat, budget = self._loop_beat, self.loop_budget_ms
+        # A cleanly stopped scheduler's stale beat is not a stall; a
+        # DEAD loop thread on a live scheduler very much is.
+        if beat is not None and budget and not self._closed.is_set():
+            cur = (time.monotonic() - beat) * 1e3
+            if cur > budget:
+                stall = max(stall, cur)
+        return stall
 
     def _any_active(self) -> bool:
         return any(s is not None for s in self._slots)
@@ -1799,6 +2003,7 @@ class BatchScheduler:
                     slot.finish()
                 break
             if slot.cancelled.is_set():
+                slot.depart()        # consumer gone before admission
                 continue
             if self._expired(slot):
                 continue
@@ -1920,6 +2125,17 @@ class BatchScheduler:
             "serve_admitted_total": self._n_admitted,
             "serve_decode_ticks_total": self._n_decode_ticks,
             "serve_queue_expired_total": self._n_expired,
+            # Overload shedding (queue_max): requests fast-failed with
+            # OverloadError/503 at submit instead of burning the queue
+            # deadline. 0 on a healthy deployment; a nonzero RATE is the
+            # capacity alarm.
+            "requests_shed_total": self._n_shed,
+            # Loop watchdog (loop_budget_ms): max over-budget iteration
+            # wall observed — including the CURRENT iteration if it is
+            # already past budget (a hung device call must show up in
+            # the gauge while it hangs, not after it ends). 0 = never
+            # stalled.
+            "loop_stall_ms": round(self._live_loop_stall_ms(), 3),
             # Fused multi-step decode (decode_fuse_max): dispatches that
             # fused K>1 steps, total fused steps, and the realized mean K
             # over every decode dispatch — the lever that closes the
@@ -2040,6 +2256,7 @@ class BatchScheduler:
         pending: list[_Slot] = []
         for s in self._admit_carry:           # prepared last round
             if s.cancelled.is_set() or s.done or self._expired(s):
+                s.depart()                    # no longer queued, any path
                 if s.pages:                   # never installed in a table
                     self._alloc.free(s.pages)
                     s.pages = None
@@ -2050,6 +2267,7 @@ class BatchScheduler:
             still: list[_Slot] = []
             for s in self._waiting:
                 if s.cancelled.is_set():
+                    s.depart()
                     continue
                 if self._expired(s):
                     continue
@@ -2205,6 +2423,12 @@ class BatchScheduler:
         An EMPTY chunk is the warmup path: all R entries are padding, so
         the dispatch compiles-and-runs the exact serving program as a
         device no-op (``warm_prefix`` selects the prefix variant)."""
+        # Failpoint: an injected admission fault must fail THIS chunk's
+        # requests cleanly (the _admit_pending recovery envelope) and
+        # leave the loop serving — the contract tests/test_failpoints.py
+        # drives. (Warmup jobs route through here too; arming during
+        # warmup fails that warmup job, surfaced by warmup()'s re-raise.)
+        failpoint("serve.scheduler.admit")
         prefix = chunk[0].prefix if chunk else warm_prefix
         P = prefix.length if prefix is not None else 0
         pad = R - len(chunk)
@@ -2319,6 +2543,7 @@ class BatchScheduler:
         now = time.monotonic()
         self._n_admitted += len(chunk)
         for i, (slot, row) in enumerate(zip(chunk, rows)):
+            slot.depart()                # reached a batch row: not queued
             if slot.stats is not None:
                 slot.stats.ttft_s = now - slot.req.arrival_time
             slot.ctx_len = len(slot.prompt_ids)
@@ -2358,6 +2583,7 @@ class BatchScheduler:
         prompt's admission stalls live decodes by one chunk's compute,
         never the whole prompt's prefill; the final chunk samples the
         first tokens and installs the rows (TTFT lands there)."""
+        failpoint("serve.scheduler.admit")   # chunked-admission leg of the site
         pc = self._prefill_carry
         C = pc.C    # the carry's own width — see _PrefillCarry.C
         P0 = pc.prefix.length if pc.prefix is not None else 0
@@ -2452,6 +2678,10 @@ class BatchScheduler:
         Returns (toks_dev [B] or [K,B], snapshot of the rows it decoded
         for, K); _process_tick consumes it, one tick later under
         pipelining."""
+        # Failpoint: an injected dispatch fault rides the loop's recovery
+        # envelope (_fail_all_and_reset) — in-flight requests fail with a
+        # well-formed error, the next request serves oracle-exact.
+        failpoint("serve.scheduler.dispatch")
         K = self._choose_fuse_k(inflight) if allow_fuse else 1
         self._n_decode_ticks += 1
         self._n_decode_steps += K
@@ -2504,6 +2734,10 @@ class BatchScheduler:
         in-flight tokens are discarded, and the writes they made sit
         beyond the trusted length by the overwrite-before-trust
         invariant."""
+        # Failpoint: the engine's token readback (device -> host). A
+        # fault here (a dead tunnel, a device reset) hits the same loop
+        # recovery envelope as a dispatch fault.
+        failpoint("serve.engine.readback")
         # graftcheck: sync-ok intentional: [B] or [K,B] int32, the tick's readback
         toks = np.asarray(toks_dev)
         if toks.ndim == 1:

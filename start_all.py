@@ -126,10 +126,15 @@ def main() -> int:
         wait_http(f"{dir_url}/healthz")
         # Big-model TPU boots (8B checkpoint restore + streamed int8
         # quantize + warmup compile) legitimately take many minutes;
-        # SERVE_WAIT_S widens the readiness budget.
+        # SERVE_WAIT_S widens the readiness budget. /readyz (not
+        # /healthz): the engine warms up in the BACKGROUND, so liveness
+        # arrives minutes before the compiled programs do — launching
+        # the UIs at /healthz put the first suggestions' TTFT behind
+        # warmup compiles. wait_http treats /readyz's 503-warming as
+        # not-ready (urlopen raises on it) and keeps polling.
         serve_wait = env_float(
             "SERVE_WAIT_S", 300.0 if args.backend != "fake" else 30.0)
-        wait_http(f"{serve_url}/healthz", timeout=serve_wait)
+        wait_http(f"{serve_url}/readyz", timeout=serve_wait)
 
         dht_seed = ""
         for i, user in enumerate(users):

@@ -1,7 +1,7 @@
 """Env-flag hygiene analyzer.
 
-Every ``SERVE_*``/``BENCH_*``/``PAGED_*`` (config.env_prefixes)
-environment read must:
+Every ``SERVE_*``/``BENCH_*``/``PAGED_*``/``FAIL_*``
+(config.env_prefixes) environment read must:
 
 - go through the typed helpers in ``utils/env.py`` (``env_or``,
   ``env_int``, ``env_float``, ``env_bool``, plus ``env_opt`` for the
