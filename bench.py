@@ -64,9 +64,23 @@ Env knobs (all optional):
 - ``BENCH_WORKLOAD``    quote = synthetic checkpoint whose greedy output
                         repeats a 16-token phrase (the quote-the-context
                         statistic of real co-pilot replies; full model
-                        compute) — THE workload where BENCH_SPEC wins:
-                        measured +51% served tok/s at K=4 greedy with
-                        3,128/4,096 tokens from accepted drafts
+                        compute) — THE workload where prompt-lookup
+                        BENCH_SPEC wins: measured +51% served tok/s at
+                        K=4 greedy with 3,128/4,096 tokens from
+                        accepted drafts
+- ``BENCH_SPEC_WORKLOAD`` freeform = the NON-quote speculation phase:
+                        synthetic weights whose greedy output follows
+                        one pseudo-random 95-token cycle (n-gram drafts
+                        score ~0 — the free-form statistic), served with
+                        the resident draft model (BENCH_DRAFT) on vs
+                        speculation off, per-source acceptance in the
+                        JSON ``spec_freeform`` row. Defaults BENCH_SPEC
+                        to 4 when unset
+- ``BENCH_DRAFT``       draft-model config resident beside the target
+                        (default draft-400m for the freeform phase;
+                        vocab clones to the target's). With
+                        BENCH_SPEC > 0 it also drafts for the main
+                        phases' workload
 - ``BENCH_PREFIX``      shared-prefix KV cache (default 1; 0 disables)
 - ``BENCH_TEMP``        request temperature (default 0.7; 0 = greedy —
                         the workload where prompt-lookup spec drafts
@@ -156,9 +170,27 @@ def main() -> None:
     dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
     quant = env_opt("BENCH_QUANT", "int8")   # "" | int8; BENCH_QUANT= = bf16
     workload = env_or("BENCH_WORKLOAD", "")
+    # Free-form draft-model spec phase (BENCH_SPEC_WORKLOAD=freeform):
+    # the synthetic lm_head follows ONE pseudo-random 95-token cycle
+    # instead of the quote workload's 16-token repeats, so n-gram drafts
+    # score ~0 and only the resident draft model (BENCH_DRAFT, sharing
+    # the successor map) can make speculation win — the two statistics
+    # stop being conflated in one "spec" number.
+    spec_workload = env_or("BENCH_SPEC_WORKLOAD", "")
+    if spec_workload not in ("", "freeform"):
+        raise SystemExit(f"BENCH_SPEC_WORKLOAD must be freeform or "
+                         f"empty, got {spec_workload!r}")
+    if spec_workload == "freeform" and workload == "quote":
+        # One set of weights serves the whole run; building the target
+        # with the freeform cycle while labeling the main phases "quote"
+        # would be exactly the conflation this phase exists to remove.
+        raise SystemExit("BENCH_WORKLOAD=quote and BENCH_SPEC_WORKLOAD="
+                         "freeform are mutually exclusive (one synthetic "
+                         "lm_head per run); pick one statistic")
+    synth_mode = "freeform" if spec_workload == "freeform" else "quote"
     stream_int8 = (quant == "int8"
                    and hasattr(family, "init_params_quantized"))
-    if workload == "quote":
+    if workload == "quote" or spec_workload == "freeform":
         # Speculation / streaming workload (models/synth.py): random
         # transformer layers (full compute) + an embed/lm_head whose
         # greedy output repeats a printable 16-token phrase — the
@@ -168,7 +200,7 @@ def main() -> None:
         # true verify-tick cost vs accepted-draft win end-to-end.
         from p2p_llm_chat_tpu.models.synth import quote_params
         params = quote_params(config, jax.random.PRNGKey(0), dtype=dtype,
-                              quantized=stream_int8)
+                              quantized=stream_int8, mode=synth_mode)
         if quant == "int8" and not stream_int8:
             from p2p_llm_chat_tpu.models.quant import quantize_params
             params = quantize_params(params)
@@ -479,6 +511,43 @@ def main() -> None:
     # -- end-to-end serving: p50 TTFT at `slots` concurrent peers ------------
     admit_chunk = env_int("BENCH_ADMIT_CHUNK", 0) or None
     spec_k = env_int("BENCH_SPEC", 0)
+    if spec_workload == "freeform" and not spec_k:
+        spec_k = 4          # the phase exists to measure draft-model spec
+    # Resident draft model (BENCH_DRAFT: config name; default draft-400m
+    # for the freeform phase). Random/synthetic weights carry no
+    # vocabulary semantics, so the config clones at the target's vocab;
+    # synthetic modes build the drafter with the SAME successor map as
+    # the target (models/synth.py) — the stand-in for a small model
+    # predicting the big model's easy tokens.
+    draft_name = env_or("BENCH_DRAFT",
+                        "draft-400m" if spec_workload == "freeform" else "")
+    drafter = None
+    if draft_name and spec_k:
+        from p2p_llm_chat_tpu.serve.draft_model import ModelDrafter
+        dcfg = get_config(draft_name)
+        if dcfg.vocab_size != config.vocab_size:
+            dcfg = dcfg.with_(vocab_size=config.vocab_size)
+        dfam = family_for(dcfg)
+        d_int8 = quant == "int8" and hasattr(dfam, "init_params_quantized")
+        if workload == "quote" or spec_workload == "freeform":
+            from p2p_llm_chat_tpu.models.synth import quote_params as _qp
+            dparams = _qp(dcfg, jax.random.PRNGKey(1), dtype=dtype,
+                          quantized=d_int8, mode=synth_mode)
+        elif d_int8:
+            dparams = dfam.init_params_quantized(dcfg,
+                                                 jax.random.PRNGKey(1),
+                                                 dtype=dtype)
+        else:
+            dparams = dfam.init_params(dcfg, jax.random.PRNGKey(1),
+                                       dtype=dtype)
+            if quant == "int8":
+                from p2p_llm_chat_tpu.models.quant import quantize_params
+                dparams = quantize_params(dparams)
+        drafter = ModelDrafter(dparams, dcfg, num_slots=slots,
+                               max_seq=max_seq, k=spec_k)
+        log(f"draft model: {draft_name} resident "
+            f"({drafter.param_bytes()/1e9:.2f} GB params, "
+            f"{drafter.kv_bytes()/1e9:.2f} GB KV), k={spec_k}")
     use_prefix = env_bool("BENCH_PREFIX", True)
     # Chunked prefill (serve/scheduler.py prefill_chunk) + the mixed-load
     # phase that measures the admission stall it bounds.
@@ -513,6 +582,10 @@ def main() -> None:
         if mixed:
             shapes.append(len(prompt) + 1 + mixed_new + spec_k + 2)
             shapes.append(arr_ctx + 32 + new_tokens + spec_k + 2)
+        if spec_workload == "freeform" and drafter is not None:
+            # The freeform A/B phase decodes longer completions.
+            shapes.append(len(prompt) + 1 + max(64, 2 * new_tokens)
+                          + spec_k + 2)
         per_req = max(-(-s // page_size) + 1 for s in shapes)
         per_req = min(per_req, -(-eff_max // page_size))
         serve_pages = slots * per_req + 1
@@ -522,7 +595,7 @@ def main() -> None:
                            admit_chunk=admit_chunk,
                            spec_k=spec_k, prefix_cache=use_prefix,
                            kv_quant=kv_quant, decode_fuse_max=fuse_k,
-                           prefill_chunk=bench_chunk)
+                           prefill_chunk=bench_chunk, drafter=drafter)
     # BENCH_TEMP=0 (greedy) is the honest speculative-decoding workload:
     # prompt-lookup drafts only land when the model's continuation repeats
     # earlier n-grams, which greedy decoding does and temperature-0.7
@@ -566,6 +639,13 @@ def main() -> None:
         # and masquerade as a multi-second admission stall.
         deepest_ctx = max(deepest_ctx, plen + mixed_new,
                           min(arr_ctx + 1, eff_max - 2) + new_tokens)
+    # Freeform spec A/B phase decodes longer completions (speculation's
+    # win is per decoded token; short completions would be TTFT-bound).
+    spec_new = (max(64, 2 * new_tokens)
+                if spec_workload == "freeform" and drafter is not None
+                else 0)
+    if spec_new:
+        deepest_ctx = max(deepest_ctx, plen + spec_new)
     need = min(deepest_ctx + spec_k + 2 * fuse_k + 2, eff_max)
     ws, w = [], 128
     while True:
@@ -716,6 +796,73 @@ def main() -> None:
         chunk_saved, sched.prefill_chunk = sched.prefill_chunk, 0
         mixed_stats["single_shot"] = mixed_phase("single-shot")
         sched.prefill_chunk = chunk_saved
+
+    # -- freeform draft-model spec phase (BENCH_SPEC_WORKLOAD=freeform):
+    # served tok/s + per-source acceptance on NON-quote output — the
+    # workload where n-gram drafting measures ~0 — with the resident
+    # drafter on vs speculation off, over the same warmed scheduler.
+    # Greedy requests: acceptance there is argmax-match, the honest
+    # draft-quality number (sampled acceptance rides the same math but
+    # adds sampling noise to the tok/s comparison).
+    spec_freeform: dict = {}
+    if spec_new:
+        def _src(snap: dict, key: str, src: str) -> float:
+            return snap.get(f'{key}{{source="{src}"}}', 0)
+
+        def spec_phase(label: str, stats_keys: bool) -> dict:
+            snap0 = sched.metrics_snapshot()
+            gopts = GenerateOptions(max_tokens=spec_new, temperature=0.0,
+                                    seed=0)
+            stats = [RequestStats() for _ in range(slots)]
+
+            def run_g(s: RequestStats) -> None:
+                for _ in sched.submit(
+                        GenerateRequest(prompt=prompt, options=gopts), s):
+                    pass
+
+            ths = [threading.Thread(target=run_g, args=(s,))
+                   for s in stats]
+            t0p = time.monotonic()
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            wallp = time.monotonic() - t0p
+            toks = sum(s.completion_tokens for s in stats)
+            out = {"served_tok_s": round(toks / wallp, 1),
+                   "tokens": toks, "wall_s": round(wallp, 2)}
+            if stats_keys:
+                snap1 = sched.metrics_snapshot()
+                for src in ("ngram", "model"):
+                    p = (_src(snap1, "serve_spec_proposed_total", src)
+                         - _src(snap0, "serve_spec_proposed_total", src))
+                    a = (_src(snap1, "serve_spec_accepted_total", src)
+                         - _src(snap0, "serve_spec_accepted_total", src))
+                    out[f"proposed_{src}"] = p
+                    out[f"accepted_{src}"] = a
+                    out[f"accept_rate_{src}"] = (round(a / p, 3)
+                                                 if p else None)
+            log(f"freeform spec ({label}): {out['served_tok_s']:,.1f} "
+                f"tok/s" + (f", model {out['accepted_model']}/"
+                            f"{out['proposed_model']} accepted, ngram "
+                            f"{out['accepted_ngram']}/"
+                            f"{out['proposed_ngram']}"
+                            if stats_keys else ""))
+            return out
+
+        on = spec_phase("draft on", stats_keys=True)
+        spec_saved, sched.spec_k = sched.spec_k, 0
+        off = spec_phase("spec off", stats_keys=False)
+        sched.spec_k = spec_saved
+        spec_freeform = {
+            "draft_config": draft_name, "spec_k": spec_k,
+            "new_tokens": spec_new,
+            "draft_on": on, "spec_off": off,
+            "speedup": (round(on["served_tok_s"] / off["served_tok_s"], 3)
+                        if off["served_tok_s"] else None),
+        }
+        log(f"freeform spec: draft-model speedup "
+            f"{spec_freeform['speedup']}x over non-speculative")
     # Overload/robustness gauges for the JSON row: shed counts make
     # overload runs visible in BENCH_*.json (0 on a healthy run — the
     # bench's own load must never shed under the default queue bound),
@@ -770,6 +917,14 @@ def main() -> None:
             # not the whole prompt's prefill).
             "prefill_chunk": sched.prefill_chunk or None,
             "mixed_load": mixed_stats or None,
+            # Draft-model speculative decoding (BENCH_DRAFT /
+            # BENCH_SPEC_WORKLOAD=freeform): served tok/s with the
+            # resident drafter vs non-speculative on free-form (non-
+            # quote) output, plus per-source proposed/accepted — the
+            # row the round-9 acceptance bar reads.
+            "draft_config": (draft_name or None) if spec_k else None,
+            "spec_workload": spec_workload or None,
+            "spec_freeform": spec_freeform or None,
             # Overload shedding + loop watchdog (ISSUE 5): shed requests
             # (503 fast-fail at the queue bound) and the max over-budget
             # scheduler-loop iteration. Both 0 on a healthy run.

@@ -116,8 +116,18 @@ else
   JAX_PLATFORMS=cpu python -m pytest tests/test_failpoints.py -q -x \
     -m 'not slow' || rc=1
 
+  # Draft-model speculative decoding: the tier-1 legs (hybrid source
+  # routing, drafter-KV rollback, greedy bit-identity draft-on vs off,
+  # cold-start throttle) pinned on CPU; the slow-marked spec x chunked-
+  # prefill x fused-K matrix runs in full mode. Excluded from the sweep
+  # below so each case executes exactly once.
+  echo "== draft-model speculation: exactness + rollback (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_spec_draft.py -q -x \
+    -m 'not slow' || rc=1
+
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
+    --ignore=tests/test_spec_draft.py \
     --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_chunked_prefill.py \
     --ignore=tests/test_flash_append_geometry.py \

@@ -154,6 +154,24 @@ _register(ModelConfig(
     bos_token_id=1, eos_token_ids=(2,),
 ))
 
+# ~0.4B-param draft model for draft-target speculative decoding: resident
+# alongside a big target on the SAME chip (llama3.1-8b int8 ~8.6 GB +
+# this config int8 ~0.45 GB + both KV pools fit one 16 GB v5e), it
+# proposes K greedy tokens per spec tick that the target verifies in one
+# forward (serve/draft_model.py). vocab matches llama3.1-8b — a drafter
+# MUST share its target's vocabulary (draft ids feed the target's verify
+# forward directly); pair it with a different-vocab target by cloning
+# the config at the target's vocab (`get_config("draft-400m").with_(
+# vocab_size=target.vocab_size)` — bench.py's freeform spec phase does
+# this for bench-1b). Embeddings are untied so the synthetic quote/
+# freeform workloads (models/synth.py) can install their successor-map
+# lm_head for CPU tests and benches without real checkpoints.
+_register(ModelConfig(
+    name="draft-400m", vocab_size=128256, hidden_size=1024,
+    intermediate_size=4096, num_layers=16, num_heads=8, num_kv_heads=4,
+    head_dim=128, max_seq_len=16384, rope_theta=500000.0,
+))
+
 # ~1.2B-param MoE config (8 experts, top-2) for single-chip MoE benching:
 # measures the scatter/gather expert-dispatch cost of models/mixtral.py on
 # real hardware (BASELINE.json config 5's family; ep=1 on one chip).

@@ -38,15 +38,35 @@ _ASCII_LO, _ASCII_HI = 32, 127
 _CYCLE = 16
 
 
-def successor_map(vocab: int) -> np.ndarray:
-    """succ[t] for every token id: printable-ASCII ids cycle in blocks of
-    ``_CYCLE`` within the printable range; every other id funnels into
-    the printable range so one step after any stray token the stream is
-    printable forever."""
+def successor_map(vocab: int, mode: str = "quote") -> np.ndarray:
+    """succ[t] for every token id: printable-ASCII ids cycle within the
+    printable range; every other id funnels into the printable range so
+    one step after any stray token the stream is printable forever.
+
+    ``mode`` selects the cycle statistics of the greedy output:
+
+    - ``"quote"`` (default): blocks of ``_CYCLE`` consecutive ids — the
+      output repeats a 16-token phrase, so trailing n-grams recur fast
+      and prompt-lookup drafts land (the quote-the-context statistic).
+    - ``"freeform"``: ONE pseudo-random cycle over the whole printable
+      range (a seeded permutation, not the +1 ordering — consecutive-
+      byte bigrams occur in natural prompt text and would hand the
+      n-gram index spurious hits). Trailing bigrams recur only after a
+      full 95-token lap, so prompt-lookup drafting scores ~0 on any
+      normal-length completion — the free-form statistic where only a
+      DRAFT MODEL sharing the map (serve/draft_model.py) can win.
+    """
     ids = np.arange(_ASCII_LO, _ASCII_HI)
     succ = np.empty(vocab, np.int64)
     # stray ids -> deterministic printable entry points
     succ[:] = _ASCII_LO + (np.arange(vocab) % len(ids))
+    if mode == "freeform":
+        order = np.random.default_rng(11).permutation(ids)
+        succ[order] = np.roll(order, -1)     # one 95-token cycle
+        return succ
+    if mode != "quote":
+        raise ValueError(f"successor_map mode must be quote|freeform, "
+                         f"got {mode!r}")
     for start in range(0, len(ids), _CYCLE):
         block = ids[start: start + _CYCLE]
         succ[block] = np.roll(block, -1)
@@ -54,12 +74,24 @@ def successor_map(vocab: int) -> np.ndarray:
 
 
 def quote_params(config: ModelConfig, key: jax.Array,
-                 dtype=jnp.bfloat16, quantized: bool = False) -> dict:
+                 dtype=jnp.bfloat16, quantized: bool = False,
+                 mode: str = "quote") -> dict:
     """Full-size tree (random transformer layers of the config's FAMILY —
     llama or mixtral — full compute) with the quote-workload
     embed/lm_head. ``quantized=True`` returns int8 matmul leaves (the
     llama family streams straight to fused int8; other families quantize
-    after init). Requires an untied lm_head."""
+    after init). Requires an untied lm_head.
+
+    ``mode="freeform"`` swaps the 16-token repeat cycles for one
+    pseudo-random 95-token cycle (see :func:`successor_map`): greedy
+    output stops repeating n-grams, so prompt-lookup drafting measures
+    ~0 acceptances — the free-form workload of the draft-model spec
+    bench. The successor map depends only on (vocab, mode), so a TARGET
+    and a smaller DRAFTER config built with the same (vocab, mode)
+    follow the same cycle and the drafter's greedy proposals match the
+    target's continuation — the synthetic stand-in for "a small model
+    predicts the big model's easy tokens" that lets CPU tests and the
+    no-checkpoint bench measure draft-model speculation end to end."""
     from . import family_for
     from .quant import quantize_params
 
@@ -98,7 +130,7 @@ def quote_params(config: ModelConfig, key: jax.Array,
     V, H = config.vocab_size, config.hidden_size
     emb = np.asarray(jax.random.normal(jax.random.PRNGKey(7), (V, H),
                                        jnp.float32))
-    succ = successor_map(V)
+    succ = successor_map(V, mode=mode)
     # lm_head[:, j] = 4 * sum_{succ(t)=j} w_t * emb[t]: logits_j(t)
     # contains 4*w_t*|emb[t]|^2 ~ 4H exactly when j = succ(t). Printable
     # tokens get w=1 (a pure in-range permutation); the ~V/95 stray

@@ -1,0 +1,381 @@
+"""Resident draft model for speculative decoding (the "model" source).
+
+Prompt-lookup n-grams (utils/draft.py) draft for free but measure ~0
+acceptances on free-form output — the headline spec wins existed only on
+the quote-heavy statistic. This module runs a SECOND, small model
+resident on the same chip as the serving target (classic draft-target
+speculative sampling — Leviathan et al. 2023; Chen et al. 2023): each
+spec tick it autoregressively proposes K greedy tokens per batch row,
+which the target then verifies in one forward through the existing
+``models/llama.verify_step[_paged]`` + ``sampling.spec_verify_batched``
+exact-acceptance math. Greedy proposals are a point-mass draft
+distribution, so the acceptance rule stays distribution-exact (greedy
+serving output is BIT-identical with the drafter on or off — pinned by
+tests/test_spec_draft.py).
+
+Device design, all reused from the existing model stack at small scale:
+
+- **Dense KV cache** ``[L_d, B, max_seq, Hkv_d, D_d]`` mirroring the
+  target's batch rows. Dense, not paged, on purpose: the drafter's dims
+  are half the target's on both KV-scaling axes (draft-400m bf16:
+  32 KB/token/row vs the 8B target's 64 KB int8), the whole cache is a
+  fixed ~1 GB allocation at the 32×1024 bench geometry that the engine
+  logs at build, and dense keeps the drafter's programs on the
+  oracle-simple path (no allocator coupled to the target's pool).
+- **Catch-up = verify_step.** Tokens the target accepted since the
+  drafter last ran (the correction token; anything emitted while the
+  model source was throttled) are fed in ONE multi-position forward —
+  the same continuation shape the target's verify uses — and the last
+  pending position's logits yield the first draft.
+- **Drafting = decode_fused.** The remaining K-1 proposals run as the
+  existing fused-decode ``lax.scan`` with an argmax sample_fn — one
+  dispatch for the whole draft, the same machinery the serving decode
+  ticks use.
+- **Rollback is free.** The drafter cache obeys the same
+  overwrite-before-trust invariant as the target: rejected drafts' KV
+  is stale-beyond-length, and every dispatch OVERRIDES the device
+  lengths from the host-tracked valid prefix (``_fed``), so rewinding
+  the draft cache to the last accepted position is pure host
+  bookkeeping (``observe``).
+
+Host bookkeeping per row: ``_fed[row]`` = number of leading context
+tokens whose KV in the drafter cache is valid. Advancing rules:
+
+- admission prefill / catch-up feeds advance by the tokens fed (they
+  are accepted context — trusted immediately);
+- a draft dispatch writes KV for draft inputs d1..d_{K-1}; after the
+  target accepts ``a`` of them, ``observe`` advances by ``min(a, K-1)``
+  (accepted drafts became context; d_K's KV was never written — it was
+  proposed, not fed).
+
+Threading: every method runs on the scheduler thread (_loop) — the
+drafter's mutable state rides the scheduler's single-writer discipline,
+like the slot table it is keyed by. The scheduler's recovery envelope
+calls :meth:`reset` whenever its own device state resets (a failed
+donated call may have consumed the drafter cache too).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import family_for
+from ..models.configs import ModelConfig
+from ..models.llama import KVCache
+from ..utils.draft import DraftSource
+from ..utils.log import get_logger
+
+log = get_logger("serve.draft_model")
+
+# Catch-up feed bucket ladder: pending suffixes bucket to the smallest
+# power of two >= len (floor _MIN_FEED); anything longer than _MAX_FEED
+# feeds in _MAX_FEED-wide chunks first (bounds the compiled-shape set —
+# a whole long prompt otherwise compiles one program per prompt bucket).
+_MIN_FEED = 4
+_MAX_FEED = 512
+
+
+def _pow2(n: int, lo: int, hi: int) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return min(b, hi)
+
+
+def _ctx_len(ctx: tuple) -> int:
+    prompt, ids = ctx
+    return len(prompt) + len(ids)
+
+
+def _ctx_suffix(ctx: tuple, start: int) -> list:
+    """Context tokens past ``start`` without concatenating the whole
+    (prompt, generated) pair: after the admission prefill the fed
+    prefix always covers the prompt, so the steady-state slice touches
+    only the generated tail (O(pending), not O(context))."""
+    prompt, ids = ctx
+    if start >= len(prompt):
+        return ids[start - len(prompt):]
+    return list(prompt[start:]) + list(ids)
+
+
+class ModelDrafter(DraftSource):
+    """Device-resident draft model behind the DraftSource protocol.
+
+    ``params``/``config``: the drafter model (any llama/mixtral-family
+    config; its ``vocab_size`` MUST equal the target's — draft ids feed
+    the target's verify forward directly, which the scheduler validates
+    at construction). ``num_slots``/``max_seq`` mirror the target
+    scheduler's batch geometry; ``k`` is the drafts-per-tick budget
+    (the scheduler's ``spec_k``)."""
+
+    name = "model"
+
+    def __init__(self, params: dict, config: ModelConfig, *,
+                 num_slots: int, max_seq: int, k: int,
+                 mesh=None) -> None:
+        if k < 1:
+            raise ValueError(f"drafter k must be >= 1, got {k}")
+        self.config = config
+        self.k = k
+        self.num_slots = num_slots
+        self.max_seq = min(max_seq, config.max_seq_len)
+        self.mesh = mesh
+        self._model = family_for(config)
+        self._dtype = params["embed"].dtype
+        model = self._model
+        if hasattr(model, "fuse_params"):
+            from ..models.llama import fuse_tp_for
+            params = model.fuse_params(params,
+                                       tp=fuse_tp_for(config, mesh),
+                                       mesh=mesh)
+        self._params = params
+        self._cache = KVCache.create(config, num_slots, self.max_seq,
+                                     self._dtype)
+        # Valid-KV prefix per row (tokens of the row's context whose KV
+        # in the drafter cache is trusted). Scheduler-thread only.
+        self._fed = [0] * num_slots
+        # Rows drafted by the last draft_batch, awaiting observe().
+        self._await_obs: set[int] = set()
+        self._feed_programs: dict[tuple[int, int], object] = {}
+        self._draft_programs: dict[tuple[int, int], object] = {}
+
+    # -- memory accounting ----------------------------------------------------
+
+    def kv_bytes(self) -> int:
+        """Drafter KV footprint (the engine logs it next to the target's
+        pool at build — the second model must be budgeted, not implied)."""
+        return self._cache.k.nbytes + self._cache.v.nbytes
+
+    def param_bytes(self) -> int:
+        from ..models.quant import QTensor
+        return sum(
+            (x.q.nbytes + x.s.nbytes if isinstance(x, QTensor) else x.nbytes)
+            for x in jax.tree.leaves(
+                self._params, is_leaf=lambda x: isinstance(x, QTensor)))
+
+    # -- jitted programs ------------------------------------------------------
+
+    def _feed_for(self, M: int, W: int):
+        """Catch-up program for a (pending-bucket M, window W) shape:
+        one multi-position forward (models verify_step — per-row ragged
+        ``pend`` lengths, rows with pend=0 are no-ops) that writes the
+        pending tokens' KV and advances lengths by pend. No sampling, no
+        readback — admission prefills dispatch through this and return
+        without a sync."""
+        prog = self._feed_programs.get((M, W))
+        if prog is None:
+            model, config, mesh = self._model, self.config, self.mesh
+
+            def _feed(params, tokens, pend, lengths, cache):
+                cache = cache._replace(lengths=lengths)
+                _, cache = model.verify_step(params, config, tokens, cache,
+                                             mesh, kv_window=W)
+                return cache._replace(lengths=cache.lengths + pend)
+
+            prog = jax.jit(_feed, donate_argnums=(4,))
+            self._feed_programs[(M, W)] = prog
+        return prog
+
+    def _draft_for(self, M: int, W: int):
+        """Combined catch-up + K-greedy-draft program: verify_step over
+        the pending bucket, first draft from the last pending position's
+        argmax, then K-1 more greedy steps through the existing
+        decode_fused scan (argmax sample_fn, no stop parking — the
+        TARGET's verify decides what an EOS draft means). Returns the
+        [B, K] proposals; rejected drafts' KV is rolled back by the next
+        dispatch's host-supplied lengths."""
+        prog = self._draft_programs.get((M, W))
+        if prog is None:
+            model, config, mesh = self._model, self.config, self.mesh
+            K = self.k
+            stop_ids = np.zeros((0,), np.int32)
+
+            def _draft(params, tokens, pend, lengths, cache):
+                cache = cache._replace(lengths=lengths)
+                logits, cache = model.verify_step(params, config, tokens,
+                                                  cache, mesh, kv_window=W)
+                last = jnp.take_along_axis(
+                    logits, jnp.clip(pend - 1, 0, M - 1)[:, None, None],
+                    axis=1)[:, 0]                                  # [B,V]
+                cache = cache._replace(lengths=cache.lengths + pend)
+                d1 = jnp.argmax(last, axis=-1).astype(jnp.int32)   # [B]
+                if K == 1:
+                    return d1[:, None], cache
+                act = pend > 0
+
+                def sample_fn(lg, state, emit_pos, a):
+                    return jnp.argmax(lg, axis=-1).astype(jnp.int32), state
+
+                toks_all, _, _, cache, _, _ = model.decode_fused(
+                    params, config, d1[:, None], cache, mesh, active=act,
+                    num_steps=K - 1, sample_fn=sample_fn, sample_state=(),
+                    stop_ids=stop_ids, kv_window=W)
+                drafts = jnp.concatenate([d1[:, None], toks_all.T], axis=1)
+                return drafts, cache
+
+            prog = jax.jit(_draft, donate_argnums=(4,))
+            self._draft_programs[(M, W)] = prog
+        return prog
+
+    # -- host plumbing --------------------------------------------------------
+
+    def _window(self, need: int) -> int:
+        return _pow2(need, min(128, self.max_seq), self.max_seq)
+
+    def _host_arrays(self, rows: list[int],
+                     pend_toks: dict[int, list[int]], M: int) -> tuple:
+        B = self.num_slots
+        tokens = np.zeros((B, M), np.int32)
+        pend = np.zeros((B,), np.int32)
+        lengths = np.asarray(self._fed, np.int32)
+        for row in rows:
+            t = pend_toks[row]
+            tokens[row, : len(t)] = t
+            pend[row] = len(t)
+        return (jnp.asarray(tokens), jnp.asarray(pend),
+                jnp.asarray(lengths))
+
+    def _dispatch_feed(self, rows: list[int],
+                       pend_toks: dict[int, list[int]]) -> None:
+        if not rows:
+            return
+        M = _pow2(max(len(pend_toks[r]) for r in rows), _MIN_FEED,
+                  _MAX_FEED)
+        need = max(self._fed[r] + len(pend_toks[r]) for r in rows) + 1
+        W = self._window(need)
+        tokens, pend, lengths = self._host_arrays(rows, pend_toks, M)
+        self._cache = self._feed_for(M, W)(
+            self._params, tokens, pend, lengths, self._cache)
+        for row in rows:
+            self._fed[row] += len(pend_toks[row])
+
+    def _catch_up_oversize(self, rows: list[int],
+                           ctxs: dict[int, tuple]) -> None:
+        """Feed _MAX_FEED-wide chunks until every row's pending suffix
+        fits one draft dispatch (rare: a long throttled stretch, or a
+        drafter enabled mid-stream)."""
+        logged = False
+        while True:
+            big = [r for r in rows
+                   if _ctx_len(ctxs[r]) - self._fed[r] > _MAX_FEED]
+            if not big:
+                return
+            if not logged:
+                logged = True
+                log.info("drafter catching up %d row(s), longest pending "
+                         "suffix %d tokens", len(big),
+                         max(_ctx_len(ctxs[r]) - self._fed[r]
+                             for r in big))
+            self._dispatch_feed(
+                big, {r: _ctx_suffix(ctxs[r], self._fed[r])[:_MAX_FEED]
+                      for r in big})
+
+    # -- DraftSource protocol -------------------------------------------------
+
+    def prefill(self, rows: list[int], ctxs: dict[int, list[int]]) -> None:
+        """Batched admission prefill: feed each admitted row's prompt in
+        one dispatch (chunked at _MAX_FEED). Async by construction —
+        nothing reads back, so the dispatch overlaps whatever target
+        work (chunk ladder, decode ticks) the loop does next."""
+        for row in rows:
+            self._fed[row] = 0
+            self._await_obs.discard(row)
+        todo = [r for r in rows if ctxs[r]]
+        while todo:
+            chunk = {r: ctxs[r][self._fed[r]: self._fed[r] + _MAX_FEED]
+                     for r in todo}
+            self._dispatch_feed(todo, chunk)
+            todo = [r for r in todo if self._fed[r] < len(ctxs[r])]
+
+    def admit(self, row: int, ctx: list[int]) -> None:
+        self.prefill([row], {row: ctx})
+
+    def release(self, row: int) -> None:
+        self._fed[row] = 0
+        self._await_obs.discard(row)
+
+    def draft_batch(self, rows: list[int],
+                    ctxs: dict[int, tuple]) -> dict[int, list[int]]:
+        """Propose K greedy tokens for each requested row: catch up the
+        pending context suffix, then one combined feed+draft dispatch.
+        Costs one device dispatch + a [B, K] int32 readback — the price
+        the verify's accepted tokens must amortise (the scheduler's
+        per-source EMA throttle turns this off when they don't)."""
+        # Rows whose context + drafts would overrun the drafter budget
+        # stop model-drafting (they are about to finish anyway; n-gram
+        # proposals and the target's max_acc cap still apply).
+        rows = [r for r in rows
+                if _ctx_len(ctxs[r]) + self.k + 1 <= self.max_seq
+                and _ctx_len(ctxs[r]) > self._fed[r]]
+        if not rows:
+            return {}
+        self._catch_up_oversize(rows, ctxs)
+        pend_toks = {r: _ctx_suffix(ctxs[r], self._fed[r]) for r in rows}
+        M = _pow2(max(len(t) for t in pend_toks.values()), _MIN_FEED,
+                  _MAX_FEED)
+        need = max(self._fed[r] + len(pend_toks[r]) for r in rows) + self.k
+        W = self._window(need)
+        tokens, pend, lengths = self._host_arrays(rows, pend_toks, M)
+        drafts_dev, self._cache = self._draft_for(M, W)(
+            self._params, tokens, pend, lengths, self._cache)
+        # graftcheck: sync-ok intentional: [B,K] int32 draft readback, the spec tick consumes it
+        drafts = np.asarray(drafts_dev)
+        for row in rows:
+            self._fed[row] += len(pend_toks[row])
+            self._await_obs.add(row)
+        return {row: [int(t) for t in drafts[row]] for row in rows}
+
+    def observe(self, row: int, accepted: int) -> None:
+        """Verify outcome: accepted drafts became context — their KV
+        (written as scan inputs d1..d_{K-1}) is now trusted, so the
+        valid prefix advances by min(accepted, K-1). Everything beyond
+        is stale-beyond-length: rollback costs nothing."""
+        if row in self._await_obs:
+            self._await_obs.discard(row)
+            self._fed[row] += min(max(0, accepted), self.k - 1)
+
+    def reset(self) -> None:
+        """Drop all drafter device state (scheduler recovery envelope —
+        a failed donated call may have consumed the cache)."""
+        self._cache = KVCache.create(self.config, self.num_slots,
+                                     self.max_seq, self._dtype)
+        self._fed = [0] * self.num_slots
+        self._await_obs.clear()
+
+    # -- warmup ---------------------------------------------------------------
+
+    def warm(self, buckets: tuple[int, ...], windows: tuple[int, ...]
+             ) -> list:
+        """One warmup closure per drafter program, for the scheduler's
+        job queue (same shape as its own admit/window jobs — live ticks
+        interleave between compiles). Warms the steady-state draft shape
+        (M = _MIN_FEED — pending is one correction token between spec
+        ticks) at every window, plus the admission-prefill feed shapes
+        for the warmed prompt buckets; longer catch-up shapes compile
+        lazily (rare, small-model compiles, logged by jax)."""
+        jobs = []
+        ws = sorted({self._window(min(w, self.max_seq)) for w in windows})
+        for W in ws:
+            jobs.append(lambda W=W: self._warm_one(_MIN_FEED, W,
+                                                   draft=True))
+        for S in buckets:
+            M = _pow2(min(S, _MAX_FEED), _MIN_FEED, _MAX_FEED)
+            W = self._window(min(S + 1, self.max_seq))
+            jobs.append(lambda M=M, W=W: self._warm_one(M, W, draft=False))
+        return jobs
+
+    def _warm_one(self, M: int, W: int, draft: bool) -> None:
+        """Compile+run one program as an all-rows-inactive no-op on the
+        live drafter cache (pend=0 everywhere: lengths don't advance,
+        garbage writes land beyond every valid prefix)."""
+        tokens = jnp.zeros((self.num_slots, M), jnp.int32)
+        pend = jnp.zeros((self.num_slots,), jnp.int32)
+        lengths = jnp.asarray(np.asarray(self._fed, np.int32))
+        if draft:
+            _, self._cache = self._draft_for(M, W)(
+                self._params, tokens, pend, lengths, self._cache)
+        else:
+            self._cache = self._feed_for(M, W)(
+                self._params, tokens, pend, lengths, self._cache)

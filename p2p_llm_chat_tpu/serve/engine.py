@@ -29,7 +29,11 @@ unset = 8 x SERVE_SLOTS, 0 = unbounded; at the bound, submits fast-fail
 with 503 + Retry-After instead of burning the queue deadline),
 ``SERVE_LOOP_BUDGET_MS`` (scheduler-loop watchdog budget; 0 disables),
 ``SERVE_QUANT`` (int8 = weight-only quantization, models/quant.py),
-``SERVE_SPEC`` (K>0 = speculative decoding with prompt-lookup drafts),
+``SERVE_SPEC`` (K>0 = speculative decoding: hybrid prompt-lookup n-gram
+drafts + the optional resident draft model),
+``SERVE_DRAFT`` (draft-model config name or checkpoint dir, resident on
+the same chip; drafts wherever the n-gram index misses — needs
+SERVE_SPEC > 0; serve/draft_model.py),
 ``SERVE_FUSE`` (fused multi-step decode: up to K decode steps per device
 dispatch, adaptive; default 4, 1 disables),
 ``SERVE_PREFILL_CHUNK`` (chunked prefill: admissions above this token
@@ -88,12 +92,57 @@ class TPUEngine:
                  kv_quant: bool = False,
                  decode_fuse_max: int = 4,
                  prefill_chunk: int = 256,
-                 queue_max: Optional[int] = None) -> None:
+                 queue_max: Optional[int] = None,
+                 draft: Optional[tuple] = None) -> None:
+        """``draft``: optional ``(params, config)`` of a small draft
+        model made resident alongside this engine's target for
+        speculative decoding (SERVE_DRAFT; serve/draft_model.py). Needs
+        ``spec_k`` > 0, a matching vocabulary, and single-chip serving
+        (mesh=None) — incompatible pairings log and fall back to
+        n-gram-only speculation rather than failing the boot (a bad
+        optimizer flag must not take the serving plane down)."""
         self.name = name or config.name
         self.config = config
         self.prefix_texts = tuple(prefix_texts) if prefix_cache else ()
         self._embed_j = None      # guarded-by: _embed_lock
         self._embed_lock = threading.Lock()
+        drafter = None
+        if draft is not None and spec_k:
+            dparams, dconfig = draft
+            if dconfig.vocab_size != config.vocab_size:
+                log.warning(
+                    "SERVE_DRAFT model %s (vocab %d) cannot draft for "
+                    "%s (vocab %d); falling back to n-gram-only "
+                    "speculation", dconfig.name, dconfig.vocab_size,
+                    config.name, config.vocab_size)
+            elif mesh is not None:
+                log.warning("SERVE_DRAFT is single-chip only (the "
+                            "drafter does not shard); falling back to "
+                            "n-gram-only speculation under a mesh")
+            elif (min(max_seq, dconfig.max_seq_len)
+                  < min(max_seq, config.max_seq_len)):
+                # The scheduler hard-raises on a drafter that cannot
+                # cover the target's context budget — catch it here so
+                # a bad flag degrades instead of failing the boot.
+                log.warning(
+                    "SERVE_DRAFT model %s (max_seq_len %d) cannot cover "
+                    "the serving budget %d; falling back to n-gram-only "
+                    "speculation", dconfig.name, dconfig.max_seq_len,
+                    min(max_seq, config.max_seq_len))
+            else:
+                from .draft_model import ModelDrafter
+                drafter = ModelDrafter(dparams, dconfig,
+                                       num_slots=num_slots,
+                                       max_seq=max_seq, k=spec_k)
+                # Second-model memory accounting: the drafter's params
+                # + dense KV are a fixed add-on the operator budgets
+                # against HBM next to the target's pool.
+                log.info(
+                    "draft model resident: %s (%.2f GB params, "
+                    "%.2f GB KV at %d slots x %d) drafting k=%d for %s",
+                    dconfig.name, drafter.param_bytes() / 1e9,
+                    drafter.kv_bytes() / 1e9, num_slots,
+                    drafter.max_seq, spec_k, config.name)
         self.scheduler = BatchScheduler(params, config, tokenizer,
                                         num_slots=num_slots, max_seq=max_seq,
                                         mesh=mesh, kv_mode=kv_mode,
@@ -106,7 +155,8 @@ class TPUEngine:
                                         kv_quant=kv_quant,
                                         decode_fuse_max=decode_fuse_max,
                                         prefill_chunk=prefill_chunk,
-                                        queue_max=queue_max)
+                                        queue_max=queue_max,
+                                        drafter=drafter)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
@@ -260,6 +310,18 @@ def build_engine_from_env() -> Backend:
     qm = env_int("SERVE_QUEUE_MAX", -1)
     queue_max = None if qm < 0 else qm
     spec_k = env_int("SERVE_SPEC", 0)
+    # Draft-model speculative decoding (serve/draft_model.py): a config
+    # name (random-init / synthetic path — CPU tests, benches) or a
+    # checkpoint dir (the production path: e.g. a llama3.2-1b instruct
+    # checkpoint drafting for llama3.1-8b) of a SMALL model resident
+    # alongside the target. Requires SERVE_SPEC > 0; drafts fill in
+    # wherever the n-gram index misses, so speculation wins on free-form
+    # output, not just quoting.
+    draft_ref = env_or("SERVE_DRAFT", "")
+    if draft_ref and not spec_k:
+        log.warning("SERVE_DRAFT set but SERVE_SPEC=0 — no speculative "
+                    "ticks will run; set SERVE_SPEC (e.g. 4) to enable "
+                    "the drafter")
     # Fused multi-step decode: up to this many decode steps per device
     # dispatch (adaptive — see scheduler.decode_fuse_max). 1 disables.
     decode_fuse_max = max(1, env_int("SERVE_FUSE", 4))
@@ -314,6 +376,52 @@ def build_engine_from_env() -> Backend:
             params = quantize_params(params, mesh=mesh)
         return params
 
+    def load_draft_for(config) -> Optional[tuple]:
+        """(params, config) for SERVE_DRAFT against this target, or
+        None. A directory loads the checkpoint (strict vocabulary — the
+        engine falls back with a warning on mismatch); a config name
+        random-inits at the TARGET's vocabulary (random weights carry
+        no vocabulary semantics, so cloning the config at the right
+        vocab keeps the no-checkpoint path drafting end to end)."""
+        if not draft_ref or not spec_k:
+            return None
+        if mesh is not None:
+            log.warning("SERVE_DRAFT is single-chip only (the drafter "
+                        "does not shard); ignoring it under SERVE_TP>1 "
+                        "— n-gram-only speculation")
+            return None
+        if os.sep in draft_ref or os.path.isdir(draft_ref):
+            # Same format probe as the target path (native orbax vs HF
+            # safetensors); any load failure degrades to n-gram-only —
+            # the drafter is an optimizer, it must not take serving down.
+            try:
+                from ..models.checkpoint import is_native_checkpoint
+                if is_native_checkpoint(draft_ref):
+                    from ..models.checkpoint import \
+                        load_checkpoint as load_native
+                    dparams, dconfig = load_native(draft_ref)
+                else:
+                    dparams, dconfig = load_checkpoint(draft_ref)
+                if quant:
+                    from ..models.quant import quantize_params
+                    dparams = quantize_params(dparams)
+            except Exception:   # noqa: BLE001 — degrade, don't fail boot
+                log.exception(
+                    "SERVE_DRAFT checkpoint %r failed to load; falling "
+                    "back to n-gram-only speculation", draft_ref)
+                return None
+            return dparams, dconfig
+        try:
+            dconfig = get_config(draft_ref)
+        except KeyError:
+            log.warning("SERVE_DRAFT %r is neither a checkpoint dir nor "
+                        "a registered config; falling back to n-gram-only "
+                        "speculation", draft_ref)
+            return None
+        if dconfig.vocab_size != config.vocab_size:
+            dconfig = dconfig.with_(vocab_size=config.vocab_size)
+        return random_init_params(dconfig, 101), dconfig
+
     def make_engine(params, config, tokenizer, name: str) -> TPUEngine:
         return TPUEngine(params, config, tokenizer, num_slots=num_slots,
                          max_seq=max_seq, mesh=mesh, kv_mode=kv_mode,
@@ -325,7 +433,8 @@ def build_engine_from_env() -> Backend:
                          kv_quant=bool(kv_quant),
                          decode_fuse_max=decode_fuse_max,
                          prefill_chunk=prefill_chunk,
-                         queue_max=queue_max)
+                         queue_max=queue_max,
+                         draft=load_draft_for(config))
 
     def warmup_buckets():
         warmup = env_or("SERVE_WARMUP", "128,256")

@@ -66,7 +66,14 @@ class MultiBackend:
             esc = (tag.replace("\\", "\\\\").replace('"', '\\"')
                    .replace("\n", "\\n"))
             for k, v in snap().items():
-                out[f'{k}{{model="{esc}"}}'] = v
+                if k.endswith("}"):
+                    # Already-labeled series (the per-draft-source spec
+                    # keys): merge the model label into the existing
+                    # brace block — a second {model=...} suffix would be
+                    # malformed exposition and break the whole scrape.
+                    out[f'{k[:-1]},model="{esc}"}}'] = v
+                else:
+                    out[f'{k}{{model="{esc}"}}'] = v
         return out
 
     def ready(self) -> bool:

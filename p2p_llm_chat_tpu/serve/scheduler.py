@@ -81,8 +81,16 @@ _RING = 64
 _MIN_REGISTER_PREFIX = 8
 # Adaptive speculation: below this EMA of accepted-drafts-per-tick the
 # verify pass costs more than it saves; probe intermittently instead.
+# EMAs are PER DRAFT SOURCE (ngram | model): a cold n-gram index on
+# free-form output must not throttle model drafting, and vice versa.
 _SPEC_EMA_FLOOR = 0.5
 _SPEC_EMA_ALPHA = 0.1
+# Cold start: each source seeds at 2x the floor (speculation gets a fair
+# shot) and zero-acceptance ticks decay with this faster alpha, so a
+# workload that never accepts throttles within ~3 spec ticks instead of
+# the ~20 the old spec_k-optimistic seed burned (ISSUE 6 satellite).
+_SPEC_EMA_SEED = 2 * _SPEC_EMA_FLOOR
+_SPEC_EMA_ZERO_ALPHA = 0.3
 _SPEC_PROBE_EVERY = 8
 # Deferred prefix-promotion builds prefer idle ticks, but under
 # sustained load one build is allowed per this many decode ticks.
@@ -117,7 +125,6 @@ class _Slot:
     pages: Optional[list[int]] = None                  # paged mode: physical pages
     cancelled: threading.Event = field(default_factory=threading.Event)
     error: Optional[str] = None                        # surfaced by submit()
-    drafter: Optional[object] = None                   # spec-decode NGramDrafter
     prefix: Optional[PrefixEntry] = None               # cached-prefix admission
     prefix_checked: bool = False                       # match() ran for this slot
     last_emit_t: float = 0.0                           # inter-token gap tracking
@@ -258,7 +265,8 @@ class BatchScheduler:
                  decode_fuse_max: int = 4,
                  prefill_chunk: int = 256,
                  queue_max: Optional[int] = None,
-                 loop_budget_ms: Optional[float] = None) -> None:
+                 loop_budget_ms: Optional[float] = None,
+                 drafter: Optional[object] = None) -> None:
         """``admit_chunk``: burst-admission width. None (default) admits a
         backlog burst through one full-width prefill (minimal dispatches —
         best p95/throughput); a fixed power-of-two (e.g. 8) staggers the
@@ -292,10 +300,20 @@ class BatchScheduler:
         operator alerts on. None reads ``SERVE_LOOP_BUDGET_MS``
         (default 5000); 0 disables.
 
-        ``spec_k``: speculative decoding (prompt-lookup drafting,
-        utils/draft.py): each tick verifies up to K drafted tokens per
-        row in one forward (models/llama.verify_step[_paged] + exact
-        acceptance sampling), so ticks emit 1..K+1 tokens. 0 disables.
+        ``spec_k``: speculative decoding: each tick verifies up to K
+        drafted tokens per row in one forward
+        (models/llama.verify_step[_paged] + exact acceptance sampling),
+        so ticks emit 1..K+1 tokens. 0 disables. Drafts come from a
+        priority-ordered hybrid of sources (utils/draft.DraftSource):
+        prompt-lookup n-grams first (~free when they hit — quoting
+        workloads), then — when ``drafter`` is set — a resident draft
+        model filling in on n-gram misses (free-form workloads). Each
+        source throttles on its OWN acceptance EMA.
+
+        ``drafter``: a serve/draft_model.ModelDrafter resident alongside
+        the target (same batch geometry, same vocabulary — validated
+        here). None = n-gram-only speculation (the pre-round-9
+        behavior). Requires ``spec_k`` > 0 to have any effect.
 
         ``kv_quant``: store the paged pool as int8 with per-(slot,
         kv-head) scales (ops/paged_kv.py). Decode is KV-bandwidth-bound,
@@ -525,13 +543,46 @@ class BatchScheduler:
         self._stall_reset_req = threading.Event()
         self._stall_reset_ack = threading.Event()
         self._tbt_hist = Histogram("inter_token_ms")
-        # Adaptive speculation: EMA of accepted drafts per spec tick.
-        # The verify forward computes K+1 positions for every row, so
-        # when drafts stop landing (non-repetitive output), paying it
-        # every tick is pure loss — below the floor, only probe every
-        # _SPEC_PROBE_EVERY ticks until acceptance recovers.
-        self._spec_ema = float(spec_k)  # owned-by: _loop — optimistic start
-        self._spec_cooldown = 0         # owned-by: _loop
+        # Draft sources, priority order: n-gram prompt-lookup first (it
+        # is ~free when it hits), the resident draft model filling in on
+        # misses. The model drafter must match the target's batch
+        # geometry and vocabulary — draft ids feed the verify forward
+        # directly, so a vocab mismatch would silently verify garbage.
+        self._draft_model = drafter if spec_k else None
+        if self._draft_model is not None:
+            d = self._draft_model
+            if d.config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"drafter vocab {d.config.vocab_size} != target "
+                    f"vocab {config.vocab_size}: a draft model must "
+                    "share its target's vocabulary")
+            if d.num_slots != num_slots or d.max_seq < self.max_seq:
+                raise ValueError(
+                    f"drafter geometry (slots={d.num_slots}, "
+                    f"max_seq={d.max_seq}) does not cover the target's "
+                    f"(slots={num_slots}, max_seq={self.max_seq})")
+            if d.k != spec_k:
+                raise ValueError(
+                    f"drafter k={d.k} != spec_k={spec_k}")
+        # Adaptive speculation: PER-SOURCE EMA of accepted drafts per
+        # spec tick. The verify forward computes K+1 positions for every
+        # row, so when a source's drafts stop landing, paying its
+        # proposal cost (and the verify it triggers) every tick is pure
+        # loss — below the floor, that source only probes every
+        # _SPEC_PROBE_EVERY ticks until acceptance recovers. Seeds are
+        # mildly optimistic (2x floor) and zero-acceptance ticks decay
+        # fast — see _SPEC_EMA_SEED. Sources late-init via
+        # _ensure_sources so a spec_k toggled 0 -> K at runtime (the
+        # attribute is runtime-togglable) still gets n-gram
+        # speculation, like the pre-round-9 per-slot drafters did.
+        self._sources: list = []               # owned-by: _loop (state inside)
+        self._spec_ema: dict[str, float] = {}  # owned-by: _loop
+        self._spec_cooldown: dict[str, int] = {}   # owned-by: _loop
+        # Per-source proposed/accepted draft-token counters (/metrics
+        # spec_draft_source observability; bench freeform phase).
+        self._n_spec_proposed_src: dict[str, int] = {}  # owned-by: _loop
+        self._n_spec_accepted_src: dict[str, int] = {}  # owned-by: _loop
+        self._ensure_sources()
 
         # Jitted programs. decode is compiled once; admit once per
         # (chunk-rows, prompt-bucket) shape pair — both power-of-two
@@ -1372,6 +1423,12 @@ class BatchScheduler:
                                                synthetic=True))
         for w in windows:
             steps.append(lambda w=w: self._warm_window(w))
+        if self._draft_model is not None:
+            # Drafter programs (steady-state draft shape per window +
+            # the admission-prefill feed shapes) ride the same one-job-
+            # per-program queue, so a mid-traffic warmup interleaves
+            # drafter compiles with live ticks too.
+            steps.extend(self._draft_model.warm(buckets, windows))
         if self.kv_mode == "paged":
             steps.append(self._warm_zero_row)
         # One-shot device-step measurement for the wall/device gauges —
@@ -1890,16 +1947,20 @@ class BatchScheduler:
                     continue
                 # Flush the pipeline for a speculative tick only when one
                 # can actually run this tick (drafting needs current ids)
-                # — while the acceptance throttle has speculation backed
+                # — while the acceptance throttle has EVERY source backed
                 # off, plain ticks keep their pipelining.
-                spec_now = bool(self.spec_k) and not self._spec_throttled()
+                if self.spec_k and not self._sources:
+                    self._ensure_sources()   # spec_k toggled 0 -> K
+                spec_allowed = (self._spec_sources_allowed()
+                                if self.spec_k else {})
+                spec_now = bool(self.spec_k) and any(spec_allowed.values())
                 if spec_now:
                     if pending is not None:
                         self._process_tick(*pending)
                         pending = None
                     if not self._any_active():
                         continue
-                    if self._spec_tick():
+                    if self._spec_tick(spec_allowed):
                         continue
                 # Fused K-step ticks ride the same one-tick-deep pipeline
                 # as plain ones: tick t+1 (up to K steps) is enqueued
@@ -2169,7 +2230,24 @@ class BatchScheduler:
         }
         if self.spec_k:
             out["serve_spec_accepted_total"] = self._n_spec_accepted
-            out["serve_spec_accept_ema"] = round(self._spec_ema, 4)
+            # Back-compat aggregate: the most optimistic source (the
+            # one that keeps speculation ticking).
+            out["serve_spec_accept_ema"] = round(
+                max(self._spec_ema.values(), default=0.0), 4)
+            # Per-draft-source series (ngram | model): proposed/accepted
+            # draft-token counters, the realized acceptance rate, and
+            # each source's throttle EMA — the observability that shows
+            # WHICH source is earning its verify cost per workload.
+            for s in self._sources:
+                n = s.name
+                prop = self._n_spec_proposed_src[n]
+                acc = self._n_spec_accepted_src[n]
+                out[f'serve_spec_proposed_total{{source="{n}"}}'] = prop
+                out[f'serve_spec_accepted_total{{source="{n}"}}'] = acc
+                out[f'serve_spec_accept_rate{{source="{n}"}}'] = (
+                    round(acc / prop, 4) if prop else 0.0)
+                out[f'serve_spec_accept_ema{{source="{n}"}}'] = round(
+                    self._spec_ema[n], 4)
         if self._prefix is not None:
             out["serve_prefix_entries"] = len(self._prefix)
             out["serve_prefix_admits_total"] = self._n_prefix_admits
@@ -2540,6 +2618,29 @@ class BatchScheduler:
         # graftcheck: sync-ok intentional: R int32 first tokens, TTFT depends on it
         first_toks = np.asarray(toks_dev)
 
+        # Draft-source admission BEFORE the install loop (a row that
+        # finishes on its very first token releases inside the loop, and
+        # release must never precede its own admit): n-gram builds its
+        # prompt index per row; the model drafter prefills every row's
+        # prompt in one batched dispatch — async, no readback, so it
+        # overlaps the first-token streaming below and whatever target
+        # work the loop does next (the PR 3 chunk ladder included).
+        # Gated on the runtime-togglable spec_k (bench A/B phases flip
+        # it): with speculation off, no drafter dispatches may run —
+        # sources late-bind at the next draft_batch instead (the model
+        # drafter's catch-up feed covers rows admitted while off).
+        if self.spec_k and self._sources and chunk:
+            ctxs = {row: slot.prompt_ids
+                    for slot, row in zip(chunk, rows)}
+            rws = [row for _, row in zip(chunk, rows)]
+            for s in self._sources:
+                pf = getattr(s, "prefill", None)
+                if pf is not None:
+                    pf(rws, ctxs)
+                else:
+                    for r in rws:
+                        s.admit(r, ctxs[r])
+
         now = time.monotonic()
         self._n_admitted += len(chunk)
         for i, (slot, row) in enumerate(zip(chunk, rows)):
@@ -2550,9 +2651,6 @@ class BatchScheduler:
             # last_emit_t stays 0 until _append_token below sets it: the
             # first token's latency is TTFT, not an inter-token gap — a
             # pre-set stamp would log a fake ~0 ms TBT sample per request.
-            if self.spec_k:
-                from ..utils.draft import NGramDrafter
-                slot.drafter = NGramDrafter(slot.prompt_ids, self.spec_k)
             self._slots[row] = slot
             if not self._append_token(slot, row, int(first_toks[pad + i])):
                 # finished on the very first token (eos / limits)
@@ -2758,53 +2856,127 @@ class BatchScheduler:
                     self._release(row)
                     break
 
-    def _spec_throttled(self) -> bool:
-        """Acceptance-collapse throttle: when the accepted-drafts EMA is
-        below the floor, speculate only every Nth tick (a successful
-        probe lifts the EMA and re-enables per-tick speculation). Checked
-        in _loop BEFORE the pipeline flush, so throttled plain ticks keep
-        their one-tick pipelining."""
-        if self._spec_ema >= _SPEC_EMA_FLOOR:
-            return False
-        self._spec_cooldown += 1
-        return bool(self._spec_cooldown % _SPEC_PROBE_EVERY)
+    def _ensure_sources(self) -> None:
+        """Build the draft-source list (and per-source throttle/counter
+        state) the first time speculation is on. Called at construction
+        and from _loop, so a scheduler built with spec_k=0 whose spec_k
+        is later toggled >0 still speculates (n-gram only: a drafter's
+        K is baked in at ITS construction, so it cannot be conjured by
+        a toggle — it is validated and attached only when the scheduler
+        is built with spec_k>0)."""
+        if self._sources or not self.spec_k:
+            return
+        from ..utils.draft import NGramSource
+        srcs = [NGramSource(self.spec_k)]
+        if self._draft_model is not None:
+            srcs.append(self._draft_model)
+        for s in srcs:
+            # Per-source state BEFORE the source becomes visible: a
+            # concurrent /metrics scrape iterates _sources and indexes
+            # these dicts, so appending first would open a KeyError
+            # window during a runtime 0 -> K toggle.
+            self._spec_ema[s.name] = _SPEC_EMA_SEED
+            self._spec_cooldown[s.name] = 0
+            self._n_spec_proposed_src[s.name] = 0
+            self._n_spec_accepted_src[s.name] = 0
+            self._sources.append(s)
 
-    def _spec_tick(self) -> bool:
-        """Speculative decode tick. Returns False (caller falls back to
-        the plain tick) when no active row has a usable draft — the
-        verify program computes K+1 positions for every row, so it only
-        pays off when something is drafted.
+    # graftcheck: runs-on _loop
+    def _spec_sources_allowed(self) -> dict[str, bool]:
+        """Per-source acceptance-collapse throttle: a source whose EMA
+        sits below the floor proposes only every Nth tick (a successful
+        probe lifts its EMA and re-enables it per-tick); sources above
+        the floor always may. Mutates the per-source probe counters —
+        call once per loop iteration, BEFORE the pipeline flush, so
+        iterations where every source is throttled keep their one-tick
+        pipelining. Per-source on purpose: a cold n-gram index on
+        free-form output must not starve model drafting (and a cold
+        model must not stop quoting workloads' free n-gram wins)."""
+        out: dict[str, bool] = {}
+        for s in self._sources:
+            if self._spec_ema[s.name] >= _SPEC_EMA_FLOOR:
+                out[s.name] = True
+            else:
+                self._spec_cooldown[s.name] += 1
+                out[s.name] = not (self._spec_cooldown[s.name]
+                                   % _SPEC_PROBE_EVERY)
+        return out
 
-        Per row: host proposes up to K tokens from its n-gram index
-        (utils/draft.py), the device verifies [cur, drafts...] in one
-        forward, accepts an exactly-distributed prefix
-        (models/sampling.spec_verify_batched), advances lengths by
-        accepted+1, and hands back (accepted, correction) — 2×B int32.
-        Rejected drafts' kv slots are stale-beyond-length (free
-        rollback); near-budget rows cap acceptance via max_acc so
-        trusted slots never pass their budget."""
+    def _spec_tick(self, allowed: dict[str, bool]) -> bool:
+        """Speculative decode tick over the hybrid draft sources.
+        Returns False (caller falls back to the plain tick) when no
+        active row has a usable draft — the verify program computes K+1
+        positions for every row, so it only pays off when something is
+        drafted.
+
+        Draft phase, priority order (``allowed`` gates each source —
+        the per-source EMA throttle): the n-gram index proposes first
+        (host-side, ~free when it hits); rows it misses go to the
+        resident draft model, which proposes K greedy tokens in one
+        batched drafter dispatch (serve/draft_model.py). Verify phase:
+        the device verifies [cur, drafts...] in one target forward,
+        accepts an exactly-distributed prefix
+        (models/sampling.spec_verify_batched — both sources propose
+        point-mass drafts, so the acceptance math is exact for either),
+        advances lengths by accepted+1, and hands back (accepted,
+        correction) — 2×B int32. Rejected drafts' kv slots are
+        stale-beyond-length (free rollback, target AND drafter — the
+        drafter rewinds via observe()); near-budget rows cap acceptance
+        via max_acc so trusted slots never pass their budget."""
         K = self.spec_k
         B = self.num_slots
         tokens = np.zeros((B, K + 1), np.int32)
         drafts = np.zeros((B, K), np.int32)
         max_acc = np.zeros((B,), np.int32)
-        any_draft = False
+        budgets: dict[int, int] = {}
+        # Contexts as UNCONCATENATED (prompt_ids, ids) reference pairs —
+        # the DraftSource contract — so a spec tick copies no per-row
+        # context; sources slice only the suffix they need.
+        ctxs: dict[int, tuple] = {}
+        remaining: list[int] = []
         for row, slot in enumerate(self._slots):
             if slot is None:
                 continue
             # Live slots always hold >= 1 generated token (admission
             # appends the first or releases the row).
             tokens[row, 0] = slot.ids[-1]
-            d = slot.drafter.draft() if slot.drafter is not None else []
             budget = slot.ctx_budget - 2 - slot.ctx_len
-            m = max(0, min(len(d), budget))
-            if m:
-                any_draft = True
-                drafts[row, : len(d)] = d
-                tokens[row, 1: 1 + len(d)] = d
-                max_acc[row] = m
-        if not any_draft:
+            if budget < 1:
+                continue        # cannot accept anything — don't draft
+            budgets[row] = budget
+            ctxs[row] = (slot.prompt_ids, slot.ids)
+            remaining.append(row)
+        # row -> (source name, proposal) — first source to propose wins.
+        proposals: dict[int, tuple[str, list[int]]] = {}
+        consulted: list[str] = []
+        for s in self._sources:
+            if not remaining or not allowed.get(s.name):
+                continue
+            consulted.append(s.name)
+            got = s.draft_batch(remaining, ctxs)
+            for row in remaining:
+                d = got.get(row)
+                if d:
+                    proposals[row] = (s.name, list(d[:K]))
+            remaining = [r for r in remaining if r not in proposals]
+        # A consulted source that proposed NOTHING decays like a
+        # zero-acceptance tick: an unthrottled source is what keeps the
+        # spec path flushing the one-tick decode pipeline each
+        # iteration, so "never proposes" must back off to probes
+        # exactly like "never accepted" (a free-form stream under
+        # n-gram-only speculation otherwise ran unpipelined forever).
+        for name in consulted:
+            if not any(src == name for src, _ in proposals.values()):
+                self._spec_ema[name] *= (1 - _SPEC_EMA_ZERO_ALPHA)
+        if not proposals:
             return False
+        src_rows: dict[str, list[int]] = {s.name: [] for s in self._sources}
+        for row, (src, d) in proposals.items():
+            src_rows[src].append(row)
+            self._n_spec_proposed_src[src] += len(d)
+            drafts[row, : len(d)] = d
+            tokens[row, 1: 1 + len(d)] = d
+            max_acc[row] = min(len(d), budgets[row])
 
         self._n_decode_ticks += 1
         self._n_spec_ticks += 1
@@ -2827,10 +2999,34 @@ class BatchScheduler:
             self._ring_dev, self._rps_dev)
         acc = np.asarray(accepted)  # graftcheck: sync-ok 2xB int32 verify readback
         corr = np.asarray(correction)  # graftcheck: sync-ok same dispatch, already synced
-        n_active = sum(s is not None for s in self._slots)
-        tick_acc = float(acc.sum()) / max(1, n_active)
-        self._spec_ema = ((1 - _SPEC_EMA_ALPHA) * self._spec_ema
-                          + _SPEC_EMA_ALPHA * tick_acc)
+        # Per-source EMA update over the rows THAT source drafted this
+        # tick (a source is judged on its own proposals only — the old
+        # all-active-rows denominator let undrafted rows dilute the
+        # signal). Zero-acceptance ticks decay fast (_SPEC_EMA_ZERO_
+        # ALPHA) so a never-accepting workload stops paying verify
+        # forwards within a few ticks. Sources also roll back their
+        # state to the last accepted position here (the model drafter's
+        # KV rewind — observe()).
+        for s in self._sources:
+            rows_s = src_rows.get(s.name) or []
+            if not rows_s:
+                continue
+            n_acc = sum(int(acc[r]) for r in rows_s)
+            self._n_spec_accepted_src[s.name] += n_acc
+            tick_acc = n_acc / len(rows_s)
+            alpha = (_SPEC_EMA_ZERO_ALPHA if n_acc == 0
+                     else _SPEC_EMA_ALPHA)
+            ema = (1 - alpha) * self._spec_ema[s.name] + alpha * tick_acc
+            if tick_acc >= _SPEC_EMA_FLOOR:
+                # Probe recovery: a deeply-decayed EMA (long dry spell)
+                # would need several good probes x _SPEC_PROBE_EVERY
+                # ticks to climb back over the floor — one probe whose
+                # acceptance already clears it is the recovery signal,
+                # so re-enable immediately.
+                ema = max(ema, _SPEC_EMA_SEED)
+            self._spec_ema[s.name] = ema
+            for r in rows_s:
+                s.observe(r, int(acc[r]))
         for row, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -2863,8 +3059,10 @@ class BatchScheduler:
             slot.finish()
             return False
         slot.ids.append(tok)
-        if slot.drafter is not None:
-            slot.drafter.append(tok)
+        for s in self._sources:
+            # n-gram: extend the row's index. Model drafter: no-op here
+            # (its KV catches up lazily at the next draft dispatch).
+            s.append(row, tok)
         if slot.stats is not None:
             slot.stats.completion_tokens = len(slot.ids)
         stop_hit = self._flush_text(slot)
@@ -2968,6 +3166,11 @@ class BatchScheduler:
             for s in pc.chunk:
                 s.pages = None
                 s.fail("internal error: serving state was reset")
+        for s in self._sources:
+            # The drafter's donated cache may have been consumed by the
+            # same failed call; its per-row state maps dead rows either
+            # way — rebuild alongside the target state.
+            s.reset()
         self._reset_device_state()
 
     def _release(self, row: int) -> None:
@@ -2978,6 +3181,8 @@ class BatchScheduler:
         which must land in the garbage page, never a re-allocated one."""
         slot = self._slots[row]
         self._slots[row] = None
+        for s in self._sources:
+            s.release(row)
         if self.kv_mode == "paged" and slot is not None and slot.pages:
             try:
                 self._cache = self._zero_row_j(

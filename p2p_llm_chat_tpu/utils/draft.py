@@ -1,17 +1,127 @@
-"""Prompt-lookup drafting for speculative decoding.
+"""Draft sources for speculative decoding.
 
-Reply suggestions quote and rephrase their context heavily (the co-pilot
-prompt embeds the peer's message verbatim — web/streamlit_app.py:93), so a
-draft model is unnecessary: proposing the continuation that followed the
-most recent earlier occurrence of the current trailing n-gram gets long
-accepted runs for free. The verify pass (models/llama.verify_step +
-sampling.spec_verify_batched) scores the whole draft in one forward.
+Two draft sources live behind one scheduler-facing protocol
+(:class:`DraftSource`):
 
-The index is incremental: O(1) per generated token, last occurrence wins
-(recency beats frequency for chat text).
+- **Prompt-lookup n-grams** (:class:`NGramDrafter` per row, batched as
+  :class:`NGramSource`). Reply suggestions quote and rephrase their
+  context heavily (the co-pilot prompt embeds the peer's message
+  verbatim — web/streamlit_app.py:93), so proposing the continuation
+  that followed the most recent earlier occurrence of the current
+  trailing n-gram gets long accepted runs for FREE — no second model,
+  ~0 host cost. But it measures ~0 acceptances on free-form output
+  (models/synth.py docstring: 251/256 unique tokens), so the quote-heavy
+  statistic was the only workload where speculation won.
+- **A resident draft model** (serve/draft_model.ModelDrafter): a small
+  model on the same chip proposes K greedy tokens autoregressively —
+  the classic draft-target scheme (Leviathan et al. 2023; Chen et al.
+  2023) that generalises the win to every workload, at the cost of a
+  drafter dispatch per spec tick. It lives in serve/ (it owns device
+  state and reuses the model stack); this module holds only the
+  host-side protocol both sources implement.
+
+The scheduler consults sources in priority order per row — n-gram
+first (free when it hits), model drafts filling in on n-gram misses —
+and throttles each source independently on its own acceptance EMA
+(serve/scheduler.py), so a cold n-gram index cannot throttle model
+drafting. Either way the verify pass (models/llama.verify_step +
+sampling.spec_verify_batched) scores the whole draft in one forward;
+both sources propose point-mass (deterministic) drafts, which is what
+keeps the acceptance math distribution-exact.
+
+The n-gram index is incremental: O(1) per generated token, last
+occurrence wins (recency beats frequency for chat text).
 """
 
 from __future__ import annotations
+
+
+class DraftSource:
+    """Scheduler-facing draft-source protocol (batch-level: one instance
+    serves every batch row — the model drafter must dispatch ONE batched
+    device program per tick, not one per row, so the per-row NGramDrafter
+    shape cannot be the shared interface).
+
+    Lifecycle hooks mirror the scheduler's slot lifecycle; every method
+    is called from the scheduler thread only. ``draft_batch`` proposes
+    up to k tokens per requested row; ``observe`` reports the verify
+    outcome so stateful sources (the model drafter's KV) can roll back
+    to the last accepted position. All proposals must be DETERMINISTIC
+    functions of the row context (point-mass draft distribution) — the
+    exact-acceptance math in models/sampling.spec_verify_batched relies
+    on it."""
+
+    name: str = "?"
+
+    def admit(self, row: int, ctx: list[int]) -> None:
+        """Row entered the batch with ``ctx`` (prompt ids) as context."""
+
+    def append(self, row: int, tok: int) -> None:
+        """One token was accepted into the row's context (plain ticks,
+        accepted drafts, corrections — every emitted token)."""
+
+    def release(self, row: int) -> None:
+        """Row left the batch."""
+
+    def draft_batch(self, rows: list[int],
+                    ctxs: dict[int, tuple[list[int], list[int]]]
+                    ) -> dict[int, list[int]]:
+        """Proposals for ``rows``: row -> up to k draft tokens ([] /
+        missing = no proposal). ``ctxs[row]`` is the row's context as
+        the UNCONCATENATED ``(prompt_ids, generated_ids)`` pair — the
+        scheduler passes its live list references, so a spec tick costs
+        no per-row context copies; sources slice only what they need
+        (the model drafter: the suffix past its fed prefix)."""
+        raise NotImplementedError
+
+    def observe(self, row: int, accepted: int) -> None:
+        """Verify outcome for a row this source drafted this tick."""
+
+    def reset(self) -> None:
+        """Scheduler device-state reset — drop everything."""
+
+
+class NGramSource(DraftSource):
+    """Prompt-lookup drafting behind the batch protocol: one incremental
+    :class:`NGramDrafter` per live row."""
+
+    name = "ngram"
+
+    def __init__(self, k: int, n: int = 2) -> None:
+        self.k = k
+        self.n = n
+        self._rows: dict[int, NGramDrafter] = {}
+
+    def admit(self, row: int, ctx: list[int]) -> None:
+        self._rows[row] = NGramDrafter(ctx, self.k, n=self.n)
+
+    def append(self, row: int, tok: int) -> None:
+        d = self._rows.get(row)
+        if d is not None:
+            d.append(tok)
+
+    def release(self, row: int) -> None:
+        self._rows.pop(row, None)
+
+    def draft_batch(self, rows: list[int],
+                    ctxs: dict[int, tuple[list[int], list[int]]]
+                    ) -> dict[int, list[int]]:
+        out: dict[int, list[int]] = {}
+        for row in rows:
+            d = self._rows.get(row)
+            if d is None:
+                # Late bind (e.g. source enabled after admission): build
+                # the index from the full context once.
+                prompt, ids = ctxs[row]
+                d = self._rows[row] = NGramDrafter(list(prompt) + list(ids),
+                                                   self.k, n=self.n)
+            prop = d.draft()
+            if prop:
+                out[row] = prop
+        return out
+
+    def reset(self) -> None:
+        self._rows.clear()
 
 
 class NGramDrafter:
