@@ -105,6 +105,17 @@ Env knobs (all optional):
                         (default 384 -> a 512 bucket, two chunks)
 - ``BENCH_ARRIVAL_N``   mixed-phase arrival count (default 6)
 - ``BENCH_ARRIVAL_RATE`` mixed-phase Poisson arrival rate, 1/s (default 4)
+- ``BENCH_REPLICAS``    replica-router phase (0 = off): N >= 2 builds N
+                        full-stack engines sharing this bench's params
+                        behind serve/router.py and measures aggregate
+                        served tok/s through the router vs one replica
+                        on the same workload over real HTTP, plus
+                        routed/retried/shed counts (JSON
+                        ``replica_router`` row; docs/serving.md
+                        Round-10).
+- ``BENCH_REPLICA_SLOTS`` per-replica batch rows in that phase
+                        (default BENCH_SLOTS / BENCH_REPLICAS — fixed
+                        per-replica capacity, fleet capacity = slots)
 - ``BENCH_PROFILE``     directory for a jax.profiler trace of the
                         concurrent section
 - ``BENCH_LONG_W``      long-window decode sweep: comma list of paged
@@ -873,6 +884,137 @@ def main() -> None:
     loop_stall_ms = final_snap.get("loop_stall_ms", 0.0)
     sched.stop()
 
+    # -- replica-router phase (BENCH_REPLICAS >= 2, Round-10): N full-
+    # stack engines SHARING this bench's params (immutable device
+    # arrays — no extra weight copies) behind serve/router.py, driven
+    # over real HTTP. Measures aggregate served tok/s through the
+    # router vs the SAME workload through one replica, at fixed
+    # per-replica capacity (slots split across the fleet), plus the
+    # router's routed/retried/shed counters. Runs after the main
+    # scheduler stops so KV pools never coexist.
+    replica_router: dict = {}
+    n_replicas = env_int("BENCH_REPLICAS", 0)
+    if n_replicas >= 2:
+        import json as _json
+        import urllib.request as _urlreq
+
+        from p2p_llm_chat_tpu.serve.api import OllamaServer
+        from p2p_llm_chat_tpu.serve.engine import TPUEngine
+        from p2p_llm_chat_tpu.serve.router import (ReplicaRouter,
+                                                   parse_metrics_text)
+
+        rep_slots = max(2, env_int("BENCH_REPLICA_SLOTS",
+                                   max(2, slots // n_replicas)))
+        rep_pages = None
+        if kv_mode == "paged":
+            per_req = -(-(len(prompt) + 1 + new_tokens + spec_k + 2)
+                        // page_size) + 1
+            # Same cap as the main phase's pool sizing: a BENCH_CTX
+            # prompt longer than the row budget gets tail-truncated at
+            # admission, so pages past eff_max can never be written —
+            # N replica pools of them would just burn HBM.
+            eff_rep = min(max_seq, config.max_seq_len)
+            per_req = min(per_req, -(-eff_rep // page_size))
+            rep_pages = rep_slots * per_req + 1
+        engines = [TPUEngine(params, config, tokenizer,
+                             num_slots=rep_slots, max_seq=max_seq,
+                             kv_mode=kv_mode, page_size=page_size,
+                             num_pages=rep_pages, spec_k=spec_k,
+                             prefix_cache=use_prefix,
+                             prefix_texts=(prompt,) if use_prefix else (),
+                             kv_quant=kv_quant, decode_fuse_max=fuse_k,
+                             prefill_chunk=bench_chunk,
+                             name=cfg_name)
+                   for _ in range(n_replicas)]
+        fronts = [OllamaServer(e, addr="127.0.0.1:0").start()
+                  for e in engines]
+        router = ReplicaRouter([f.url for f in fronts],
+                               addr="127.0.0.1:0", scrape_ms=200).start()
+        for e in engines:
+            e.warmup(buckets=(pbucket,), background=False)
+
+        m_reqs = n_replicas * rep_slots     # one fleet-wide wave
+        body = _json.dumps({
+            "model": cfg_name, "prompt": prompt, "stream": False,
+            "options": {"num_predict": new_tokens,
+                        "temperature": bench_temp, "top_p": 0.9,
+                        "seed": 0}}).encode()
+
+        def drive(base: str) -> tuple[float, int]:
+            errs: list = []
+            toks = [0] * m_reqs
+
+            def worker(i: int) -> None:
+                try:
+                    rq = _urlreq.Request(
+                        f"{base}/api/generate", data=body,
+                        headers={"Content-Type": "application/json"})
+                    with _urlreq.urlopen(rq, timeout=600) as r:
+                        toks[i] = _json.loads(r.read()).get("eval_count", 0)
+                except Exception as e:      # noqa: BLE001
+                    errs.append(e)
+
+            ths = [threading.Thread(target=worker, args=(i,))
+                   for i in range(m_reqs)]
+            t0w = time.monotonic()
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            wallw = time.monotonic() - t0w
+            if errs:
+                raise RuntimeError(f"replica phase failed: {errs[:3]}")
+            return wallw, sum(toks)
+
+        # Warm-through: one unmeasured wave per replica direct (real
+        # host-path warm, both replicas' lazily-compiled windows), then
+        # measure single-replica vs routed fleet on the same workload.
+        # try/finally: a single failed wave must record an error row and
+        # release the router/fronts/engines — NOT abort the bench and
+        # lose every already-measured phase in the JSON output.
+        try:
+            for f in fronts:
+                drive(f.url)
+            wall_single, toks_single = drive(fronts[0].url)
+            wall_fleet, toks_fleet = drive(router.url)
+            with _urlreq.urlopen(f"{router.url}/metrics", timeout=10) as r:
+                rsnap = parse_metrics_text(r.read().decode())
+            routed = [rsnap.get(f'router_routed_total{{replica="{i}"}}', 0)
+                      for i in range(n_replicas)]
+            replica_router = {
+                "replicas": n_replicas,
+                "slots_per_replica": rep_slots,
+                "requests": m_reqs,
+                "single": {"served_tok_s": round(toks_single / wall_single,
+                                                 1),
+                           "tokens": toks_single,
+                           "wall_s": round(wall_single, 2)},
+                "fleet": {"served_tok_s": round(toks_fleet / wall_fleet, 1),
+                          "tokens": toks_fleet,
+                          "wall_s": round(wall_fleet, 2)},
+                "speedup": round(wall_single / wall_fleet, 3),
+                "routed": routed,
+                "retried": rsnap.get("router_retries_total", 0),
+                "shed": rsnap.get("router_requests_shed_total", 0),
+            }
+            log(f"replica router: {n_replicas}x{rep_slots} slots, fleet "
+                f"{replica_router['fleet']['served_tok_s']:,.1f} tok/s vs "
+                f"single {replica_router['single']['served_tok_s']:,.1f} "
+                f"({replica_router['speedup']}x), routed {routed}, "
+                f"retried {replica_router['retried']}, "
+                f"shed {replica_router['shed']}")
+        except Exception as e:      # noqa: BLE001 — record, don't abort
+            log(f"replica router phase FAILED: {e}")
+            replica_router = {"replicas": n_replicas,
+                              "slots_per_replica": rep_slots,
+                              "error": str(e)}
+        finally:
+            router.stop()
+            for f in fronts:
+                f.stop()
+            for eng in engines:
+                eng.stop()
+
     result = {
         "metric": f"p50_ttft_ms_{slots}_concurrent_{cfg_name}",
         "value": round(p50, 2),
@@ -930,6 +1072,11 @@ def main() -> None:
             # scheduler-loop iteration. Both 0 on a healthy run.
             "requests_shed": requests_shed,
             "loop_stall_ms": loop_stall_ms or None,
+            # Replica-router phase (BENCH_REPLICAS): aggregate served
+            # tok/s through serve/router.py over N engines vs one
+            # replica on the same workload, with the router's
+            # routed/retried/shed counters — the Round-10 scaling row.
+            "replica_router": replica_router or None,
             # Long-window sweep (BENCH_LONG_W): per (window, impl) step
             # time vs the HBM bytes bound; flash rows carry their
             # speedup over the gather path — the round-8 acceptance

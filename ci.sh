@@ -76,10 +76,19 @@ if [ "${1:-}" = "full" ]; then
   echo "== failpoint chaos suite + HTTP chaos matrix (CPU)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_failpoints.py -q || rc=1
 
+  # Replica-router serving: the WHOLE file including the slow-marked
+  # two-OS-process full-stack matrix (both replicas paged + spec +
+  # prefix behind the router: aggregate throughput vs one replica,
+  # failpoint-induced overload failover, drain semantics). Excluded
+  # from the sweep below so each case executes exactly once.
+  echo "== replica router: fast legs + two-OS-process matrix (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q || rc=1
+
   echo "== full test suite"
   python -m pytest tests/ -q \
     --ignore=tests/test_flash_append_geometry.py \
-    --ignore=tests/test_failpoints.py || rc=1
+    --ignore=tests/test_failpoints.py \
+    --ignore=tests/test_router.py || rc=1
 else
   # Fused-decode parity pinned explicitly on CPU: the K-fused-steps ≡
   # K-plain-ticks bit-identity contract (serve/scheduler.py
@@ -125,8 +134,18 @@ else
   JAX_PLATFORMS=cpu python -m pytest tests/test_spec_draft.py -q -x \
     -m 'not slow' || rc=1
 
+  # Replica-router serving (tier-1 legs): routing/failover/drain/
+  # affinity/metrics-aggregation contracts over in-process FakeLLM
+  # replicas plus the engine-level drain hook — the slow-marked
+  # two-OS-process full-stack matrix runs in full mode. Excluded from
+  # the sweep below so each case executes exactly once.
+  echo "== replica router contracts (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q -x \
+    -m 'not slow' || rc=1
+
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
+    --ignore=tests/test_router.py \
     --ignore=tests/test_spec_draft.py \
     --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_chunked_prefill.py \

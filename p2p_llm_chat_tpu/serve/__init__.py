@@ -14,9 +14,13 @@ an in-tree backend so ``OLLAMA_URL`` can point here unchanged:
                      paged KV cache)
 - :mod:`scheduler` — continuous batching: all peers' suggestion requests
                      merged into one TPU decode loop
+- :mod:`router`    — replica-router mode: N independent full-stack
+                     engines behind one backpressure-aware front
 """
 
 from .backend import Backend, FakeLLM, GenerateOptions, GenerateRequest
 from .api import OllamaServer
+from .router import ReplicaRouter
 
-__all__ = ["Backend", "FakeLLM", "GenerateOptions", "GenerateRequest", "OllamaServer"]
+__all__ = ["Backend", "FakeLLM", "GenerateOptions", "GenerateRequest",
+           "OllamaServer", "ReplicaRouter"]

@@ -116,6 +116,15 @@ class OllamaServer:
         # real requests' TTFT).
         self.router.add("GET", "/healthz", lambda r: Response(200, {"status": "ok"}))
         self.router.add("GET", "/readyz", self._readyz)
+        # Drain hooks (replica-router mode, serve/router.py): draining
+        # finishes in-flight streams but refuses new sessions and flips
+        # /readyz, so a balancer retires this replica gracefully.
+        # Front-level flag covers backends without their own drain()
+        # (FakeLLM); engine backends ALSO drain their scheduler so
+        # direct submits shed too.
+        self._draining = threading.Event()
+        self.router.add("POST", "/admin/drain", self._drain)
+        self.router.add("POST", "/admin/undrain", self._undrain)
         self._server: Optional[HttpServer] = None
 
     # -- helpers -------------------------------------------------------------
@@ -123,7 +132,12 @@ class OllamaServer:
     def _readyz(self, req: Request) -> Response:
         """Readiness: backends exposing ``ready()`` (the TPU engine —
         warmup-gated; multi-model fronts AND their engines) gate the
-        answer; backends without it (FakeLLM) are ready when live."""
+        answer; backends without it (FakeLLM) are ready when live.
+        Draining (the replica-router retire path) is not-ready with its
+        own status so an operator can tell it from warming."""
+        if self._draining.is_set():
+            return Response(503, {"status": "draining"},
+                            headers={"Retry-After": "5"})
         fn = getattr(self.backend, "ready", None)
         try:
             ok = bool(fn()) if callable(fn) else True
@@ -134,6 +148,48 @@ class OllamaServer:
             return Response(200, {"status": "ready"})
         return Response(503, {"status": "warming"},
                         headers={"Retry-After": "2"})
+
+    def _drain(self, req: Request) -> Response:
+        """POST /admin/drain: stop taking new sessions (503 + Retry-After
+        on new requests; /readyz flips to draining), finish in-flight
+        streams. The backend's own drain hook (engine -> scheduler)
+        runs too, so submits that bypass this front shed as well."""
+        self._draining.set()
+        fn = getattr(self.backend, "drain", None)
+        if callable(fn):
+            fn()
+        log.info("draining: new sessions refused, in-flight streams "
+                 "finishing")
+        return Response(200, {"status": "draining"})
+
+    def _undrain(self, req: Request) -> Response:
+        self._draining.clear()
+        fn = getattr(self.backend, "undrain", None)
+        if callable(fn):
+            fn()
+        log.info("undrained: accepting new sessions")
+        return Response(200, {"status": "ready"})
+
+    def _shed_if_draining(self, count: bool = True) -> Optional[Response]:
+        """Front-level drain shed for every work-accepting endpoint
+        (generate/chat AND embed — the embed path never passes through
+        scheduler.submit, so the scheduler-level drain alone would leave
+        a whole endpoint class accepting new work on a retiring
+        replica). Engine backends also shed at the scheduler; backends
+        without a drain hook (FakeLLM) are covered here alone.
+
+        ``count=False`` (the embed paths): embeds never move
+        serve_requests_total, so moving serve_requests_shed_total for
+        them would break the shed <= requests invariant dashboards
+        divide by — their drain 503s stay visible via the
+        ``serve_draining`` gauge and /readyz instead."""
+        if not self._draining.is_set():
+            return None
+        if count:
+            self._m_shed.inc()
+        return Response(503, {"error": "server is draining; retry "
+                                       "elsewhere"},
+                        headers={"Retry-After": "5"})
 
     def _resolve(self, model: str):
         """Backend for a request's model tag: multi-model backends
@@ -237,6 +293,15 @@ class OllamaServer:
         self._m_requests.inc()
         self._m_inflight.add(1)
         started = time.monotonic()
+
+        # Drain shed AFTER the request counters move, exactly like the
+        # scheduler's OverloadError path below — a drain must not make
+        # serve_requests_shed_total climb while serve_requests_total
+        # stays flat (shed-ratio dashboards would read >100%).
+        shed = self._shed_if_draining()
+        if shed is not None:
+            self._m_inflight.add(-1)
+            return shed
 
         # Submit happens HERE, before the stream/non-stream split: the
         # scheduler's overload check is eager (fast-fail shedding), so a
@@ -380,6 +445,9 @@ class OllamaServer:
             body = req.json() or {}
         except ValueError:
             return Response(400, {"error": "invalid json"})
+        shed = self._shed_if_draining(count=False)
+        if shed is not None:
+            return shed
         model = str(body.get("model") or self.backend.name)
         fn = getattr(self._resolve(model), "embed", None)
         if fn is None:
@@ -415,6 +483,9 @@ class OllamaServer:
             body = req.json() or {}
         except ValueError:
             return Response(400, {"error": "invalid json"})
+        shed = self._shed_if_draining(count=False)
+        if shed is not None:
+            return shed
         fn = getattr(self._resolve(str(body.get("model")
                                        or self.backend.name)),
                      "embed", None)
@@ -469,7 +540,25 @@ class OllamaServer:
 
 def main() -> None:
     """Entry point: serve FakeLLM (real engine wiring arrives with
-    serve.engine; SERVE_BACKEND=fake|tpu selects)."""
+    serve.engine; SERVE_BACKEND=fake|tpu selects).
+
+    Multi-host mode switch (docs/serving.md Round-10): setting
+    ``SERVE_ROUTER_UPSTREAMS`` starts the replica router
+    (serve/router.py — N independent full-stack engines, this process
+    only routes); setting ``SERVE_COORDINATOR`` starts the lockstep
+    SPMD plane (serve/multihost.py — one model instance spanning
+    hosts). They are alternatives; configuring both is a boot error
+    rather than a silent pick."""
+    ups = env_or("SERVE_ROUTER_UPSTREAMS", "")
+    if ups:
+        if env_or("SERVE_COORDINATOR", ""):
+            raise SystemExit(
+                "SERVE_ROUTER_UPSTREAMS and SERVE_COORDINATOR are "
+                "mutually exclusive modes (replica-router vs lockstep "
+                "SPMD); set exactly one")
+        from .router import build_router_from_env
+        build_router_from_env().serve_forever()
+        return
     from .backend import FakeLLM
     backend_kind = env_or("SERVE_BACKEND", "fake")
     if backend_kind == "fake":

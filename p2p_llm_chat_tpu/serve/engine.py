@@ -272,6 +272,17 @@ class TPUEngine:
         merged into the API front's /metrics (serve/api.py)."""
         return self.scheduler.metrics_snapshot()
 
+    def drain(self) -> None:
+        """Replica drain hook (serve/router.py): finish in-flight
+        streams, refuse new sessions, report not-ready on /readyz."""
+        self.scheduler.drain()
+
+    def undrain(self) -> None:
+        self.scheduler.undrain()
+
+    def draining(self) -> bool:
+        return self.scheduler.draining
+
     def stop(self) -> None:
         self.scheduler.stop()
 

@@ -93,6 +93,20 @@ class MultiBackend:
             if fn is not None:
                 fn(*args, **kwargs)
 
+    def drain(self) -> None:
+        """Replica drain (serve/router.py): draining the front drains
+        EVERY engine — the replica retires as a unit, not per tag."""
+        for b in self.backends.values():
+            fn = getattr(b, "drain", None)
+            if fn is not None:
+                fn()
+
+    def undrain(self) -> None:
+        for b in self.backends.values():
+            fn = getattr(b, "undrain", None)
+            if fn is not None:
+                fn()
+
     def stop(self) -> None:
         for b in self.backends.values():
             fn = getattr(b, "stop", None)

@@ -56,6 +56,13 @@ Env surface: ``SERVE_COORDINATOR`` (host:port of process 0; or the
 ``SERVE_TP`` for the slice-local tp axis, ``SERVE_MH_WINDOW_MS`` for
 the admission window (default 25 ms). serve/api.py's main() runs the
 HTTP front on the leader and ``follower_loop()`` on everyone else.
+
+Mode selection (docs/serving.md Round-10): this lockstep plane is for
+meshes one model instance must SPAN. When the model fits a single
+host — the common case — run N independent full-stack engines behind
+``serve/router.py`` instead (``SERVE_ROUTER_UPSTREAMS``): every
+feature above returns, and throughput scales with replicas without a
+broadcast protocol. The two modes are mutually exclusive per process.
 """
 
 from __future__ import annotations
@@ -64,6 +71,7 @@ import functools
 import queue
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Iterator, Optional
 
@@ -402,16 +410,20 @@ class MultihostEngine:
             self._stopped.set()
 
     def _dispatch_loop_inner(self) -> None:
-        # An item displaced out of a round (embed / unbounded / shutdown
-        # encountered mid-fill) is HELD as the next round's head, never
-        # re-queued to the back — a put() would park it behind every
+        # Items displaced out of a round (embed / unbounded / shutdown
+        # encountered mid-fill) are HELD as the next rounds' heads, never
+        # re-queued to the back — a put() would park them behind every
         # newly arrived request, and sustained bounded traffic could
-        # then starve it indefinitely (re-encountered and re-queued
-        # every round). Holding it bounds the wait to one round.
-        held = None
+        # then starve them indefinitely (re-encountered and re-queued
+        # every round). Holding bounds the wait to one round. A deque
+        # (not a single slot): holding must not TRUNCATE the batch being
+        # filled — an embed racing into a 4-generate admission window
+        # once cut the round at one row and stranded an odd generate
+        # behind a full extra window (measured as the batched-throughput
+        # bar failing by exactly one window).
+        held: deque = deque()
         while True:
-            item = held if held is not None else self._q.get()
-            held = None
+            item = held.popleft() if held else self._q.get()
             if item is _SHUTDOWN:
                 try:
                     cmd = np.zeros((self._cmd_size,), np.int32)
@@ -463,14 +475,20 @@ class MultihostEngine:
                     nxt = self._q.get(timeout=left)
                 except queue.Empty:
                     break
-                if (nxt is _SHUTDOWN or isinstance(nxt, _PendingEmbed)
-                        or nxt.unbounded):
-                    # Different program, exit, or an unbounded request
-                    # (solo round by policy): never co-batched with
-                    # these rows — hold it as the NEXT round's head and
-                    # run this batch first (see the loop-head note).
-                    held = nxt
+                if nxt is _SHUTDOWN:
+                    # Exit: stop filling, run this batch, shut down on
+                    # the next loop head (after any earlier-held items).
+                    held.append(nxt)
                     break
+                if isinstance(nxt, _PendingEmbed) or nxt.unbounded:
+                    # Different program or an unbounded request (solo
+                    # round by policy): never co-batched with these rows
+                    # — hold it for its own round and KEEP filling this
+                    # batch (breaking here would truncate the round and
+                    # strand later bounded arrivals behind an extra
+                    # admission window each).
+                    held.append(nxt)
+                    continue
                 batch.append(nxt)
             try:
                 results = self._run_cmd(self._broadcast(self._pack(batch)))

@@ -1,0 +1,784 @@
+"""Replica-router serving: N full-stack engines behind one HTTP front.
+
+The production data-parallel architecture (ROADMAP top item): instead of
+the feature-stripped lockstep plane (serve/multihost.py), each replica
+is a fully independent single-host engine — paged KV, chunked prefill,
+fused-K decode, speculation, prefix cache, the whole stack — and this
+router load-balances *distinct* requests across them. No broadcast
+protocol, no lockstep invariant: throughput scales with replica count
+because replicas never coordinate.
+
+Mode selection (documented in docs/serving.md Round-10): replica-router
+when the model fits one host — run N replicas, point the router at them
+(``SERVE_ROUTER_UPSTREAMS``); lockstep SPMD (``SERVE_COORDINATOR``)
+only when a single model instance must span hosts.
+
+Routing policy (backpressure-aware, built on the PR-5 overload signals):
+
+- **Eligibility**: a replica takes new work only when its ``/readyz``
+  answered ready at the last scrape and it is not draining. A replica
+  whose scrape fails goes not-alive until a scrape succeeds again.
+- **Weighting**: among eligible replicas, pick the lowest load score =
+  live queue depth (scraped from the replica's ``/metrics``
+  ``serve_queue_depth``) + the router's own in-flight count toward that
+  replica + a saturation penalty while the replica's
+  ``requests_shed_total`` is still climbing between scrapes.
+- **Retry**: a 503 (the replica's fast-fail shed) or a connection error
+  moves the request to the next-best replica immediately — each retry
+  is counted via utils/backoff.note_retry (the shared
+  ``retry_attempts_total`` series). A fully-saturated fleet exhausts
+  the candidate list without sleeping and answers 503 + Retry-After in
+  milliseconds (the min Retry-After the replicas advertised) — the
+  router never burns a client's deadline waiting out backpressure.
+- **Session affinity**: a conversation id (explicit ``session`` field /
+  ``X-Session-Id`` header, else derived from the chat history head or
+  the /api/generate ``context`` ids) pins a session to its home
+  replica, keeping its paged KV and prefix-cache hits local. A
+  draining/unready home rehomes the session to the best eligible
+  replica.
+- **Draining**: ``POST /admin/drain`` marks a replica draining — no new
+  sessions route there, existing streams (proxied connections) finish —
+  and forwards the drain to the replica's own ``/admin/drain`` so its
+  ``/readyz`` flips for any other balancer watching it.
+  ``POST /admin/undrain`` reverses both.
+
+``/metrics`` aggregates every replica's scrape — per-replica series get
+a ``replica="i"`` label merged with the same brace-block discipline
+serve/multi.py established for model labels (so model-labeled series
+from a multi-model replica nest correctly), and unsuffixed fleet totals
+are the sums over replicas — plus the router's own counters. Fleet
+``/readyz`` is ready when ANY replica is eligible; ``/healthz`` is the
+router process's own liveness.
+
+Env surface (utils/env.py helpers; flag table in docs/serving.md):
+``SERVE_ROUTER_UPSTREAMS`` (comma-separated replica base URLs — setting
+it makes serve.api main() start this router instead of an engine),
+``SERVE_ADDR`` (listen address, same flag as the single front),
+``SERVE_ROUTER_SCRAPE_MS`` (readiness/metrics poll interval),
+``SERVE_ROUTER_RETRIES`` (max distinct replicas tried per request; 0 =
+every eligible replica), ``SERVE_ROUTER_AFFINITY`` (session affinity
+on/off), ``SERVE_ROUTER_TIMEOUT_S`` (per-proxied-request upstream
+timeout). The launcher path (``SERVE_REPLICAS=N`` in start_all.py)
+spawns N replica processes and wires this router in front of them.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from ..utils import backoff as _backoff
+from ..utils.env import env_bool, env_float, env_int, env_or
+from ..utils.http import HttpServer, Request, Response, Router
+from ..utils.log import get_logger
+from ..utils.metrics import Registry
+
+log = get_logger("serve.router")
+
+# Saturation penalty: a replica still shedding between scrapes competes
+# as if this many requests were queued — enough to lose to any healthy
+# replica, finite so a fleet that is ALL shedding still gets a
+# deterministic order.
+_SHED_PENALTY = 1000.0
+
+# Gauges whose fleet-wide SUM is meaningful (capacity/occupancy/depth —
+# additive across replicas). Everything else that is not a counter stays
+# per-replica only: summing a p50 quantile sample or a config gauge like
+# paged_flash_min_w would publish fabricated numbers under the real
+# series names.
+_ADDITIVE_GAUGES = frozenset((
+    "serve_queue_depth", "serve_inflight_requests",
+    "serve_batch_occupancy", "serve_batch_slots",
+    "serve_kv_free_pages", "serve_kv_total_pages",
+))
+
+
+def _fleet_additive(series: str) -> bool:
+    """May this series be summed into an unlabeled fleet total?
+    Counters (``*_total``) and histogram ``_count``/``_sum`` components
+    are additive by construction; gauges only from the allowlist;
+    quantile samples never."""
+    if '{quantile="' in series:
+        return False
+    base = series.split("{", 1)[0]
+    if base.endswith(("_total", "_count", "_sum")):
+        return True
+    return base in _ADDITIVE_GAUGES
+
+
+@dataclass
+class _Replica:
+    """One upstream engine's routing state.
+
+    ``url``/``index`` are immutable; every mutable field is part of the
+    router's replica-state table and is read/written only under the
+    OWNING router's ``_mu`` (the scrape thread and request threads both
+    touch it). The guard lives on another object, which the per-class
+    ``# guarded-by:`` grammar cannot express — the router's own tables
+    (``_sessions``, ``_rr``) carry the machine-checked annotations, and
+    every access to these fields in router.py sits inside a
+    ``with self._mu:`` block there."""
+
+    url: str
+    index: int
+    alive: bool = False
+    ready: bool = False
+    draining: bool = False
+    queue_depth: float = 0.0
+    shed_total: float = -1.0
+    shedding: bool = False
+    inflight: int = 0
+    routed: int = 0
+    retried_to: int = 0
+    last_scrape_s: float = 0.0
+
+    def snapshot(self) -> dict:
+        return {"url": self.url, "index": self.index, "alive": self.alive,
+                "ready": self.ready, "draining": self.draining,
+                "queue_depth": self.queue_depth,
+                "inflight": self.inflight, "routed": self.routed,
+                "retried_to": self.retried_to,
+                "shedding": self.shedding}
+
+
+class _Upstream:
+    """One proxied upstream response: status/headers plus a body source
+    that can be drained whole or streamed chunk-by-chunk."""
+
+    def __init__(self, status: int, headers, resp) -> None:
+        self.status = status
+        self.headers = headers
+        self._resp = resp
+
+    def read_all(self) -> bytes:
+        with self._resp:
+            return self._resp.read()
+
+    def iter_chunks(self, size: int = 16384) -> Iterator[bytes]:
+        # http.client transparently de-chunks Transfer-Encoding: chunked;
+        # re-chunking happens in utils/http's stream writer. read1(), NOT
+        # read(): read(n) on a chunked response LOOPS across chunk
+        # boundaries accumulating until n bytes or end-of-stream — for
+        # any completion under n bytes that buffers the ENTIRE generation
+        # and forwards nothing until it finishes, silently destroying
+        # token-by-token streaming (TTFT through the router == total
+        # time). read1 returns after at most one underlying chunk.
+        # A mid-read upstream failure propagates and truncates the
+        # client stream — the same "failure looks truncated, never
+        # well-formed" contract HttpServer applies to local streams.
+        with self._resp:
+            read1 = getattr(self._resp, "read1", None)
+            while True:
+                chunk = read1(size) if read1 else self._resp.read(size)
+                if not chunk:
+                    return
+                yield chunk
+
+
+def parse_metrics_text(text: str) -> "OrderedDict[str, float]":
+    """Prometheus exposition -> ordered {series: value}. Series keys keep
+    their label block verbatim (``name{a="b"}``); comment/TYPE lines are
+    skipped. Order is preserved so aggregated output groups stably."""
+    out: "OrderedDict[str, float]" = OrderedDict()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        # Split on the LAST space: label values may contain spaces.
+        name, _, value = line.rpartition(" ")
+        if not name:
+            continue
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def _merge_label(series: str, label: str) -> str:
+    """Merge ``label`` (e.g. ``replica="0"``) into a series key, reusing
+    an existing brace block — a second ``{}`` suffix would be malformed
+    exposition and break the whole scrape (the serve/multi.py model-label
+    discipline)."""
+    if series.endswith("}"):
+        return f"{series[:-1]},{label}}}"
+    return f"{series}{{{label}}}"
+
+
+class ReplicaRouter:
+    """Backpressure-aware request router over N replica serve fronts."""
+
+    def __init__(self, upstreams: list[str], addr: Optional[str] = None,
+                 scrape_ms: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 affinity: Optional[bool] = None,
+                 timeout_s: Optional[float] = None,
+                 registry: Optional[Registry] = None) -> None:
+        if not upstreams:
+            raise ValueError("need at least one replica URL")
+        self.addr_cfg = (addr if addr is not None
+                         else env_or("SERVE_ADDR", "127.0.0.1:11434"))
+        self.replicas = [
+            _Replica(url=u.rstrip("/"), index=i)
+            for i, u in enumerate(upstreams)]
+        self._mu = threading.Lock()
+        # Session-affinity table: conversation id -> home replica index,
+        # LRU-bounded (an unbounded dict would grow one entry per
+        # conversation forever).
+        self._sessions: "OrderedDict[str, int]" = OrderedDict()  # guarded-by: _mu
+        self._session_cap = 4096
+        self._rr = 0                 # guarded-by: _mu (tiebreak rotation)
+        self.scrape_s = max(0.05, (scrape_ms if scrape_ms is not None else
+                                   env_float("SERVE_ROUTER_SCRAPE_MS",
+                                             500.0)) / 1000.0)
+        r = (retries if retries is not None
+             else env_int("SERVE_ROUTER_RETRIES", 0))
+        # 0 = try every replica once; N bounds the distinct replicas
+        # tried per request.
+        self.max_attempts = r if r > 0 else len(self.replicas)
+        self.affinity = (affinity if affinity is not None
+                         else env_bool("SERVE_ROUTER_AFFINITY", True))
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else env_float("SERVE_ROUTER_TIMEOUT_S", 300.0))
+        self.metrics = registry or Registry()
+        self._m_requests = self.metrics.counter("router_requests_total")
+        self._m_retries = self.metrics.counter("router_retries_total")
+        self._m_shed = self.metrics.counter("router_requests_shed_total")
+        self._m_errors = self.metrics.counter("router_errors_total")
+
+        self.router = Router()
+        # The Ollama wire contract, proxied: generation endpoints route
+        # by load/affinity; metadata endpoints go to the first eligible
+        # replica (replicas serve identical model sets).
+        for ep in ("/api/generate", "/api/chat"):
+            self.router.add("POST", ep, self._route_generate)
+        for ep in ("/api/embed", "/api/embeddings", "/api/show"):
+            self.router.add("POST", ep, self._route_any)
+        for ep in ("/api/tags", "/api/ps"):
+            self.router.add("GET", ep, self._route_any)
+        # Version answers locally (static — same string as the replica
+        # fronts): health probes must not 503 while the fleet warms.
+        self.router.add("GET", "/api/version", lambda r: Response(
+            200, {"version": "0.1.0-p2p-llm-chat-tpu"}))
+        for ep in ("/api/pull", "/api/push", "/api/create", "/api/copy"):
+            self.router.add("POST", ep, self._route_any)
+        self.router.add("DELETE", "/api/delete", self._route_any)
+        self.router.add("GET", "/", lambda r: Response(
+            200, "Ollama is running", content_type="text/plain"))
+        self.router.add("HEAD", "/", lambda r: Response(200, ""))
+        self.router.add("GET", "/healthz",
+                        lambda r: Response(200, {"status": "ok"}))
+        self.router.add("GET", "/readyz", self._readyz)
+        self.router.add("GET", "/metrics", self._metrics)
+        self.router.add("GET", "/admin/replicas", self._admin_replicas)
+        self.router.add("POST", "/admin/drain", self._admin_drain)
+        self.router.add("POST", "/admin/undrain", self._admin_undrain)
+
+        self._closed = threading.Event()
+        self._scrape_thread = threading.Thread(
+            target=self._scrape_loop, daemon=True, name="router-scrape")
+        self._server: Optional[HttpServer] = None
+        # First scrape inline so the router boots with a live view
+        # instead of an all-dead table until the poller's first tick.
+        self._scrape_all()
+        self._scrape_thread.start()
+
+    # -- replica state -------------------------------------------------------
+
+    def _scrape_all(self) -> None:
+        # Parallel: a slow/blackholed replica costs its own 2 s timeout,
+        # never delaying the OTHER replicas' readiness/drain/queue-depth
+        # view past the scrape interval — the routing table must stay
+        # fresh precisely when part of the fleet is misbehaving.
+        results: dict = {}
+
+        def scrape(rep: _Replica) -> None:
+            results[rep.index] = self._scrape_one(rep.url)
+
+        threads = [threading.Thread(target=scrape, args=(rep,))
+                   for rep in self.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5.0)
+        for rep in self.replicas:
+            if rep.index not in results:
+                continue
+            ready, depth, shed = results[rep.index]
+            now = time.monotonic()
+            with self._mu:
+                rep.alive = ready is not None
+                rep.ready = bool(ready)
+                rep.last_scrape_s = now
+                if depth is not None:
+                    rep.queue_depth = depth
+                if shed is not None:
+                    # Shedding = the counter moved since the last scrape:
+                    # the replica hit its queue bound within one scrape
+                    # interval, so routing more there is known-futile.
+                    rep.shedding = (rep.shed_total >= 0
+                                    and shed > rep.shed_total)
+                    rep.shed_total = shed
+                else:
+                    # No counter signal (unreachable, or a backend that
+                    # doesn't export it): don't penalize forever — a 503
+                    # on the request path re-flags it within one try.
+                    rep.shedding = False
+
+    def _scrape_one(self, url: str):
+        """(ready, queue_depth, shed_total) — ready None = unreachable.
+        The readiness probe and the metrics fetch fail INDEPENDENTLY: a
+        replica whose /readyz just answered 200 stays routable when only
+        its /metrics times out (stale depth/shed values persist) —
+        collapsing that into "unreachable" once idled a healthy replica
+        behind a transient exposition stall."""
+        try:
+            req = urllib.request.Request(f"{url}/readyz")
+            try:
+                with urllib.request.urlopen(req, timeout=2.0) as r:
+                    ready = r.status == 200
+            except urllib.error.HTTPError as e:
+                e.close()
+                ready = False       # 503 warming/draining: alive, not ready
+        except Exception:   # noqa: BLE001 — probe failure = unreachable
+            return None, None, None
+        try:
+            with urllib.request.urlopen(f"{url}/metrics", timeout=2.0) as r:
+                snap = parse_metrics_text(r.read().decode("utf-8", "replace"))
+        except Exception:   # noqa: BLE001 — keep stale depth/shed
+            return ready, None, None
+
+        def total(base: str):
+            """Sum the base series across label sets: a multi-model
+            replica exports ONLY ``{model="tag"}``-labeled series
+            (serve/multi.py relabels everything), so reading the
+            unlabeled key alone would leave the queue-depth
+            weighting and shed penalty silently inert there."""
+            vals = [v for k, v in snap.items()
+                    if k == base or k.startswith(base + "{")]
+            return sum(vals) if vals else None
+
+        return ready, total("serve_queue_depth"), \
+            total("requests_shed_total")
+
+    def _scrape_loop(self) -> None:
+        # Per-replica scrape failures back off implicitly via the fixed
+        # interval; the loop itself must never die (a dead poller would
+        # freeze the routing table on a stale view).
+        while not self._closed.wait(self.scrape_s):
+            try:
+                self._scrape_all()
+            except Exception:   # noqa: BLE001
+                log.exception("scrape loop iteration failed")
+
+    def _eligible(self) -> list[_Replica]:
+        """Replicas that may take NEW work, best-first: ready, not
+        draining, ordered by load score (queue depth + router inflight +
+        shed penalty). Equal scores tiebreak on a rotating index so a
+        burst of instant requests (depth never visibly moves) still
+        spreads across the fleet instead of piling on replica 0."""
+        with self._mu:
+            self._rr += 1
+            rot = self._rr
+            n = len(self.replicas)
+            cands = [r for r in self.replicas if r.ready and not r.draining]
+            scored = sorted(
+                cands,
+                key=lambda r: (r.queue_depth + r.inflight
+                               + (_SHED_PENALTY if r.shedding else 0.0),
+                               (r.index + rot) % n))
+        return scored
+
+    # -- session affinity ----------------------------------------------------
+
+    @staticmethod
+    def session_key(path: str, body: dict,
+                    headers: dict[str, str]) -> Optional[str]:
+        """Conversation id for affinity. Explicit wins (``X-Session-Id``
+        header or a ``session`` body field — both ignored by replicas);
+        else /api/chat derives it from the FIRST message (constant
+        across a conversation's turns, unlike the latest one) and
+        /api/generate from the ``context`` head ids (the stateless-
+        continuation round trip carries them back every turn). One-shot
+        prompts get no key and ride pure load balancing."""
+        sid = headers.get("x-session-id") or body.get("session")
+        if sid:
+            return str(sid)
+        if path == "/api/chat":
+            # Key on the first TWO messages, not just the first: apps
+            # send a fixed system prompt as message 0, and keying on it
+            # alone would hash EVERY conversation to one session and
+            # serialize the fleet onto a single home replica. The first
+            # two (system + first user turn, or first user + first
+            # assistant reply) are stable across a conversation's later
+            # turns, and conversations they DO collide on share their
+            # whole opening prefix — co-locating those is prefix-cache
+            # locality, not a hotspot.
+            msgs = body.get("messages")
+            if isinstance(msgs, list) and msgs:
+                parts = [f"{m.get('role')}:{m.get('content')}"
+                         for m in msgs[:2] if isinstance(m, dict)]
+                if parts:
+                    return hashlib.sha1(
+                        "\x1f".join(parts).encode()).hexdigest()[:16]
+            return None
+        ctx = body.get("context")
+        if isinstance(ctx, (list, tuple)) and ctx:
+            head = ",".join(str(t) for t in ctx[:32])
+            return hashlib.sha1(head.encode()).hexdigest()[:16]
+        return None
+
+    def _candidates(self, session: Optional[str]) -> list[_Replica]:
+        """Routing order: the session's home replica first when it is
+        still eligible; else best-score order (and the session rehomes
+        to whichever replica ends up serving it)."""
+        order = self._eligible()
+        if session is None or not self.affinity or not order:
+            return order
+        with self._mu:
+            home = self._sessions.get(session)
+            if home is not None:
+                self._sessions.move_to_end(session)
+        if home is not None:
+            for i, r in enumerate(order):
+                if r.index == home:
+                    return [order[i]] + order[:i] + order[i + 1:]
+        return order
+
+    def _note_served(self, session: Optional[str], rep: _Replica) -> None:
+        if session is None or not self.affinity:
+            return
+        with self._mu:
+            self._sessions[session] = rep.index
+            self._sessions.move_to_end(session)
+            while len(self._sessions) > self._session_cap:
+                self._sessions.popitem(last=False)
+
+    # -- proxying ------------------------------------------------------------
+
+    def _open(self, rep: _Replica, req: Request) -> _Upstream:
+        headers = {}
+        ct = req.headers.get("content-type")
+        if ct:
+            headers["Content-Type"] = ct
+        sid = req.headers.get("x-session-id")
+        if sid:
+            headers["X-Session-Id"] = sid
+        up = urllib.request.Request(
+            f"{rep.url}{req.path}", data=req.body or None,
+            headers=headers, method=req.method)
+        try:
+            resp = urllib.request.urlopen(up, timeout=self.timeout_s)
+            return _Upstream(resp.status, resp.headers, resp)
+        except urllib.error.HTTPError as e:
+            # Non-2xx with a well-formed body (including the replica's
+            # 503 shed): HTTPError IS the response object.
+            return _Upstream(e.code, e.headers, e)
+
+    def _respond(self, upstream: _Upstream, rep: _Replica,
+                 on_done) -> Response:
+        """Upstream -> client response; streams pass through chunk-wise.
+        ``on_done`` runs exactly once when the response is fully
+        delivered (or the stream ends either way)."""
+        ctype = upstream.headers.get("Content-Type") or "application/json"
+        is_stream = (upstream.headers.get("Transfer-Encoding") == "chunked"
+                     or "ndjson" in ctype)
+        if not is_stream:
+            try:
+                body = upstream.read_all()
+            finally:
+                on_done()
+            return Response(upstream.status, body, content_type=ctype)
+
+        def passthrough() -> Iterator[bytes]:
+            try:
+                yield from upstream.iter_chunks()
+            finally:
+                on_done()
+
+        return Response(upstream.status, stream=passthrough(),
+                        content_type=ctype)
+
+    def _try_replicas(self, req: Request,
+                      session: Optional[str]) -> Response:
+        """Route with retry: walk the candidate list (home replica
+        first), moving on at a 503 shed or a connection failure. No
+        sleeping anywhere on this path — a fully-saturated fleet must
+        answer 503 + Retry-After in milliseconds, not after a backoff
+        ladder (the CLIENT owns the retry delay; Retry-After tells it
+        how long)."""
+        self._m_requests.inc()
+        cands = self._candidates(session)[: self.max_attempts]
+        if not cands:
+            self._m_shed.inc()
+            return Response(
+                503, {"error": "no replica ready"},
+                headers={"Retry-After": "2"})
+        retry_after = None
+        last_error = None
+        for attempt, rep in enumerate(cands):
+            if attempt:
+                # Each failover is a retry against the fleet — counted
+                # on the shared utils/backoff series so router failovers
+                # and control-plane retries read on one scale.
+                _backoff.note_retry()
+                self._m_retries.inc()
+                with self._mu:
+                    rep.retried_to += 1
+            with self._mu:
+                rep.inflight += 1
+                rep.routed += 1
+            done = threading.Event()
+
+            def on_done(rep=rep, done=done) -> None:
+                if not done.is_set():
+                    done.set()
+                    with self._mu:
+                        rep.inflight -= 1
+            try:
+                upstream = self._open(rep, req)
+            except Exception as e:  # noqa: BLE001 — connection-level failure
+                on_done()
+                with self._mu:
+                    rep.alive = False
+                    rep.ready = False
+                log.warning("replica %d (%s) unreachable: %s",
+                            rep.index, rep.url, e)
+                continue
+            if upstream.status == 503:
+                ra = upstream.headers.get("Retry-After")
+                try:
+                    if ra is not None:
+                        ra_f = float(ra)
+                        retry_after = (ra_f if retry_after is None
+                                       else min(retry_after, ra_f))
+                except ValueError:
+                    pass
+                upstream.read_all()
+                on_done()
+                with self._mu:
+                    rep.shedding = True
+                continue
+            if upstream.status >= 500 and upstream.status != 501:
+                # Replica-side failure (e.g. an armed
+                # serve.scheduler.admit failpoint surfacing as a 500):
+                # the request produced no client-visible output, so
+                # failing over is safe and lands it on a healthy
+                # replica. 501 is excluded — it is a deliberate ANSWER
+                # (unsupported model-management endpoints), identical on
+                # every replica. Remember the body: if every replica
+                # 5xxs the same way, the client gets the real error, not
+                # a fabricated shed.
+                ctype = (upstream.headers.get("Content-Type")
+                         or "application/json")
+                last_error = (upstream.status, upstream.read_all(), ctype)
+                on_done()
+                self._m_errors.inc()
+                log.warning("replica %d (%s) answered %d on %s; failing "
+                            "over", rep.index, rep.url, upstream.status,
+                            req.path)
+                continue
+            self._note_served(session, rep)
+            return self._respond(upstream, rep, on_done)
+        if retry_after is None and last_error is not None:
+            status, body, ctype = last_error
+            return Response(status, body, content_type=ctype)
+        self._m_shed.inc()
+        return Response(
+            503, {"error": "all replicas at capacity; retry later"},
+            headers={"Retry-After": str(max(1, round(retry_after or 1)))})
+
+    # -- handlers ------------------------------------------------------------
+
+    def _route_generate(self, req: Request) -> Response:
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        if not isinstance(body, dict):
+            return Response(400, {"error": "request body must be an object"})
+        session = self.session_key(req.path, body, req.headers)
+        return self._try_replicas(req, session)
+
+    def _route_any(self, req: Request) -> Response:
+        return self._try_replicas(req, None)
+
+    def _readyz(self, req: Request) -> Response:
+        """Fleet readiness: ready when ANY replica can take new work."""
+        if self._eligible():
+            return Response(200, {"status": "ready"})
+        return Response(503, {"status": "no replica ready"},
+                        headers={"Retry-After": "2"})
+
+    def _metrics(self, req: Request) -> Response:
+        """Aggregate /metrics: the router's own registry, each replica's
+        scrape relabeled ``replica="i"``, and unsuffixed fleet totals
+        (sum over replicas). TYPE lines key on base names, once."""
+        text = self.metrics.render()
+        with self._mu:
+            reps = [(r.index, r.url, r.routed, r.ready, r.draining)
+                    for r in self.replicas]
+        lines: list[str] = []
+        typed: set = set()
+
+        def typeline(base: str) -> None:
+            if base not in typed:
+                typed.add(base)
+                kind = "counter" if base.endswith("_total") else "gauge"
+                lines.append(f"# TYPE {base} {kind}\n")
+
+        for idx, url, routed, ready, draining in reps:
+            typeline("router_routed_total")
+            lines.append(f'router_routed_total{{replica="{idx}"}} {routed}\n')
+            typeline("router_replica_ready")
+            lines.append(
+                f'router_replica_ready{{replica="{idx}"}} {int(ready)}\n')
+            typeline("router_replica_draining")
+            lines.append(f'router_replica_draining{{replica="{idx}"}} '
+                         f"{int(draining)}\n")
+        totals: "OrderedDict[str, float]" = OrderedDict()
+        with self._mu:
+            alive = {r.index: r.alive for r in self.replicas}
+
+        def fetch(url: str, out: dict, idx: int) -> None:
+            try:
+                with urllib.request.urlopen(f"{url}/metrics",
+                                            timeout=2.0) as r:
+                    out[idx] = parse_metrics_text(
+                        r.read().decode("utf-8", "replace"))
+            except Exception:   # noqa: BLE001 — a dead replica drops out
+                pass
+
+        # Fetch replicas in PARALLEL, skipping known-dead ones: a
+        # monitoring poll must pay one slow replica's latency at most
+        # once, not 2 s x N serially — and a poll during an incident is
+        # exactly when the aggregate matters. (The scrape loop flips a
+        # dead replica back alive within one interval of recovery.)
+        snaps: dict = {}
+        fetchers = [threading.Thread(target=fetch, args=(url, snaps, idx))
+                    for idx, url, _, _, _ in reps if alive.get(idx)]
+        for t in fetchers:
+            t.start()
+        for t in fetchers:
+            t.join(timeout=2.5)
+        for idx, url, _, _, _ in reps:
+            snap = snaps.get(idx)
+            if snap is None:
+                continue
+            for series, v in snap.items():
+                base = series.split("{", 1)[0]
+                typeline(base)
+                label = f'replica="{idx}"'
+                lines.append(f"{_merge_label(series, label)} {v}\n")
+                if _fleet_additive(series):
+                    totals[series] = totals.get(series, 0.0) + v
+        # Fleet totals AFTER the per-replica series so scrapers see the
+        # labeled breakdown first; same series key, no replica label.
+        # The router's own failovers fold into the fleet
+        # retry_attempts_total (every replica exports the series, so the
+        # unlabeled sum already exists — a second unlabeled row would be
+        # invalid exposition).
+        if "retry_attempts_total" in totals:
+            totals["retry_attempts_total"] += _backoff.retries_total()
+        else:
+            typeline("retry_attempts_total")
+            totals["retry_attempts_total"] = float(_backoff.retries_total())
+        for series, v in totals.items():
+            lines.append(f"{series} {v}\n")
+        text += "".join(lines)
+        return Response(200, text, content_type="text/plain; version=0.0.4")
+
+    # -- draining ------------------------------------------------------------
+
+    def _find_replica(self, body: dict) -> Optional[_Replica]:
+        sel = body.get("replica")
+        for rep in self.replicas:
+            if sel == rep.index or sel == str(rep.index) or sel == rep.url:
+                return rep
+        return None
+
+    def _set_drain(self, req: Request, draining: bool) -> Response:
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        rep = self._find_replica(body if isinstance(body, dict) else {})
+        if rep is None:
+            return Response(404, {"error": "no such replica; pass "
+                                           '{"replica": <index or url>}'})
+        with self._mu:
+            rep.draining = draining
+        # Forward to the replica's own drain hook so ITS /readyz flips
+        # too (any other balancer watching the replica sees the drain,
+        # not just this router). Best-effort: a replica that predates
+        # the hook still drains router-side.
+        verb = "drain" if draining else "undrain"
+        try:
+            up = urllib.request.Request(f"{rep.url}/admin/{verb}",
+                                        data=b"{}", method="POST")
+            with urllib.request.urlopen(up, timeout=2.0) as r:
+                r.read()
+        except Exception as e:  # noqa: BLE001
+            log.warning("replica %d %s forward failed: %s",
+                        rep.index, verb, e)
+        log.info("replica %d (%s) %s", rep.index, rep.url,
+                 "draining" if draining else "undrained")
+        return Response(200, {"status": verb, "replica": rep.index})
+
+    def _admin_drain(self, req: Request) -> Response:
+        return self._set_drain(req, True)
+
+    def _admin_undrain(self, req: Request) -> Response:
+        return self._set_drain(req, False)
+
+    def _admin_replicas(self, req: Request) -> Response:
+        with self._mu:
+            return Response(200, {
+                "replicas": [r.snapshot() for r in self.replicas],
+                "sessions": len(self._sessions)})
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ReplicaRouter":
+        self._server = HttpServer(self.router, self.addr_cfg).start()
+        log.info("replica router on %s over %d replicas: %s",
+                 self._server.addr, len(self.replicas),
+                 ", ".join(r.url for r in self.replicas))
+        return self
+
+    @property
+    def url(self) -> str:
+        assert self._server is not None
+        return self._server.url
+
+    def serve_forever(self) -> None:
+        self.start()
+        threading.Event().wait()
+
+    def stop(self) -> None:
+        self._closed.set()
+        if self._server:
+            self._server.stop()
+
+
+def build_router_from_env() -> ReplicaRouter:
+    ups = [u.strip() for u in
+           env_or("SERVE_ROUTER_UPSTREAMS", "").split(",") if u.strip()]
+    if not ups:
+        raise SystemExit("SERVE_ROUTER_UPSTREAMS must list at least one "
+                         "replica URL (comma-separated)")
+    return ReplicaRouter(ups)
+
+
+def main() -> None:
+    build_router_from_env().serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -404,6 +404,12 @@ class BatchScheduler:
         self._depth_mu = threading.Lock()
         self._queued_requests = 0     # guarded-by: _depth_mu
         self._n_shed = 0              # guarded-by: _depth_mu
+        # Draining (replica-router mode, serve/router.py): a draining
+        # scheduler finishes its in-flight streams but refuses NEW
+        # submissions (OverloadError -> the front's 503) and reports
+        # not-ready so balancers route new sessions elsewhere. An Event
+        # (not a bare bool) so readers never see a torn flip.
+        self._draining = threading.Event()
         # Scheduler-loop watchdog (see docstring).
         self.loop_budget_ms = (env_float("SERVE_LOOP_BUDGET_MS", 5000.0)
                                if loop_budget_ms is None else loop_budget_ms)
@@ -1773,7 +1779,24 @@ class BatchScheduler:
         never warms is ready as soon as its thread runs."""
         if self._closed.is_set() or not self._thread.is_alive():
             return False
+        if self._draining.is_set():
+            return False
         return not self._warmup_started or self._warmup_done_at is not None
+
+    def drain(self) -> None:
+        """Enter draining: in-flight streams finish normally, but new
+        submits fast-fail with :class:`OverloadError` (503 at the HTTP
+        front) and ``ready`` reports False so any balancer scraping
+        /readyz routes new sessions away. Reversible via
+        :meth:`undrain` — nothing is torn down."""
+        self._draining.set()
+
+    def undrain(self) -> None:
+        self._draining.clear()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
 
     def _queue_depth(self) -> int:
         with self._depth_mu:
@@ -1792,6 +1815,14 @@ class BatchScheduler:
         here, so arrival order is the submit() call order."""
         if self._closed.is_set():
             raise RuntimeError("scheduler is stopped")
+        if self._draining.is_set():
+            # Draining is deliberate, bounded-duration backpressure: a
+            # client (or a router that somehow raced the drain) gets the
+            # same well-formed 503 + Retry-After contract as overload.
+            with self._depth_mu:
+                self._n_shed += 1
+            raise OverloadError("server is draining; retry elsewhere",
+                                retry_after_s=5.0)
         if self.queue_max:
             with self._depth_mu:
                 if self._queued_requests >= self.queue_max:
@@ -2191,6 +2222,10 @@ class BatchScheduler:
             # deadline. 0 on a healthy deployment; a nonzero RATE is the
             # capacity alarm.
             "requests_shed_total": self._n_shed,
+            # Draining (replica-router drain hook): 1 while this
+            # scheduler refuses new sessions so a balancer can retire
+            # the replica gracefully; in-flight streams still finish.
+            "serve_draining": int(self._draining.is_set()),
             # Loop watchdog (loop_budget_ms): max over-budget iteration
             # wall observed — including the CURRENT iteration if it is
             # already past budget (a hung device call must show up in

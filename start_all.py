@@ -73,6 +73,13 @@ def main() -> int:
                     default=env_int("DIR_PORT", 8080))
     ap.add_argument("--serve-port", type=int,
                     default=env_int("SERVE_PORT", 11434))
+    ap.add_argument("--replicas", type=int,
+                    default=env_int("SERVE_REPLICAS", 0),
+                    help="replica-router serving: spawn N independent "
+                         "full-stack serve processes on serve-port+1.. "
+                         "plus the backpressure-aware router on "
+                         "serve-port (docs/serving.md Round-10; 0/1 = "
+                         "single engine, the default)")
     ap.add_argument("--relay-port", type=int,
                     default=env_int("RELAY_PORT", 4100))
     args = ap.parse_args()
@@ -101,9 +108,33 @@ def main() -> int:
         serve_url = f"http://127.0.0.1:{args.serve_port}"
         spawn("directory", "p2p_llm_chat_tpu.directory",
               {"ADDR": f"127.0.0.1:{args.dir_port}"}, procs)
-        spawn("serve", "p2p_llm_chat_tpu.serve.api",
-              {"SERVE_ADDR": f"127.0.0.1:{args.serve_port}",
-               "SERVE_BACKEND": args.backend}, procs)
+        if args.replicas >= 2:
+            # Replica-router serving (docs/serving.md Round-10): N
+            # independent full-stack engines on successive ports, the
+            # backpressure-aware router on the main serve port — the
+            # UIs' OLLAMA_URL points at the router unchanged. On one
+            # machine this is the dev/demo profile (fake backend, or
+            # tiny configs on CPU); production runs one replica per
+            # accelerator host and points SERVE_ROUTER_UPSTREAMS at
+            # them.
+            upstreams = []
+            for i in range(args.replicas):
+                rport = args.serve_port + 1 + i
+                upstreams.append(f"http://127.0.0.1:{rport}")
+                spawn(f"serve-replica-{i}", "p2p_llm_chat_tpu.serve.api",
+                      {"SERVE_ADDR": f"127.0.0.1:{rport}",
+                       "SERVE_BACKEND": args.backend,
+                       # A replica must never inherit router/lockstep
+                       # mode flags from the launcher environment.
+                       "SERVE_ROUTER_UPSTREAMS": "",
+                       "SERVE_COORDINATOR": ""}, procs)
+            spawn("serve-router", "p2p_llm_chat_tpu.serve.router",
+                  {"SERVE_ADDR": f"127.0.0.1:{args.serve_port}",
+                   "SERVE_ROUTER_UPSTREAMS": ",".join(upstreams)}, procs)
+        else:
+            spawn("serve", "p2p_llm_chat_tpu.serve.api",
+                  {"SERVE_ADDR": f"127.0.0.1:{args.serve_port}",
+                   "SERVE_BACKEND": args.backend}, procs)
         relay_addrs = ""
         if args.relay:
             # The relay publishes its fresh multiaddr (identity is per-start)

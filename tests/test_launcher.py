@@ -44,6 +44,9 @@ def _post(url, body, timeout=20):
 
 
 def test_start_all_full_stack_roundtrip():
+    # The spawned node processes build their p2p identity from the
+    # cryptography package; absent = the same skip as the p2p suites.
+    pytest.importorskip("cryptography")
     dirp, servep, relayp, node0, ui0 = _free_ports(5)
     node1, ui1 = node0 + 1, ui0 + 1   # launcher uses base+index
     p = subprocess.Popen(
@@ -102,3 +105,65 @@ def test_start_all_full_stack_roundtrip():
     time.sleep(1)
     with pytest.raises(Exception):
         _get(f"http://127.0.0.1:{node0}/me", timeout=2)
+
+
+def test_start_all_replica_router_mode():
+    """--replicas 2 (docs/serving.md Round-10): the launcher spawns two
+    replica serve processes plus the router on the main serve port; the
+    UI-facing OLLAMA_URL contract is unchanged (generate through the
+    router), and the router sees both replicas ready. Runs with no
+    users (no node/UI children), so the serving fleet is exercised even
+    where the p2p plane's cryptography dependency is absent."""
+    dirp, node0, ui0 = _free_ports(3)
+    # The launcher binds the replicas on serve_port+1..+N — probe the
+    # whole consecutive block, not just the router port (a busy
+    # neighbor port kills a replica child at bind and the launcher
+    # tears the fleet down).
+    servep = None
+    for _ in range(50):
+        cand = _free_ports(1)[0]
+        try:
+            socks = []
+            for off in (1, 2):
+                s = socket.socket()
+                s.bind(("127.0.0.1", cand + off))
+                socks.append(s)
+            for s in socks:
+                s.close()
+            servep = cand
+            break
+        except OSError:
+            for s in socks:
+                s.close()
+    assert servep is not None, "no 3-port block free"
+    p = subprocess.Popen(
+        [sys.executable, "start_all.py", "--replicas", "2",
+         "--users", "",
+         "--node-port-base", str(node0), "--ui-port-base", str(ui0),
+         "--dir-port", str(dirp), "--serve-port", str(servep)],
+        cwd=ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        url = f"http://127.0.0.1:{servep}"
+        deadline = time.time() + 90
+        ready = False
+        while time.time() < deadline and not ready:
+            try:
+                _get(f"{url}/readyz", timeout=1)
+                ready = True
+            except Exception:
+                assert p.poll() is None, "launcher died during startup"
+                time.sleep(0.5)
+        assert ready, "replica fleet never became ready"
+        reps = _get(f"{url}/admin/replicas")["replicas"]
+        assert len(reps) == 2 and all(r["ready"] for r in reps), reps
+        body = _post(f"{url}/api/generate", {
+            "model": "fake-llm", "prompt": "replica launcher\n\nReply:",
+            "stream": False})
+        assert body["done"] is True and "replica launcher" in body["response"]
+    finally:
+        p.send_signal(signal.SIGTERM)
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            pytest.fail("launcher did not tear down on SIGTERM")
