@@ -116,6 +116,18 @@ Env knobs (all optional):
 - ``BENCH_REPLICA_SLOTS`` per-replica batch rows in that phase
                         (default BENCH_SLOTS / BENCH_REPLICAS — fixed
                         per-replica capacity, fleet capacity = slots)
+- ``BENCH_PARK``        park/wake phase (default 1 in paged mode):
+                        multi-tier KV session parking under HBM
+                        pressure — N sessions on a pool sized for a few
+                        concurrent requests, host-RAM parking on
+                        (idle_s=0), Poisson wake schedule, compared
+                        byte-for-byte against a resident (never-parked)
+                        run; JSON ``park_wake`` row
+- ``BENCH_PARK_SESSIONS`` sessions in that phase (default 32)
+- ``BENCH_PARK_SLOTS``  batch rows / pool sizing for it (default 4)
+- ``BENCH_PARK_RATE``   Poisson wake rate, 1/s (default 16)
+- ``BENCH_PARK_NEW``    completion tokens per turn (default 12)
+- ``BENCH_PARK_HOST_GB`` host-RAM park budget for the phase (default 1)
 - ``BENCH_PROFILE``     directory for a jax.profiler trace of the
                         concurrent section
 - ``BENCH_LONG_W``      long-window decode sweep: comma list of paged
@@ -884,6 +896,135 @@ def main() -> None:
     loop_stall_ms = final_snap.get("loop_stall_ms", 0.0)
     sched.stop()
 
+    # -- park/wake phase (BENCH_PARK, Round-11): multi-tier KV session
+    # parking under HBM pressure. Two schedulers over the same params,
+    # same seeds, same sequential wake order: (a) "parked" — a pool
+    # sized for BENCH_PARK_SLOTS concurrent requests only, idle_s=0 so
+    # every session demotes to host RAM (pressure parks the rest) —
+    # and (b) "resident" — a pool big enough to keep every session's
+    # pages in HBM, idle parking off. Open-session capacity, wake
+    # p50/p95, pages freed, and byte-equality of every resumed greedy
+    # stream between the two runs land in the JSON ``park_wake`` row.
+    park_wake: dict = {}
+    if env_bool("BENCH_PARK", kv_mode == "paged") and kv_mode == "paged":
+        park_sessions = env_int("BENCH_PARK_SESSIONS", 32)
+        park_slots = max(2, env_int("BENCH_PARK_SLOTS", 4))
+        park_rate = max(0.1, env_float("BENCH_PARK_RATE", 16.0))
+        park_new = max(4, env_int("BENCH_PARK_NEW", 12))
+        park_host_gb = env_float("BENCH_PARK_HOST_GB", 1.0)
+        import random as _random
+
+        base = ("Earlier in this thread we discussed the quarterly "
+                "plans and the picnic schedule at length. ")
+        t1_prompts = [(f"session {i:04d}: " + base * 2)[:96]
+                      for i in range(park_sessions)]
+        turn2_text = " And one more thing before we wrap up?"
+        per_admit = (-(-(len(t1_prompts[0]) + 2 + park_new + 2)
+                       // page_size) + 1)
+        park_pages = park_slots * per_admit + 1
+
+        def park_run(label: str, num_pages: int, idle_s: float,
+                     host_gb: float) -> tuple[dict, list, float]:
+            s2 = BatchScheduler(params, config, tokenizer,
+                                num_slots=park_slots, max_seq=max_seq,
+                                kv_mode=kv_mode, page_size=page_size,
+                                num_pages=num_pages, spec_k=0,
+                                prefix_cache=False, kv_quant=kv_quant,
+                                decode_fuse_max=fuse_k,
+                                prefill_chunk=bench_chunk,
+                                # The whole session fleet submits at
+                                # once by design — the phase measures
+                                # capacity, not shedding.
+                                queue_max=0, queue_timeout_s=600.0,
+                                kv_host_gb=host_gb, kv_idle_s=idle_s)
+            outs: list = [None] * park_sessions
+            t0p = time.monotonic()
+            try:
+                s2.warmup(prompt_buckets=(64, 128), windows=(128, 256))
+                opts_p = GenerateOptions(max_tokens=park_new,
+                                         temperature=0.0, seed=7)
+                ctxs: list = [None] * park_sessions
+
+                def turn1(i: int) -> None:
+                    st = RequestStats()
+                    for _ in s2.submit(GenerateRequest(
+                            prompt=t1_prompts[i], session=f"park-{i}",
+                            options=opts_p), st):
+                        pass
+                    ctxs[i] = st.context
+
+                ths = [threading.Thread(target=turn1, args=(i,))
+                       for i in range(park_sessions)]
+                for th in ths:
+                    th.start()
+                for th in ths:
+                    th.join()
+                # Let the idle sweep park what pressure didn't.
+                time.sleep(1.0 if idle_s == 0 else 0.1)
+                snap_open = s2.metrics_snapshot()
+                # Sequential Poisson wakes (same rng both runs — the
+                # byte-equality comparison needs identical order and
+                # solo-wake windows).
+                rng = _random.Random(3)
+                order = list(range(park_sessions))
+                rng.shuffle(order)
+                for i in order:
+                    time.sleep(rng.expovariate(park_rate))
+                    st = RequestStats()
+                    text = "".join(s2.submit(GenerateRequest(
+                        prompt=turn2_text, session=f"park-{i}",
+                        context=tuple(ctxs[i]), options=opts_p), st))
+                    outs[i] = text
+                snap = s2.metrics_snapshot()
+                snap["open_after_turn1"] = snap_open.get(
+                    "kv_open_sessions", 0)
+                return snap, outs, time.monotonic() - t0p
+            finally:
+                s2.stop()
+
+        try:
+            p_snap, p_outs, p_wall = park_run(
+                "parked", park_pages, idle_s=0.0, host_gb=park_host_gb)
+            resident_pages = (park_sessions + park_slots) * per_admit + 1
+            r_snap, r_outs, r_wall = park_run(
+                "resident", resident_pages, idle_s=1e9,
+                host_gb=park_host_gb)
+            # Sessions one HBM-only pool could keep open: the parked
+            # run's page pool over the measured per-session residency.
+            sess_pages = max(1, -(-(len(t1_prompts[0]) + 1 + park_new)
+                                  // page_size))
+            hbm_capacity = max(1, (park_pages - 1) // sess_pages)
+            open_sessions = int(p_snap.get("open_after_turn1", 0))
+            mismatches = sum(1 for a, b in zip(p_outs, r_outs)
+                             if a != b or a is None)
+            park_wake = {
+                "sessions": park_sessions,
+                "slots": park_slots,
+                "pool_pages": park_pages,
+                "open_sessions": open_sessions,
+                "hbm_only_capacity": hbm_capacity,
+                "open_ratio": round(open_sessions / hbm_capacity, 2),
+                "parked_total": p_snap.get("kv_parked_total", 0),
+                "waked_total": p_snap.get("kv_waked_total", 0),
+                "pages_freed": p_snap.get("kv_pages_freed_total", 0),
+                "wake_p50_ms": p_snap.get("kv_wake_p50_ms"),
+                "wake_p95_ms": p_snap.get("kv_wake_p95_ms"),
+                "resident_wake_p50_ms": r_snap.get("kv_wake_p50_ms"),
+                "resumed_byte_identical": mismatches == 0,
+                "mismatches": mismatches,
+                "wall_s": round(p_wall + r_wall, 2),
+            }
+            log(f"park/wake: {open_sessions} open sessions on a "
+                f"{park_pages}-page pool (HBM-only capacity "
+                f"{hbm_capacity} -> {park_wake['open_ratio']}x), wake "
+                f"p50 {park_wake['wake_p50_ms']} ms / p95 "
+                f"{park_wake['wake_p95_ms']} ms (resident p50 "
+                f"{park_wake['resident_wake_p50_ms']} ms), resumed "
+                f"byte-identical: {mismatches == 0}")
+        except Exception as e:      # noqa: BLE001 — record, don't abort
+            log(f"park/wake phase FAILED: {e}")
+            park_wake = {"sessions": park_sessions, "error": str(e)}
+
     # -- replica-router phase (BENCH_REPLICAS >= 2, Round-10): N full-
     # stack engines SHARING this bench's params (immutable device
     # arrays — no extra weight copies) behind serve/router.py, driven
@@ -1077,6 +1218,12 @@ def main() -> None:
             # replica on the same workload, with the router's
             # routed/retried/shed counters — the Round-10 scaling row.
             "replica_router": replica_router or None,
+            # Park/wake phase (BENCH_PARK, Round-11): open sessions on
+            # a pressure-sized pool vs the HBM-only capacity bound,
+            # wake latency percentiles, and resumed-output byte-
+            # equality between the parked and resident runs — the
+            # multi-tier KV acceptance row.
+            "park_wake": park_wake or None,
             # Long-window sweep (BENCH_LONG_W): per (window, impl) step
             # time vs the HBM bytes bound; flash rows carry their
             # speedup over the gather path — the round-8 acceptance

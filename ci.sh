@@ -84,11 +84,19 @@ if [ "${1:-}" = "full" ]; then
   echo "== replica router: fast legs + two-OS-process matrix (CPU)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q || rc=1
 
+  # Multi-tier KV: the WHOLE park/wake file including the slow-marked
+  # matrix (dense x bf16-pool x prefix composition, eviction under a
+  # sub-session host budget, pool-pressure parking). Excluded from the
+  # sweep below so each case executes exactly once.
+  echo "== multi-tier KV: park/wake matrix (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tier.py -q || rc=1
+
   echo "== full test suite"
   python -m pytest tests/ -q \
     --ignore=tests/test_flash_append_geometry.py \
     --ignore=tests/test_failpoints.py \
-    --ignore=tests/test_router.py || rc=1
+    --ignore=tests/test_router.py \
+    --ignore=tests/test_kv_tier.py || rc=1
 else
   # Fused-decode parity pinned explicitly on CPU: the K-fused-steps ≡
   # K-plain-ticks bit-identity contract (serve/scheduler.py
@@ -136,16 +144,28 @@ else
 
   # Replica-router serving (tier-1 legs): routing/failover/drain/
   # affinity/metrics-aggregation contracts over in-process FakeLLM
-  # replicas plus the engine-level drain hook — the slow-marked
-  # two-OS-process full-stack matrix runs in full mode. Excluded from
-  # the sweep below so each case executes exactly once.
+  # replicas plus the engine-level drain hook — now including the
+  # round-11 cross-replica prefix-share sync and kv-tier fleet
+  # aggregation legs. The slow-marked two-OS-process full-stack matrix
+  # runs in full mode. Excluded from the sweep below so each case
+  # executes exactly once.
   echo "== replica router contracts (CPU)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_router.py -q -x \
+    -m 'not slow' || rc=1
+
+  # Multi-tier KV (tier-1 legs): park/wake policy units, the raw-bits
+  # gather/scatter round-trip, and the paged-int8 resident-vs-parked
+  # byte-identity oracle. The dense / bf16 / prefix-composition /
+  # eviction-pressure matrix is slow-marked into full mode. Excluded
+  # from the sweep below so each case executes exactly once.
+  echo "== multi-tier KV: park/wake bit-identity (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tier.py -q -x \
     -m 'not slow' || rc=1
 
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
     --ignore=tests/test_router.py \
+    --ignore=tests/test_kv_tier.py \
     --ignore=tests/test_spec_draft.py \
     --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_chunked_prefill.py \

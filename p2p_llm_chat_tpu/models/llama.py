@@ -726,7 +726,9 @@ def verify_step(params: dict, config: ModelConfig, tokens: jax.Array,
                 cache: KVCache, mesh: Optional[Mesh] = None,
                 rules: LogicalRules = DEFAULT_RULES,
                 kv_window: Optional[int] = None,
-                mlp_fn=None) -> tuple[jax.Array, KVCache]:
+                mlp_fn=None,
+                last_idx: Optional[jax.Array] = None
+                ) -> tuple[jax.Array, KVCache]:
     """Speculative-decoding verify: score S candidate positions per row in
     ONE forward (the multi-token generalisation of :func:`decode_step`).
 
@@ -743,7 +745,11 @@ def verify_step(params: dict, config: ModelConfig, tokens: jax.Array,
 
     Returns (logits [B,S,vocab] f32 — logits[:, j] is the model's
     distribution for the token AFTER input j — and the cache with the S
-    candidate slots written, lengths unchanged).
+    candidate slots written, lengths unchanged). ``last_idx`` ([B] int):
+    gather ONE position's logits per row ([B,1,vocab]) — the
+    session-wake admission shape, where S is a whole suffix bucket and
+    the full [B,S,vocab] f32 logits would be gigabytes (see
+    forward's last_idx note); spec verify reads all S and passes None.
     """
     B, S = tokens.shape
     positions = cache.lengths[:, None] + jnp.arange(S)[None, :]   # [B,S]
@@ -753,7 +759,8 @@ def verify_step(params: dict, config: ModelConfig, tokens: jax.Array,
     mask = (jnp.arange(window)[None, None, :]
             <= positions[:, :, None])[:, None]                    # [B,1,S,W]
     return forward(params, config, tokens, positions, cache, mask,
-                   mesh, rules, kv_window=kv_window, mlp_fn=mlp_fn)
+                   mesh, rules, kv_window=kv_window, mlp_fn=mlp_fn,
+                   last_idx=last_idx)
 
 
 # -- paged decode (Pallas kernel path) ----------------------------------------
@@ -783,7 +790,7 @@ def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
                       cache, mesh: Optional[Mesh] = None,
                       rules: LogicalRules = DEFAULT_RULES,
                       *, pages: int, interpret: Optional[bool] = None,
-                      mlp_fn=None):
+                      mlp_fn=None, last_idx: Optional[jax.Array] = None):
     """Speculative verify over the paged pool: :func:`verify_step`'s
     contract (S candidate positions, lengths unchanged; caller advances
     by accepted+1) on a PagedKVCache.
@@ -826,6 +833,13 @@ def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
 
     def finish(h):
         h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+        if last_idx is not None:
+            # One position's logits per row ([B,1,vocab]) — the
+            # session-wake admission shape, where S is a whole suffix
+            # bucket and full logits would be an [B*S, vocab] f32 temp
+            # (forward's last_idx note). Spec verify passes None.
+            h = jnp.take_along_axis(
+                h, last_idx[:, None, None].astype(jnp.int32), axis=1)
         lm_head = (params["embed"].T if config.tie_embeddings
                    else params["lm_head"])
         logits = mm(h, lm_head).astype(jnp.float32)

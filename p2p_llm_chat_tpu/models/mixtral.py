@@ -370,11 +370,16 @@ def decode_fused(params: dict, config: ModelConfig, tokens: jax.Array,
 def verify_step(params: dict, config: ModelConfig, tokens: jax.Array,
                 cache: KVCache, mesh: Optional[Mesh] = None,
                 rules: LogicalRules = DEFAULT_RULES,
-                kv_window: Optional[int] = None) -> tuple[jax.Array, KVCache]:
+                kv_window: Optional[int] = None,
+                last_idx: Optional[jax.Array] = None
+                ) -> tuple[jax.Array, KVCache]:
     """llama.verify_step with the MoE MLP (speculative-decoding verify;
-    the token count is tiny, so the expert bucket stays exact)."""
+    the token count is tiny, so the expert bucket stays exact —
+    session-wake reuses it at suffix-bucket widths with ``last_idx``,
+    where the bucket scales with the suffix like prefill_chunk's)."""
     return llama.verify_step(params, config, tokens, cache, mesh, rules,
-                             kv_window, mlp_fn=_mlp_fn(config, None))
+                             kv_window, mlp_fn=_mlp_fn(config, None),
+                             last_idx=last_idx)
 
 
 def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
@@ -397,11 +402,13 @@ def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
 def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
                       cache, mesh: Optional[Mesh] = None,
                       rules: LogicalRules = DEFAULT_RULES,
-                      *, pages: int, interpret: Optional[bool] = None):
+                      *, pages: int, interpret: Optional[bool] = None,
+                      last_idx: Optional[jax.Array] = None):
     """llama.verify_step_paged with the MoE MLP."""
     return llama.verify_step_paged(params, config, tokens, cache, mesh,
                                    rules, pages=pages, interpret=interpret,
-                                   mlp_fn=_mlp_fn(config, None))
+                                   mlp_fn=_mlp_fn(config, None),
+                                   last_idx=last_idx)
 
 
 def embed_pooled(params: dict, config: ModelConfig, tokens: jax.Array,

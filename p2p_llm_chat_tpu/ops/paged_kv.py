@@ -568,6 +568,54 @@ def write_decode_multi(cache: PagedKVCache, layer: jax.Array, k: jax.Array,
                            upd, mode="drop"))
 
 
+# -- page-set extract / inject (KV tiering, serve/kv_tier.py) -----------------
+
+def gather_pages(cache: PagedKVCache, pages: jax.Array) -> tuple:
+    """Pull a page set's content out of the pool in ONE gather per array
+    — the device half of parking a session's KV to host RAM (the caller
+    jits this, reads the result back with a single sync, and frees the
+    physical pages).
+
+    pages: [P] physical page ids (pad with 0 — the garbage page — to a
+    power-of-two bucket so the compile cache stays small; padded lanes
+    carry garbage the caller ignores). Returns (k [L,P,ps,Hkv,D],
+    v [L,P,ps,Hkv,D], k_scale, v_scale) with the scale pair None for
+    bf16 pools and the head-major [L,P,Hkv,ps_pad] storage layout for
+    int8 — the raw pool bits, NOT a dequant: park/wake must round-trip
+    the exact int8+scale words so a resumed session attends bit-identical
+    KV to one that never left HBM.
+    """
+    k = cache.k[:, pages]
+    v = cache.v[:, pages]
+    if not cache.quantized:
+        return k, v, None, None
+    return k, v, cache.k_scale[:, pages], cache.v_scale[:, pages]
+
+
+def scatter_pages(cache: PagedKVCache, pages: jax.Array, k: jax.Array,
+                  v: jax.Array, k_scale: Optional[jax.Array] = None,
+                  v_scale: Optional[jax.Array] = None) -> PagedKVCache:
+    """Land a parked page set back into the pool in ONE scatter per
+    array — the device half of waking a session from host RAM. Inverse
+    of :func:`gather_pages`: the payload is raw pool words (int8 +
+    head-major scales included), so wake is a copy, never a requantize.
+
+    pages: [P] freshly-allocated physical ids, padded with 0 to the
+    payload's bucket — duplicate 0 entries scatter garbage into the
+    garbage page, which holds garbage by contract. The caller installs
+    the waking row's table/lengths separately (atomically with its
+    suffix prefill — the chunked-admission splice discipline); this
+    touches pool content only.
+    """
+    cache = cache._replace(k=cache.k.at[:, pages].set(k),
+                           v=cache.v.at[:, pages].set(v))
+    if k_scale is not None:        # payload structure — static under jit
+        cache = cache._replace(
+            k_scale=cache.k_scale.at[:, pages].set(k_scale),
+            v_scale=cache.v_scale.at[:, pages].set(v_scale))
+    return cache
+
+
 def set_row_table(cache: PagedKVCache, row: int | jax.Array,
                   pages: jax.Array) -> PagedKVCache:
     """Install a row's page map (host-allocated physical ids, padded with

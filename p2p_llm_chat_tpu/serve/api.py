@@ -125,6 +125,14 @@ class OllamaServer:
         self._draining = threading.Event()
         self.router.add("POST", "/admin/drain", self._drain)
         self.router.add("POST", "/admin/undrain", self._undrain)
+        # Cross-replica shared prefix tier (serve/prefix.py round 11):
+        # the router lists each replica's cached prefixes by token hash
+        # and tells replicas missing a hot one to pull it from the
+        # replica that built it — control messages through the router,
+        # KV bytes replica-to-replica.
+        self.router.add("GET", "/admin/prefix", self._prefix_list)
+        self.router.add("GET", "/admin/prefix/export", self._prefix_export)
+        self.router.add("POST", "/admin/prefix/import", self._prefix_import)
         self._server: Optional[HttpServer] = None
 
     # -- helpers -------------------------------------------------------------
@@ -256,12 +264,16 @@ class OllamaServer:
         self._m_tokens.inc(stats.completion_tokens)
 
     def _run(self, req_body: dict, prompt: str, key: str,
-             wrap, with_context: bool = False) -> Response:
+             wrap, with_context: bool = False,
+             headers: Optional[dict] = None) -> Response:
         """Shared generate/chat execution. ``key``: response field holding
         text ('response' or 'message'); ``wrap``: delta -> field value;
         ``with_context``: /api/generate's conversation-state round trip
         (request ``context`` ids prepended, final record returns the
-        updated ids — Ollama's stateless continuation contract)."""
+        updated ids — Ollama's stateless continuation contract).
+        ``headers``: the HTTP request headers — the session id
+        (``X-Session-Id`` / ``session`` body field, the router's
+        affinity id) rides into the engine for KV tiering."""
         # Failpoint: the request-parse/validate site. ``error`` returns
         # a well-formed Ollama error record; ``raise`` rides the
         # router's handler-error envelope (also a well-formed 500).
@@ -286,8 +298,11 @@ class OllamaServer:
                 return Response(400, {"error": "context must be a list of "
                                                "non-negative token ids"})
             context = tuple(raw_ctx)
+        session = str(req_body.get("session") or "")
+        if not session and headers is not None:
+            session = str(headers.get("x-session-id") or "")
         greq = GenerateRequest(prompt=prompt, model=model, options=opts,
-                               context=context)
+                               context=context, session=session)
         backend = self._resolve(model)
         stats = RequestStats()
         self._m_requests.inc()
@@ -375,7 +390,7 @@ class OllamaServer:
             return Response(400, {"error": "invalid json"})
         prompt = str(body.get("prompt") or "")
         return self._run(body, prompt, "response", lambda t: t,
-                         with_context=True)
+                         with_context=True, headers=req.headers)
 
     def _chat(self, req: Request) -> Response:
         try:
@@ -391,7 +406,8 @@ class OllamaServer:
                                      or self.backend.name))
         prompt = render_chat_prompt(messages, resolved)
         return self._run(body, prompt, "message",
-                         lambda t: {"role": "assistant", "content": t})
+                         lambda t: {"role": "assistant", "content": t},
+                         headers=req.headers)
 
     def _tags(self, req: Request) -> Response:
         return Response(200, {"models": [
@@ -501,6 +517,67 @@ class OllamaServer:
             log.exception("embed failed")
             return Response(500, {"error": str(e)})
         return Response(200, {"embedding": vecs[0]})
+
+    def _prefix_list(self, req: Request) -> Response:
+        """GET /admin/prefix: {token_hash: {len, hits}} for this
+        replica's cached prefixes. 501 when the backend has no prefix
+        store (FakeLLM, prefix cache disabled) so the router skips it."""
+        fn = getattr(self.backend, "prefix_hashes", None)
+        if fn is None:
+            return Response(501, {"error": "no prefix store"})
+        got = fn()
+        if got is None:
+            return Response(501, {"error": "no prefix store"})
+        return Response(200, {"prefixes": got})
+
+    def _prefix_export(self, req: Request) -> Response:
+        """GET /admin/prefix/export?h=<token_hash>: the serialized entry
+        (ids + KV, serve/prefix.py wire format) for a peer replica."""
+        fn = getattr(self.backend, "prefix_export", None)
+        if fn is None:
+            return Response(501, {"error": "no prefix store"})
+        h = str(req.query.get("h") or "")
+        if not h:
+            return Response(400, {"error": "missing h=<token_hash>"})
+        data = fn(h)
+        if data is None:
+            return Response(404, {"error": f"prefix {h} not cached"})
+        return Response(200, data, content_type="application/octet-stream")
+
+    def _prefix_import(self, req: Request) -> Response:
+        """POST /admin/prefix/import: install a peer's prefix entry.
+        Body is either the raw exported payload, or JSON
+        {"from": <peer base url>, "h": <token_hash>} — the PULL form the
+        router uses, so KV bytes flow replica-to-replica and the router
+        never buffers them."""
+        fn = getattr(self.backend, "prefix_import", None)
+        if fn is None:
+            return Response(501, {"error": "no prefix store"})
+        data = req.body or b""
+        if data[:1] == b"{":
+            try:
+                spec = req.json() or {}
+            except ValueError:
+                return Response(400, {"error": "invalid json"})
+            src = str(spec.get("from") or "")
+            h = str(spec.get("h") or "")
+            if not src or not h:
+                return Response(400, {"error": "need from + h"})
+            import urllib.request
+            try:
+                with urllib.request.urlopen(
+                        f"{src.rstrip('/')}/admin/prefix/export?h={h}",
+                        timeout=30.0) as r:
+                    data = r.read()
+            except Exception as e:   # noqa: BLE001 — peer may be gone
+                return Response(502, {"error": f"pull from {src} "
+                                               f"failed: {e}"})
+        entry = fn(data)
+        if entry is None:
+            return Response(400, {"error": "malformed or incompatible "
+                                           "prefix payload"})
+        return Response(200, {"status": "ok", "len": entry.length,
+                              "hash": entry.token_hash})
 
     def _unsupported(self, req: Request) -> Response:
         return Response(501, {

@@ -75,6 +75,12 @@ class GenerateRequest:
     # prepended to this prompt (the final response record returns the
     # updated ids). Tuple of ints; empty = fresh conversation.
     context: tuple = ()
+    # Conversation id (``X-Session-Id`` header / ``session`` body field
+    # — the same id serve/router.py keys affinity on): engines with KV
+    # tiering (serve/kv_tier.py) keep this conversation's KV open across
+    # requests under it, so a follow-up turn wakes the session instead
+    # of re-prefilling its whole history. Empty = derived/anonymous.
+    session: str = ""
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex)
     arrival_time: float = field(default_factory=time.monotonic)
 

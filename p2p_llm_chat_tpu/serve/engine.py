@@ -39,6 +39,11 @@ dispatch, adaptive; default 4, 1 disables),
 ``SERVE_PREFILL_CHUNK`` (chunked prefill: admissions above this token
 budget land in fixed chunks interleaved with decode ticks; default 256,
 0 disables),
+``SERVE_KV_HOST_GB`` (multi-tier KV: host-RAM session parking budget in
+GB — finished conversations' KV stays open and follow-up turns wake it
+instead of re-prefilling the history; 0 disables; serve/kv_tier.py),
+``SERVE_KV_IDLE_S`` (seconds a resident session idles before parking
+to host RAM),
 ``SERVE_PREFIX`` (shared-prefix KV caching, serve/prefix.py; default on),
 ``SERVE_PREFIX_TEXTS`` (extra templates to pre-register, ``||``-separated;
 the reference co-pilot template is always registered),
@@ -61,7 +66,7 @@ from ..models.configs import get_config
 from ..models import family_for
 from ..models.weights import load_checkpoint
 from ..tokenizer import ByteTokenizer, load_tokenizer
-from ..utils.env import env_bool, env_int, env_or
+from ..utils.env import env_bool, env_float, env_int, env_or
 from ..utils.log import get_logger
 from .backend import Backend, GenerateRequest, RequestStats
 from .scheduler import BatchScheduler
@@ -93,7 +98,9 @@ class TPUEngine:
                  decode_fuse_max: int = 4,
                  prefill_chunk: int = 256,
                  queue_max: Optional[int] = None,
-                 draft: Optional[tuple] = None) -> None:
+                 draft: Optional[tuple] = None,
+                 kv_host_gb: float = 0.0,
+                 kv_idle_s: float = 30.0) -> None:
         """``draft``: optional ``(params, config)`` of a small draft
         model made resident alongside this engine's target for
         speculative decoding (SERVE_DRAFT; serve/draft_model.py). Needs
@@ -156,7 +163,9 @@ class TPUEngine:
                                         decode_fuse_max=decode_fuse_max,
                                         prefill_chunk=prefill_chunk,
                                         queue_max=queue_max,
-                                        drafter=drafter)
+                                        drafter=drafter,
+                                        kv_host_gb=kv_host_gb,
+                                        kv_idle_s=kv_idle_s)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
@@ -272,6 +281,26 @@ class TPUEngine:
         merged into the API front's /metrics (serve/api.py)."""
         return self.scheduler.metrics_snapshot()
 
+    # -- cross-replica shared prefix tier (serve/prefix.py round 11) ---------
+
+    def prefix_hashes(self):
+        """{token_hash: {len, hits}} of cached prefixes, or None when
+        the prefix cache is off (the front answers 501)."""
+        store = self.scheduler._prefix
+        return None if store is None else store.hashes()
+
+    def prefix_export(self, h: str):
+        store = self.scheduler._prefix
+        return None if store is None else store.export_payload(h)
+
+    def prefix_import(self, data: bytes):
+        """Install a peer replica's exported prefix entry (thread-safe:
+        the store locks; the scheduler reads entries between admission
+        dispatches). Admission programs for grain-snapped imports are
+        covered by warmup's grain pre-warm."""
+        store = self.scheduler._prefix
+        return None if store is None else store.import_payload(data)
+
     def drain(self) -> None:
         """Replica drain hook (serve/router.py): finish in-flight
         streams, refuse new sessions, report not-ready on /readyz."""
@@ -341,6 +370,12 @@ def build_engine_from_env() -> Backend:
     # stall-free admission — see scheduler.prefill_chunk). 0 disables
     # (legacy whole-bucket admission).
     prefill_chunk = max(0, env_int("SERVE_PREFILL_CHUNK", 256))
+    # Multi-tier KV (serve/kv_tier.py): host-RAM session parking. > 0
+    # enables — finished conversations' KV stays open (resident pages
+    # first, host-RAM copies under idle/pressure) up to this many GB of
+    # host RAM, and follow-up turns wake instead of re-prefilling.
+    kv_host_gb = env_float("SERVE_KV_HOST_GB", 0.0)
+    kv_idle_s = env_float("SERVE_KV_IDLE_S", 30.0)
     prefix_cache = env_bool("SERVE_PREFIX", True)
     prefix_texts = (SUGGEST_PREFIX,) + tuple(
         t for t in env_or("SERVE_PREFIX_TEXTS", "").split("||") if t)
@@ -445,7 +480,8 @@ def build_engine_from_env() -> Backend:
                          decode_fuse_max=decode_fuse_max,
                          prefill_chunk=prefill_chunk,
                          queue_max=queue_max,
-                         draft=load_draft_for(config))
+                         draft=load_draft_for(config),
+                         kv_host_gb=kv_host_gb, kv_idle_s=kv_idle_s)
 
     def warmup_buckets():
         warmup = env_or("SERVE_WARMUP", "128,256")

@@ -1,0 +1,286 @@
+"""Multi-tier KV: host-RAM session parking for mostly-idle conversations.
+
+The north-star workload is millions of chat sessions that are idle
+between turns, but a session's KV historically lived in HBM for the
+request's lifetime and evaporated at finish — a follow-up turn re-paid
+the whole history's prefill. At measured KV economics (16 KB/token int8
+on bench-moe, BASELINE.md) HBM bounds *open* sessions long before it
+bounds *decoding* sessions; pinned host RAM is ~50x larger per chip.
+This module adds the vLLM-style memory hierarchy on top of the paged
+pool (ops/paged_kv.py):
+
+- **resident** (paged mode): a finished request whose client named a
+  session keeps its physical pages in the pool — the row is released
+  and its table zeroed, but the pages stay out of the allocator. A
+  follow-up whose prompt extends the session's tokens wakes for free:
+  the pages re-enter a fresh row's table and only the new turn's suffix
+  runs a forward (serve/scheduler.py `_admit_wake`).
+- **parked** (both modes): under idle timeout or page-pool pressure the
+  session's raw KV words (int8 + scales included — bit-exact, never a
+  requantize) are gathered in one dispatch and copied to host arrays;
+  the pages go back to the allocator. Wake re-uploads the payload
+  (prefetch starts at match time, so the H2D copy overlaps whatever
+  admission work — including a PR 3 chunk ladder — runs ahead of it)
+  and scatters it into freshly-allocated pages in one dispatch.
+- **evicted**: the host pool is budgeted (``SERVE_KV_HOST_GB``); the
+  cost policy below drops the worst parked sessions entirely. A dropped
+  session's follow-up simply cold-admits (full prefill) — tiering is a
+  pure optimization, invisible in outputs.
+
+Eviction policy (shared with serve/prefix.py's byte-budget mode):
+cost = bytes x recency — the biggest, longest-idle entries go first,
+so one huge stale session cannot squat while many small warm ones are
+dropped (plain LRU would keep it; plain largest-first would churn hot
+long chats).
+
+Correctness: park/wake round-trips the exact pool words, so a resumed
+greedy stream is BYTE-identical to one whose session never left HBM
+(pinned by tests/test_kv_tier.py). Host-side policy lives here; the
+device programs live in ops/paged_kv.py (gather_pages/scatter_pages)
+and serve/scheduler.py (the wake admission program).
+
+Threading: the scheduler thread performs every state transition
+(park/wake/retain run between device dispatches it owns); /metrics
+scrapes read the tables from HTTP threads — hence the lock on the
+session index. Host payload arrays are immutable after parking.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..utils.log import get_logger
+
+log = get_logger("serve.kv_tier")
+
+# Token-head index grain: sessions of at least this many tokens are
+# findable by the hash of their first HEAD_GRAIN token ids (a follow-up
+# prompt that extends the session shares them verbatim), so wake works
+# for /api/generate context continuation even when the client never
+# sends an explicit session id. Shorter sessions are only reachable by
+# explicit id — their prefill is too cheap to matter.
+HEAD_GRAIN = 32
+
+
+def cost_evict(items: list[tuple], over_bytes: float,
+               now: Optional[float] = None) -> list:
+    """Pick victims until at least ``over_bytes`` bytes are freed.
+
+    ``items``: (key, nbytes, last_used) triples. Victims are chosen by
+    descending cost = nbytes x idle seconds (floor 1 ms so entries
+    touched this instant still rank by size). Returns the victim keys —
+    the caller owns the actual removal. Shared by the host session pool
+    and the PrefixStore byte budget so the two tiers cannot drift."""
+    if over_bytes <= 0:
+        return []
+    t = time.monotonic() if now is None else now
+    scored = sorted(items, key=lambda it: it[1] * max(1e-3, t - it[2]),
+                    reverse=True)
+    victims, freed = [], 0.0
+    for key, nbytes, _ in scored:
+        if freed >= over_bytes:
+            break
+        victims.append(key)
+        freed += nbytes
+    return victims
+
+
+@dataclass
+class SessionKV:
+    """One open session's KV, in whichever tier it currently occupies.
+
+    ``tokens``: the ids whose KV is trusted (prompt + all generated but
+    the last — the cache never holds the final emitted token's KV);
+    ``length`` == len(tokens). Exactly one of ``pages`` (resident) /
+    ``host`` (parked) is set; ``host`` is the raw-bits payload tuple
+    ((k, v, k_scale, v_scale), n_pages) for paged pools or
+    ((k, v), width) for dense rows."""
+
+    key: str
+    tokens: tuple
+    length: int
+    pages: Optional[list] = None          # resident: physical page ids
+    host: Optional[tuple] = None          # parked: (arrays, span)
+    nbytes: int = 0                       # host bytes when parked
+    last_used: float = field(default_factory=time.monotonic)
+
+    @property
+    def parked(self) -> bool:
+        return self.host is not None
+
+
+class KVTier:
+    """Session index + host-pool budget accounting.
+
+    State transitions (retain/park/wake/drop) run on the scheduler
+    thread only — it owns the device buffers the transitions copy — so
+    the lock exists for the /metrics readers, not for mutual exclusion
+    between writers."""
+
+    def __init__(self, host_bytes: float, idle_s: float = 30.0,
+                 max_sessions: int = 4096) -> None:
+        self.host_budget = float(host_bytes)
+        self.idle_s = idle_s
+        self.max_sessions = max_sessions
+        self._mu = threading.Lock()
+        self._sessions: dict[str, SessionKV] = {}   # guarded-by: _mu
+        self._by_head: dict[tuple, str] = {}        # guarded-by: _mu
+        self.host_bytes = 0                         # guarded-by: _mu
+        # Counters (monotonic; torn reads harmless for /metrics).
+        self.n_parked_total = 0
+        self.n_waked_total = 0
+        self.n_wake_cold_total = 0    # follow-ups that found no session
+        self.n_wake_tokens_total = 0  # prompt tokens wake did NOT re-prefill
+        self.n_evicted_total = 0
+        self.n_pages_freed_total = 0  # HBM pages released by parking
+
+    # -- index ---------------------------------------------------------------
+
+    @staticmethod
+    def _head(tokens) -> Optional[tuple]:
+        if len(tokens) < HEAD_GRAIN:
+            return None
+        return tuple(tokens[:HEAD_GRAIN])
+
+    def counts(self) -> tuple[int, int]:
+        """(resident, parked) session counts."""
+        with self._mu:
+            parked = sum(1 for s in self._sessions.values() if s.parked)
+            return len(self._sessions) - parked, parked
+
+    def resident_sessions(self) -> list[SessionKV]:
+        """Resident sessions, least-recently-used first (the park-
+        under-pressure scan order)."""
+        with self._mu:
+            res = [s for s in self._sessions.values() if not s.parked]
+        return sorted(res, key=lambda s: s.last_used)
+
+    def lookup(self, key: str, prompt_ids: list,
+               count_miss: bool = True) -> Optional[SessionKV]:
+        """Session whose tokens are a PROPER prefix of ``prompt_ids``
+        (>= 1 suffix token must remain — its logits seed sampling), by
+        explicit key first, else by the token-head index (context
+        continuation with no session header). A key match whose content
+        diverged (client edited history) is dropped — its KV can never
+        serve this conversation again. Misses count toward
+        ``kv_wake_cold_total`` only when a session was plausibly being
+        continued (an indexable key existed) and ``count_miss`` is set
+        (claim's re-validation does not double-count)."""
+        with self._mu:
+            s = self._sessions.get(key) if key else None
+            if s is None:
+                h = self._head(prompt_ids)
+                if h is not None:
+                    s = self._sessions.get(self._by_head.get(h, ""))
+        indexable = bool(key) or self._head(prompt_ids) is not None
+        if s is None:
+            if count_miss and indexable:
+                self.n_wake_cold_total += 1
+            return None
+        if not (0 < s.length < len(prompt_ids)
+                and tuple(prompt_ids[: s.length]) == s.tokens):
+            if key and s.key == key:
+                self.drop(s)        # diverged history: stale forever
+            if count_miss and indexable:
+                self.n_wake_cold_total += 1
+            return None
+        s.last_used = time.monotonic()
+        return s
+
+    def insert(self, sess: SessionKV) -> None:
+        """Register (or replace) a session. Callers must :meth:`take`
+        any older entry under the same key first — the scheduler owns
+        page/byte recycling, and the index cap is enforced by draining
+        :meth:`overflow_victims` right after an insert."""
+        with self._mu:
+            self._sessions[sess.key] = sess
+            h = self._head(sess.tokens)
+            if h is not None:
+                self._by_head[h] = sess.key
+            if sess.parked:
+                self.host_bytes += sess.nbytes
+
+    def take(self, key: str) -> Optional[SessionKV]:
+        """Remove and return a session (wake / replace): the caller now
+        owns its pages or host payload."""
+        with self._mu:
+            s = self._sessions.pop(key, None)
+            if s is None:
+                return None
+            h = self._head(s.tokens)
+            if h is not None and self._by_head.get(h) == key:
+                del self._by_head[h]
+            if s.parked:
+                self.host_bytes -= s.nbytes
+            return s
+
+    def claim(self, key: str, prompt_ids: list) -> Optional[SessionKV]:
+        """Validated take: the wake path's claim — returns the session
+        (removed from the index; the caller owns its pages/payload) only
+        if it still extends ``prompt_ids``. None = it vanished or
+        diverged since matching; the request cold-admits."""
+        s = self.lookup(key, prompt_ids, count_miss=False)
+        if s is None:
+            return None
+        return self.take(s.key)
+
+    def drop(self, sess: SessionKV) -> Optional[list]:
+        """Evict a session entirely. Returns its resident pages (for the
+        caller to free) or None if it was parked/absent."""
+        s = self.take(sess.key)
+        if s is None:
+            return None
+        self.n_evicted_total += 1
+        return s.pages
+
+    # -- policy --------------------------------------------------------------
+
+    def park_candidates(self, now: Optional[float] = None,
+                        force: bool = False) -> list[SessionKV]:
+        """Resident sessions due for parking: idle past ``idle_s`` (or
+        every resident session when ``force`` — pool pressure), oldest
+        first."""
+        t = time.monotonic() if now is None else now
+        out = [s for s in self.resident_sessions()
+               if force or (t - s.last_used) >= self.idle_s]
+        return out
+
+    def host_victims(self) -> list[SessionKV]:
+        """Parked sessions the byte budget says must go, worst
+        cost (bytes x idle) first."""
+        with self._mu:
+            over = self.host_bytes - self.host_budget
+            if over <= 0:
+                return []
+            items = [(s.key, s.nbytes, s.last_used)
+                     for s in self._sessions.values() if s.parked]
+            by_key = {s.key: s for s in self._sessions.values()}
+        return [by_key[k] for k in cost_evict(items, over)]
+
+    def overflow_victims(self) -> list[SessionKV]:
+        """Sessions past the index cap, least-recently-used first."""
+        with self._mu:
+            over = len(self._sessions) - self.max_sessions
+            if over <= 0:
+                return []
+            ordered = sorted(self._sessions.values(),
+                             key=lambda s: s.last_used)
+        return ordered[:over]
+
+    def reset_resident(self) -> None:
+        """Drop every RESIDENT session (error-path recovery: the pool
+        and allocator were rebuilt, so resident pages are dangling ids
+        over dead content). Parked payloads live on host and survive."""
+        with self._mu:
+            dead = [s for s in self._sessions.values() if not s.parked]
+            for s in dead:
+                del self._sessions[s.key]
+                h = self._head(s.tokens)
+                if h is not None and self._by_head.get(h) == s.key:
+                    del self._by_head[h]
+        if dead:
+            log.warning("dropped %d resident session(s) on device reset",
+                        len(dead))

@@ -22,6 +22,17 @@ born:
   on the spot (one prefill dispatch; on TPU the first promotion of a new
   (P, S) shape pays a compile, which is logged).
 
+A third way, round 11: **imported** — the replica router
+(serve/router.py) watches each replica's promoted entries by token hash
+and tells replicas missing a hot prefix to pull it from the replica
+that built it (`export_payload`/`import_payload`, raw bytes over the
+/admin/prefix endpoints). A prefix promoted by traffic on one replica
+is then injectable on every other, so session-affinity imbalance no
+longer decides which replica gets the admission win. Imported entries
+are grain-snapped by construction (only auto-promoted heads are worth
+shipping; registered templates exist on every replica from boot), so
+the grain pre-warm's compiled splice programs cover them.
+
 Auto-promoted prefix lengths are snapped DOWN to the grain ladder so the
 compiled admission-program shapes stay bounded: P in {64, 128, 256, 512}
 and the suffix reuses the existing prompt-bucket ladder. REGISTERED
@@ -30,21 +41,48 @@ and known at warmup, and ladder-snapping would silently drop templates
 shorter than the smallest grain (the co-pilot template is ~18 tokens
 under a real llama3 BPE vocabulary).
 
+Eviction: ``max_bytes`` > 0 switches the store to the tier cost policy
+(cost = bytes x recency, shared with serve/kv_tier.py's host pool) —
+the biggest, longest-idle entries go first, replacing the blunt
+count-capped LRU (which treated a 512-token entry and an 18-token
+template as equal occupancy). ``max_entries`` stays as a hard sanity
+cap either way. ``hits/misses/evictions`` are exported on /metrics
+(the store tracked hits internally for LRU long before round 11, but
+exported nothing).
+
 Correctness: the cached K/V is produced by the same prefill math on the
 same weights, so a prefix-cached admission is oracle-equal to the full
 prefill (pinned by tests/test_prefix.py against the uncached scheduler).
 Entries are only read between admission dispatches on the scheduler
-thread; `register` may run on the warmup thread, hence the lock.
+thread; `register` and `import_payload` may run on other threads, hence
+the lock.
 """
 
 from __future__ import annotations
 
+import hashlib
+import io
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 DEFAULT_GRAIN_LADDER = (64, 128, 256, 512)
+
+# Wire-format version for export_payload / import_payload (bumped on
+# any incompatible change; importers reject unknown versions).
+_WIRE_VERSION = 1
+
+
+def token_hash(ids) -> str:
+    """Stable cross-replica identity of a prefix: sha256 over the token
+    ids as little-endian int64 words (dtype-pinned so the hash cannot
+    drift with numpy defaults across hosts). The router's shared-tier
+    key — replicas serving the same checkpoint produce identical KV for
+    identical ids, so the hash alone decides 'already have it'."""
+    import numpy as np
+    return hashlib.sha256(
+        np.asarray(list(ids), dtype="<i8").tobytes()).hexdigest()
 
 
 @dataclass
@@ -63,6 +101,16 @@ class PrefixEntry:
     def length(self) -> int:
         return len(self.ids)
 
+    @property
+    def nbytes(self) -> int:
+        k = getattr(self.k, "nbytes", 0) or 0
+        v = getattr(self.v, "nbytes", 0) or 0
+        return int(k) + int(v)
+
+    @property
+    def token_hash(self) -> str:
+        return token_hash(self.ids)
+
 
 class PrefixStore:
     """Keyed by the exact token tuple; `match` finds the longest cached
@@ -70,15 +118,20 @@ class PrefixStore:
 
     def __init__(self, grain_ladder: tuple[int, ...] = DEFAULT_GRAIN_LADDER,
                  max_entries: int = 8, promote_after: int = 2,
-                 max_tracked: int = 256) -> None:
+                 max_tracked: int = 256, max_bytes: int = 0) -> None:
         self.grain_ladder = tuple(sorted(grain_ladder))
         self.max_entries = max_entries
         self.promote_after = promote_after
         self.max_tracked = max_tracked
+        self.max_bytes = max_bytes
         self._lock = threading.Lock()
         self._entries: dict[tuple[int, ...], PrefixEntry] = {}
         # head tuple -> times seen (insertion-ordered; trimmed FIFO).
         self._seen: dict[tuple[int, ...], int] = {}
+        # /metrics counters (monotonic ints; torn reads harmless).
+        self.hits_total = 0
+        self.misses_total = 0
+        self.evictions_total = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -87,6 +140,11 @@ class PrefixStore:
     def hits(self) -> int:
         with self._lock:
             return sum(e.hits for e in self._entries.values())
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries.values())
 
     def match(self, ids: list[int]) -> Optional[PrefixEntry]:
         """Longest entry that is a proper prefix of ``ids`` (at least one
@@ -101,6 +159,9 @@ class PrefixStore:
             if best is not None:
                 best.hits += 1
                 best.last_used = time.monotonic()
+                self.hits_total += 1
+            else:
+                self.misses_total += 1
             return best
 
     def observe(self, ids: list[int]) -> Optional[tuple[int, ...]]:
@@ -143,20 +204,36 @@ class PrefixStore:
         return candidate
 
     def put(self, entry: PrefixEntry) -> None:
-        """Insert (idempotent), evicting the least-recently-used entry
-        past ``max_entries``. Safe between admission dispatches: evicted
-        device arrays are freed by refcount after their last use.
+        """Insert (idempotent), then evict down to policy: the byte
+        budget first when ``max_bytes`` is set — cost = bytes x recency
+        (kv_tier.cost_evict, shared with the session host pool), so one
+        giant stale entry goes before many small warm ones — and the
+        ``max_entries`` count cap as the hard sanity bound either way.
+        Safe between admission dispatches: evicted device arrays are
+        freed by refcount after their last use.
 
         Entry lengths are NOT required to be on the grain ladder:
         auto-promoted heads are ladder lengths by construction
         (``observe`` only counts ladder grains), but registered
         templates cache at their exact token length — the operator names
         finitely many, and warmup compiles their admission shapes."""
+        from .kv_tier import cost_evict
         with self._lock:
             self._entries[entry.ids] = entry
+            if self.max_bytes:
+                over = (sum(e.nbytes for e in self._entries.values())
+                        - self.max_bytes)
+                if over > 0:
+                    items = [(e.ids, e.nbytes, e.last_used)
+                             for e in self._entries.values()
+                             if e.ids != entry.ids]    # newest never evicts itself
+                    for ids in cost_evict(items, over):
+                        del self._entries[ids]
+                        self.evictions_total += 1
             while len(self._entries) > self.max_entries:
                 lru = min(self._entries.values(), key=lambda e: e.last_used)
                 del self._entries[lru.ids]
+                self.evictions_total += 1
 
     def lengths(self) -> list[int]:
         """Distinct cached prefix lengths (for warmup compilation)."""
@@ -166,3 +243,59 @@ class PrefixStore:
     def snapshot(self) -> list[PrefixEntry]:
         with self._lock:
             return list(self._entries.values())
+
+    # -- cross-replica shared tier (router-driven import/export) -------------
+
+    def hashes(self) -> dict[str, dict]:
+        """{token_hash: {"len": P, "hits": n}} for every cached entry —
+        the router's scrape surface (GET /admin/prefix): small JSON, no
+        KV bytes; the hash alone decides which replicas lack what."""
+        with self._lock:
+            return {e.token_hash: {"len": e.length, "hits": e.hits}
+                    for e in self._entries.values()}
+
+    def export_payload(self, h: str) -> Optional[bytes]:
+        """Serialize one entry (by token hash) for a peer replica: ids +
+        K/V as float32 (bf16 -> f32 is lossless; the importer casts back
+        to its compute dtype) in an npz container. None = not cached."""
+        import numpy as np
+        import jax
+        with self._lock:
+            entry = next((e for e in self._entries.values()
+                          if e.token_hash == h), None)
+        if entry is None:
+            return None
+        k = np.asarray(jax.device_get(entry.k), dtype=np.float32)
+        v = np.asarray(jax.device_get(entry.v), dtype=np.float32)
+        buf = io.BytesIO()
+        np.savez_compressed(
+            buf, version=np.int64(_WIRE_VERSION),
+            ids=np.asarray(entry.ids, np.int64),
+            dtype=np.bytes_(str(entry.k.dtype).encode()), k=k, v=v)
+        return buf.getvalue()
+
+    def import_payload(self, data: bytes) -> Optional[PrefixEntry]:
+        """Install a peer's exported entry (idempotent — an already-
+        cached head just refreshes). Returns the entry, or None on a
+        malformed/incompatible payload (logged by the caller). The K/V
+        was computed by the same prefill math on the same checkpoint on
+        the exporting replica, so admission through an imported entry
+        keeps the oracle-equality contract."""
+        import numpy as np
+        import jax.numpy as jnp
+        try:
+            with np.load(io.BytesIO(data)) as z:
+                if int(z["version"]) != _WIRE_VERSION:
+                    return None
+                ids = tuple(int(t) for t in z["ids"])
+                dtype = z["dtype"].tobytes().decode()
+                k = jnp.asarray(z["k"]).astype(dtype)
+                v = jnp.asarray(z["v"]).astype(dtype)
+        except Exception:   # noqa: BLE001 — peer payloads are untrusted
+            return None
+        if not ids or k.ndim != 4 or k.shape != v.shape \
+                or k.shape[1] != len(ids):
+            return None
+        entry = PrefixEntry(ids=ids, k=k, v=v)
+        self.put(entry)
+        return entry

@@ -56,7 +56,12 @@ it makes serve.api main() start this router instead of an engine),
 ``SERVE_ADDR`` (listen address, same flag as the single front),
 ``SERVE_ROUTER_SCRAPE_MS`` (readiness/metrics poll interval),
 ``SERVE_ROUTER_RETRIES`` (max distinct replicas tried per request; 0 =
-every eligible replica), ``SERVE_ROUTER_AFFINITY`` (session affinity
+every eligible replica), ``SERVE_ROUTER_PREFIX_SHARE`` (cross-replica
+shared prefix tier: the scrape loop reconciles each replica's cached
+prefixes by token hash and has missing replicas pull hot entries from
+the replica that promoted them — serve/prefix.py round 11; default on,
+replicas without a store answer 501 once and are skipped),
+``SERVE_ROUTER_AFFINITY`` (session affinity
 on/off), ``SERVE_ROUTER_TIMEOUT_S`` (per-proxied-request upstream
 timeout). The launcher path (``SERVE_REPLICAS=N`` in start_all.py)
 spawns N replica processes and wires this router in front of them.
@@ -96,6 +101,11 @@ _ADDITIVE_GAUGES = frozenset((
     "serve_queue_depth", "serve_inflight_requests",
     "serve_batch_occupancy", "serve_batch_slots",
     "serve_kv_free_pages", "serve_kv_total_pages",
+    # Multi-tier KV (serve/kv_tier.py): fleet totals of open/parked
+    # sessions and host-pool bytes are capacity numbers an operator
+    # sums (kv_wake_p50/p95_ms stay per-replica — quantiles never sum).
+    "kv_resident_sessions", "kv_parked_sessions", "kv_open_sessions",
+    "kv_host_bytes", "serve_prefix_entries", "prefix_bytes",
 ))
 
 
@@ -219,7 +229,8 @@ class ReplicaRouter:
                  retries: Optional[int] = None,
                  affinity: Optional[bool] = None,
                  timeout_s: Optional[float] = None,
-                 registry: Optional[Registry] = None) -> None:
+                 registry: Optional[Registry] = None,
+                 prefix_share: Optional[bool] = None) -> None:
         if not upstreams:
             raise ValueError("need at least one replica URL")
         self.addr_cfg = (addr if addr is not None
@@ -251,6 +262,27 @@ class ReplicaRouter:
         self._m_retries = self.metrics.counter("router_retries_total")
         self._m_shed = self.metrics.counter("router_requests_shed_total")
         self._m_errors = self.metrics.counter("router_errors_total")
+        # Cross-replica shared prefix tier (serve/prefix.py round 11):
+        # the scrape loop lists each replica's cached prefixes by token
+        # hash and tells replicas missing one to PULL it from the
+        # replica that built it — a prefix promoted by one replica's
+        # traffic becomes injectable fleet-wide, so session-affinity
+        # imbalance no longer decides who gets the admission win.
+        self.prefix_share = (prefix_share if prefix_share is not None
+                             else env_bool("SERVE_ROUTER_PREFIX_SHARE",
+                                           True))
+        self._m_prefix_syncs = self.metrics.counter(
+            "router_prefix_syncs_total")
+        self._m_prefix_sync_failures = self.metrics.counter(
+            "router_prefix_sync_failures_total")
+        self._prefix_unsupported: set[int] = set()  # guarded-by: _mu
+        # (dst index, hash) -> last import attempt time. Scrape-thread
+        # only. A replica whose store evicted an import (its cap is its
+        # own policy) must not be force-fed the same hash every pass —
+        # the cooldown turns a would-be import/evict thrash loop into
+        # one retry per minute.
+        self._prefix_sync_at: dict[tuple, float] = {}
+        self._prefix_sync_cooldown_s = 60.0
 
         self.router = Router()
         # The Ollama wire contract, proxied: generation endpoints route
@@ -376,6 +408,98 @@ class ReplicaRouter:
                 self._scrape_all()
             except Exception:   # noqa: BLE001
                 log.exception("scrape loop iteration failed")
+            try:
+                self._sync_prefixes()
+            except Exception:   # noqa: BLE001
+                log.exception("prefix sync pass failed")
+
+    # -- cross-replica shared prefix tier ------------------------------------
+
+    def _sync_prefixes(self) -> None:
+        """One shared-prefix reconciliation pass (scrape thread): list
+        every live replica's cached prefixes by token hash, pick each
+        missing hash's source (the replica with the most hits — it has
+        the hottest, most battle-tested copy), and tell the lacking
+        replica to pull it (POST /admin/prefix/import {"from", "h"}) —
+        KV bytes flow replica-to-replica, the router moves only control
+        JSON. Bounded to a few imports per pass so a cold fleet warms
+        over seconds without an import storm; only entries with >= 1
+        hit ship (cold promotions aren't worth evicting a destination's
+        hot entries for); a per-(destination, hash) cooldown keeps a
+        capacity-bound store that evicts an import from being force-fed
+        the same hash every pass; replicas without a prefix store (501)
+        are remembered and skipped."""
+        if not self.prefix_share or len(self.replicas) < 2:
+            return
+        import json as _json
+        views: dict[int, dict] = {}
+        for rep in self.replicas:
+            with self._mu:
+                skip = (not rep.alive
+                        or rep.index in self._prefix_unsupported)
+            if skip:
+                continue
+            try:
+                with urllib.request.urlopen(f"{rep.url}/admin/prefix",
+                                            timeout=2.0) as r:
+                    views[rep.index] = (_json.loads(r.read().decode())
+                                        .get("prefixes") or {})
+            except urllib.error.HTTPError as e:
+                code = e.code
+                e.close()
+                if code in (501, 404):
+                    # No prefix store on this replica — permanent; do
+                    # not re-probe it every pass.
+                    with self._mu:
+                        self._prefix_unsupported.add(rep.index)
+            except Exception:   # noqa: BLE001 — transient; next pass
+                pass
+        if len(views) < 2:
+            return
+        union: dict[str, tuple] = {}    # hash -> (hits, source url)
+        for idx, prefixes in views.items():
+            for h, meta in prefixes.items():
+                hits = float(meta.get("hits", 0) or 0)
+                cur = union.get(h)
+                if cur is None or hits > cur[0]:
+                    union[h] = (hits, self.replicas[idx].url)
+        now = time.monotonic()
+        if len(self._prefix_sync_at) > 2048:
+            self._prefix_sync_at = {
+                k: t for k, t in self._prefix_sync_at.items()
+                if now - t < self._prefix_sync_cooldown_s}
+        budget = 2                      # imports per pass — no storms
+        for idx, prefixes in views.items():
+            dst = self.replicas[idx].url
+            for h, (hits, src) in union.items():
+                if budget <= 0:
+                    return
+                if h in prefixes or src == dst:
+                    continue
+                # Only PROVEN entries ship: a promoted-but-never-hit
+                # prefix isn't worth an import (and with bounded
+                # per-replica stores, importing cold entries evicts hot
+                # ones — the exact inversion this feature must avoid).
+                if hits < 1:
+                    continue
+                last = self._prefix_sync_at.get((idx, h))
+                if (last is not None
+                        and now - last < self._prefix_sync_cooldown_s):
+                    continue
+                self._prefix_sync_at[(idx, h)] = now
+                try:
+                    req = urllib.request.Request(
+                        f"{dst}/admin/prefix/import",
+                        data=_json.dumps({"from": src, "h": h}).encode(),
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=10.0) as r:
+                        r.read()
+                    self._m_prefix_syncs.inc()
+                    log.info("prefix %s… synced %s -> %s", h[:12], src,
+                             dst)
+                except Exception:   # noqa: BLE001 — count, keep going
+                    self._m_prefix_sync_failures.inc()
+                budget -= 1
 
     def _eligible(self) -> list[_Replica]:
         """Replicas that may take NEW work, best-first: ready, not

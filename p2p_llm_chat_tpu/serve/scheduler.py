@@ -95,6 +95,11 @@ _SPEC_PROBE_EVERY = 8
 # Deferred prefix-promotion builds prefer idle ticks, but under
 # sustained load one build is allowed per this many decode ticks.
 _PROMOTE_EVERY_TICKS = 256
+# Widest suffix bucket a session wake admits single-shot: the wake
+# forward is ONE dispatch (no chunk ladder yet — recorded headroom), so
+# its decode-stall contribution is bounded by one S-wide verify.
+# Longer new turns cold-admit through the chunked path instead.
+_WAKE_MAX_SUFFIX = 256
 
 
 def _bucket(n: int, max_seq: int) -> int:
@@ -127,6 +132,16 @@ class _Slot:
     error: Optional[str] = None                        # surfaced by submit()
     prefix: Optional[PrefixEntry] = None               # cached-prefix admission
     prefix_checked: bool = False                       # match() ran for this slot
+    # Session wake (multi-tier KV, serve/kv_tier.py): the matched open
+    # session's key, and — for parked sessions — the prefetched
+    # on-device payload as (session object, device arrays): the H2D
+    # copy starts at match time so it overlaps admission work queued
+    # ahead of the wake dispatch, and the session stamp invalidates the
+    # prefetch if the session is replaced/re-parked before the claim (a
+    # stale payload scattered under a NEWER session's sizes would break
+    # the byte-identity contract, or crash the jitted scatter).
+    wake_key: Optional[str] = None
+    wake_dev: Optional[tuple] = None
     last_emit_t: float = 0.0                           # inter-token gap tracking
     # Admission-queue depth accounting (overload shedding): on_depart
     # fires exactly once, at the earlier of batch-row install or any
@@ -266,7 +281,9 @@ class BatchScheduler:
                  prefill_chunk: int = 256,
                  queue_max: Optional[int] = None,
                  loop_budget_ms: Optional[float] = None,
-                 drafter: Optional[object] = None) -> None:
+                 drafter: Optional[object] = None,
+                 kv_host_gb: float = 0.0,
+                 kv_idle_s: float = 30.0) -> None:
         """``admit_chunk``: burst-admission width. None (default) admits a
         backlog burst through one full-width prefill (minimal dispatches —
         best p95/throughput); a fixed power-of-two (e.g. 8) staggers the
@@ -354,6 +371,22 @@ class BatchScheduler:
         prefill_chunk — and the final chunk samples from the same
         logits; pinned by tests/test_chunked_prefill.py). 0 disables
         (whole-bucket admission, the legacy fused-K collapse rule).
+
+        ``kv_host_gb``: multi-tier KV — host-RAM session parking
+        (serve/kv_tier.py). > 0 enables: a finished request whose
+        client named a session (or whose prompt is long enough to
+        index by token head) keeps its KV *open* — resident in the
+        page pool first, parked to a host-RAM copy under idle timeout
+        (``kv_idle_s``) or page-pool pressure, dropped entirely by the
+        bytes x recency cost policy when the host budget fills. A
+        follow-up whose prompt extends the session's tokens *wakes* it:
+        parked pages re-upload and scatter back in one dispatch
+        (prefetched at match time, so the copy overlaps admission work
+        ahead of it) and only the new turn's suffix runs a forward —
+        admission compute drops from O(history) to O(new turn), and
+        open sessions are bounded by host RAM instead of HBM. Resumed
+        greedy output is BYTE-identical to a never-parked session (the
+        raw pool words round-trip). 0 disables (legacy: finish frees).
 
         ``prefix_cache``: shared-prefix KV caching (serve/prefix.py).
         Prompts that begin with a cached prefix (the co-pilot template,
@@ -486,8 +519,16 @@ class BatchScheduler:
             from .prefix import DEFAULT_GRAIN_LADDER
             ladder = tuple(g for g in DEFAULT_GRAIN_LADDER
                            if g + _MIN_BUCKET <= self.max_seq)
+            # SERVE_PREFIX_MB > 0 switches eviction to the byte-budget
+            # cost policy (bytes x recency, shared with the session
+            # tier); the count cap then relaxes to a sanity bound —
+            # entry count stops standing in for entry size. 0 keeps the
+            # legacy count-capped LRU.
+            mb = env_float("SERVE_PREFIX_MB", 0.0)
             self._prefix = (PrefixStore(grain_ladder=ladder,
-                                        promote_after=prefix_promote_after)
+                                        promote_after=prefix_promote_after,
+                                        max_bytes=int(mb * 1e6),
+                                        max_entries=64 if mb > 0 else 8)
                             if ladder else None)
         else:
             self._prefix = None
@@ -549,6 +590,24 @@ class BatchScheduler:
         self._stall_reset_req = threading.Event()
         self._stall_reset_ack = threading.Event()
         self._tbt_hist = Histogram("inter_token_ms")
+        # Multi-tier KV (serve/kv_tier.py): host-RAM session parking.
+        # All tier state transitions run on the scheduler thread (they
+        # copy device buffers only it may touch); the KVTier index
+        # itself is locked for /metrics readers.
+        self._tier = None
+        if kv_host_gb and kv_host_gb > 0:
+            from .kv_tier import KVTier
+            self._tier = KVTier(kv_host_gb * 1e9, idle_s=kv_idle_s)
+            log.info("KV tiering on: %.2f GB host budget, idle park "
+                     "after %.1fs", kv_host_gb, kv_idle_s)
+        self._wake_hist = Histogram("kv_wake_ms")
+        self._last_tier_sweep = 0.0   # owned-by: _loop
+        # Wake/cold admission fairness: set after a contended round
+        # dispatched a wake ahead of carried cold work — the NEXT
+        # contended round lets the cold chunk go first (a sustained
+        # wake stream must not starve cold admissions to their queue
+        # deadline).
+        self._wake_rr_cold = False    # owned-by: _loop
         # Draft sources, priority order: n-gram prompt-lookup first (it
         # is ~free when it hits), the resident draft model filling in on
         # misses. The model drafter must match the target's batch
@@ -713,6 +772,93 @@ class BatchScheduler:
 
         self._make_spec = _make_spec
         self._spec_programs: dict[int, object] = {}
+
+        def _make_wake(kv_window: int, S: int):
+            """Session-wake admission program (multi-tier KV): ONE fused
+            dispatch re-opens waking sessions — install each waking
+            row's page table (paged) and length ATOMICALLY (the chunked-
+            admission splice discipline: a half-woken row never looks
+            live), run the suffix tokens through a verify-shaped
+            multi-position forward that attends the session's existing
+            pool KV at its DYNAMIC length (the decisive difference from
+            the prefix-cache programs, which bake the prefix length into
+            the compiled shape — sessions have arbitrary, growing
+            lengths, so they must be data, not shape), sample each
+            waking row's first token from its last suffix position, and
+            install the sampling state. Non-waking rows (mask off) pass
+            every buffer through unchanged; their verify writes land
+            beyond their trusted lengths or in the garbage page — the
+            overwrite-before-trust invariant, same as a spec tick.
+
+            tokens [B,S] right-padded suffixes; ints [4,B] = suffix
+            lens (0 = not waking) / session lengths / seeds / top_k;
+            floats [3,B] = temp/top_p/repeat_penalty; rings [B,_RING]
+            prompt-tail penalty windows; paged mode adds tables
+            [B,mppr] (each waking row's FULL page map: the session's
+            kept pages plus freshly-allocated growth pages)."""
+            def _wake(params, tokens, ints, floats, rings, *args):
+                if self.kv_mode == "paged":
+                    tables = args[0]
+                    rest = args[1:]
+                else:
+                    tables = None
+                    rest = args
+                (cache, keys, next_tokens, temps, top_ks, top_ps,
+                 ring, rps) = rest
+                suf, start = ints[0], ints[1]
+                mask = suf > 0
+                lengths = jnp.where(mask, start, cache.lengths).astype(
+                    cache.lengths.dtype)
+                if tables is not None:
+                    table = jnp.where(mask[:, None],
+                                      tables.astype(jnp.int32),
+                                      cache.page_table)
+                    cache = cache._replace(page_table=table,
+                                           lengths=lengths)
+                    pages = min(-(-(kv_window + S) // self.page_size),
+                                cache.max_pages_per_row)
+                    logits, cache = model.verify_step_paged(
+                        params, config, tokens, cache, mesh, pages=pages,
+                        last_idx=jnp.clip(suf - 1, 0, S - 1))
+                else:
+                    cache = cache._replace(lengths=lengths)
+                    logits, cache = model.verify_step(
+                        params, config, tokens, cache, mesh,
+                        kv_window=kv_window,
+                        last_idx=jnp.clip(suf - 1, 0, S - 1))
+                inc = jnp.where(mask, suf, 0)
+                cache = cache._replace(
+                    lengths=cache.lengths + inc.astype(cache.lengths.dtype))
+                B = tokens.shape[0]
+                last = logits[:, 0, :]                           # [B,V]
+                row_keys = jax.vmap(jax.random.PRNGKey)(ints[2])
+                toks, row_keys = sample_batched(last, row_keys, floats[0],
+                                                ints[3], floats[1],
+                                                ring=rings, rp=floats[2])
+                rings2 = rings.at[jnp.arange(B),
+                                  (start + suf) % _RING].set(toks)
+                m1 = mask[:, None]
+                keys = jnp.where(m1, row_keys, keys)
+                next_tokens = jnp.where(m1, toks[:, None], next_tokens)
+                temps = jnp.where(mask, floats[0], temps)
+                top_ks = jnp.where(mask, ints[3], top_ks)
+                top_ps = jnp.where(mask, floats[1], top_ps)
+                ring = jnp.where(m1, rings2, ring)
+                rps = jnp.where(mask, floats[2], rps)
+                return (toks, cache, keys, next_tokens, temps, top_ks,
+                        top_ps, ring, rps)
+            first = 6 if self.kv_mode == "paged" else 5
+            return jax.jit(_wake,
+                           donate_argnums=tuple(range(first, first + 8)))
+
+        self._make_wake = _make_wake
+        self._wake_programs: dict[tuple[int, int], object] = {}
+        # (window, S) wake shapes that have EXECUTED (the jit wrappers
+        # compile on first call — a live-stream wake through an unrun
+        # shape would stall every stream for the compile, so unwarmed
+        # shapes demote to cold admission instead; _chunk_shapes_run's
+        # discipline).
+        self._wake_shapes_run: set[tuple] = set()  # owned-by: _loop
 
         def _prefill_first_token(params, tokens, ints, floats, rings):
             """Shared admission prologue (dense and paged): batched prefill
@@ -907,6 +1053,19 @@ class BatchScheduler:
             self._admit_prefix_j = jax.jit(
                 _admit_batch_prefix,
                 donate_argnums=(7, 8, 9, 10, 11, 12, 13, 14))
+
+        # Multi-tier KV copy programs: the park gather and wake scatter
+        # move a session's raw pool words (int8 + head-major scales
+        # included) in ONE dispatch each; jit re-specializes per padded
+        # page-count bucket automatically (callers pad the page list to
+        # a power of two so the compile cache stays small). Dense rows
+        # use per-width slice/set programs (_extract_row_for).
+        if self.kv_mode == "paged":
+            from ..ops.paged_kv import gather_pages, scatter_pages
+            self._gather_pages_j = jax.jit(gather_pages)
+            self._scatter_pages_j = jax.jit(scatter_pages,
+                                            donate_argnums=(0,))
+        self._row_copy_programs: dict[tuple, object] = {}
 
         def _make_prefill_chunk_program(P0: int, S: int, OFF: int, C: int):
             """ONE continuation-prefill chunk program of the chunked
@@ -1181,6 +1340,38 @@ class BatchScheduler:
             self._decode_fused_programs[(window, K)] = p
         return p
 
+    def _wake_for(self, window: int, S: int):
+        p = self._wake_programs.get((window, S))
+        if p is None:
+            p = self._make_wake(window, S)
+            self._wake_programs[(window, S)] = p
+        return p
+
+    def _extract_row_for(self, W: int):
+        """Dense-row park gather: one [L,W,Hkv,D] slice pair per
+        session (W = the session's power-of-two width bucket)."""
+        key = ("extract", W)
+        p = self._row_copy_programs.get(key)
+        if p is None:
+            def _ex(cache, row):
+                return cache.k[:, row, :W], cache.v[:, row, :W]
+            p = jax.jit(_ex)
+            self._row_copy_programs[key] = p
+        return p
+
+    def _inject_row_for(self, W: int):
+        """Dense-row wake scatter: the inverse copy, donated so the
+        upload lands in place."""
+        key = ("inject", W)
+        p = self._row_copy_programs.get(key)
+        if p is None:
+            def _in(cache, row, k, v):
+                return cache._replace(k=cache.k.at[:, row, :W].set(k),
+                                      v=cache.v.at[:, row, :W].set(v))
+            p = jax.jit(_in, donate_argnums=(0,))
+            self._row_copy_programs[key] = p
+        return p
+
     def _prefill_chunk_for(self, P0: int, S: int, off: int, C: int):
         """Jitted continuation-prefill chunk program (compiled once per
         (prefix length, suffix bucket, offset, chunk width) — warmup
@@ -1429,6 +1620,17 @@ class BatchScheduler:
                                                synthetic=True))
         for w in windows:
             steps.append(lambda w=w: self._warm_window(w))
+        if self._tier is not None:
+            # Session-wake programs compile per (window, suffix bucket):
+            # warm the cross product so a wake under live traffic never
+            # compiles mid-serving (unwarmed shapes demote to cold
+            # admission — correct, but forfeits the wake win exactly
+            # when the session economics matter).
+            for S in buckets:
+                if S > _WAKE_MAX_SUFFIX:
+                    continue
+                for w in windows:
+                    steps.append(lambda w=w, S=S: self._warm_wake(w, S))
         if self._draft_model is not None:
             # Drafter programs (steady-state draft shape per window +
             # the admission-prefill feed shapes) ride the same one-job-
@@ -1674,6 +1876,35 @@ class BatchScheduler:
         if keys_before is not None:
             self._keys = jnp.where(jnp.asarray(live)[:, None],
                                    keys_before, self._keys)
+
+    # graftcheck: runs-on _loop
+    def _warm_wake(self, w: int, S: int) -> None:
+        """Compile+run one session-wake program as an all-masked-off
+        no-op on live state. Non-waking rows pass every buffer through
+        unchanged (keys included — no restore dance needed, unlike
+        _warm_window), and the verify writes land beyond trusted
+        lengths / in the garbage page."""
+        if w < S:
+            return   # dispatch never picks w < start + S
+        B = self.num_slots
+        tokens = np.zeros((B, S), np.int32)
+        ints = np.zeros((4, B), np.int32)
+        floats = np.zeros((3, B), np.float32)
+        floats[1] = 1.0
+        floats[2] = 1.0
+        rings = np.full((B, _RING), self.config.vocab_size, np.int32)
+        args = [self._params, jnp.asarray(tokens), jnp.asarray(ints),
+                jnp.asarray(floats), jnp.asarray(rings)]
+        if self.kv_mode == "paged":
+            args.append(jnp.asarray(
+                np.zeros((B, self._cache.max_pages_per_row), np.int32)))
+        args += [self._cache, self._keys, self._next_dev,
+                 self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                 self._ring_dev, self._rps_dev]
+        (_, self._cache, self._keys, self._next_dev, self._temps_dev,
+         self._top_ks_dev, self._top_ps_dev, self._ring_dev,
+         self._rps_dev) = self._wake_for(w, S)(*args)
+        self._wake_shapes_run.add((w, S))
 
     # graftcheck: runs-on _loop
     def _probe_device_step(self) -> None:
@@ -1954,6 +2185,7 @@ class BatchScheduler:
                     return
                 if self._prefix is not None:
                     self._drain_promotions()
+                self._tier_sweep()
                 if self._prefill_carry is not None:
                     # Chunked admission in progress: ONE continuation
                     # chunk per loop iteration — the decode tick below
@@ -2287,6 +2519,35 @@ class BatchScheduler:
             out["serve_prefix_entries"] = len(self._prefix)
             out["serve_prefix_admits_total"] = self._n_prefix_admits
             out["serve_prefix_tokens_saved_total"] = self._n_prefix_tokens
+            # Store-level hit/miss/eviction counters (the store tracked
+            # hits internally for LRU long before exporting anything —
+            # now the fleet can see prefix efficacy per replica and in
+            # the router's unsuffixed totals).
+            out["prefix_hits_total"] = self._prefix.hits_total
+            out["prefix_misses_total"] = self._prefix.misses_total
+            out["prefix_evictions_total"] = self._prefix.evictions_total
+            out["prefix_bytes"] = self._prefix.nbytes
+        if self._tier is not None:
+            res, parked = self._tier.counts()
+            # Multi-tier KV: open = resident (pages held in HBM) +
+            # parked (host-RAM copy). The whole point of the tier is
+            # that open_sessions is bounded by SERVE_KV_HOST_GB, not
+            # by the page pool.
+            out["kv_resident_sessions"] = res
+            out["kv_parked_sessions"] = parked
+            out["kv_open_sessions"] = res + parked
+            out["kv_host_bytes"] = self._tier.host_bytes
+            out["kv_parked_total"] = self._tier.n_parked_total
+            out["kv_waked_total"] = self._tier.n_waked_total
+            out["kv_wake_cold_total"] = self._tier.n_wake_cold_total
+            out["kv_wake_tokens_saved_total"] = \
+                self._tier.n_wake_tokens_total
+            out["kv_evicted_total"] = self._tier.n_evicted_total
+            out["kv_pages_freed_total"] = self._tier.n_pages_freed_total
+            out["kv_wake_p50_ms"] = round(
+                self._wake_hist.percentile(50) or 0.0, 3)
+            out["kv_wake_p95_ms"] = round(
+                self._wake_hist.percentile(95) or 0.0, 3)
         if self.kv_mode == "paged":
             out["serve_kv_free_pages"] = self._alloc.free_pages
             out["serve_kv_total_pages"] = self.num_pages - 1
@@ -2321,6 +2582,12 @@ class BatchScheduler:
         need = self._alloc.pages_for(len(slot.prompt_ids) + slot.max_new + 1)
         need = min(need, self._cache.max_pages_per_row)
         pages = self._alloc.alloc(need)
+        if pages is None and self._tier is not None:
+            # Page-pool pressure: resident sessions are the reclaimable
+            # tier — park them to host RAM and retry before making the
+            # request wait (idle KV must never block admissions).
+            self._reclaim_pages(need)
+            pages = self._alloc.alloc(need)
         if pages is None:
             return False
         slot.pages = pages
@@ -2367,14 +2634,42 @@ class BatchScheduler:
             self._drain_promotions()
         had_active = len(free) < self.num_slots   # live streams to protect
         pending: list[_Slot] = []
+        # Session wakes (multi-tier KV): slots whose prompt extends an
+        # open session's tokens, grouped by suffix bucket. Classified
+        # wherever a slot has no page reservation yet (fresh arrivals
+        # and carried wake remnants); slots that already reserved cold
+        # pages keep their reservation.
+        wakes: dict[int, list[_Slot]] = {}
+
+        def _classify(s: _Slot) -> bool:
+            if self._tier is None or s.pages is not None or self._waiting:
+                return False
+            S = self._wake_candidate(s)
+            if S is None:
+                return False
+            wakes.setdefault(S, []).append(s)
+            return True
+
         for s in self._admit_carry:           # prepared last round
             if s.cancelled.is_set() or s.done or self._expired(s):
                 s.depart()                    # no longer queued, any path
+                s.wake_dev = None
                 if s.pages:                   # never installed in a table
                     self._alloc.free(s.pages)
                     s.pages = None
                 continue
-            pending.append(s)
+            if _classify(s):
+                continue
+            if self.kv_mode == "paged" and s.pages is None:
+                # A carried wake remnant whose session vanished since
+                # last round: it needs a cold reservation like any
+                # fresh request (same FIFO discipline vs waiters).
+                if self._waiting or not self._try_reserve(s):
+                    self._wait_or_fail(s)
+                else:
+                    pending.append(s)
+            else:
+                pending.append(s)
         self._admit_carry = []
         if self.kv_mode == "paged" and self._waiting:
             still: list[_Slot] = []
@@ -2393,12 +2688,15 @@ class BatchScheduler:
                 else:
                     still.append(s)
             self._waiting = still
-        room = len(free) - len(pending)
+        room = len(free) - len(pending) - sum(len(g) for g in wakes.values())
         if room > 0:
             fresh = self._collect_pending(
-                room, block and not pending and not self._waiting)
-            if self.kv_mode == "paged":
-                for s in fresh:
+                room, block and not pending and not wakes
+                and not self._waiting)
+            for s in fresh:
+                if _classify(s):
+                    continue
+                if self.kv_mode == "paged":
                     # Strict FIFO vs page-starved waiters: once anything is
                     # waiting for pages, fresh requests queue *behind* it —
                     # a stream of small requests must not bypass (and so
@@ -2411,8 +2709,79 @@ class BatchScheduler:
                         pending.append(s)
                     else:
                         self._wait_or_fail(s)
-            else:
-                pending.extend(fresh)
+                else:
+                    pending.append(s)
+        if not pending and not wakes:
+            return
+        if wakes and pending and had_active and self._wake_rr_cold:
+            # Fairness rotation: the previous contended round put a wake
+            # ahead of carried cold admissions — this round the cold
+            # chunk goes first and the wakes wait in the carry (they
+            # re-classify next round; the rotation bounds a sustained
+            # wake stream's head-of-line hold on cold requests to
+            # alternate rounds instead of their whole queue deadline).
+            self._wake_rr_cold = False
+            self._admit_carry = [x for S in sorted(wakes)
+                                 for x in wakes[S]]
+            wakes = {}
+        # Session wakes dispatch FIRST: each suffix bucket is one fused
+        # dispatch (table/length install + suffix forward + first-token
+        # sample, all in-program — the atomic-install discipline). With
+        # live streams at most ONE wake dispatch runs per round and
+        # everything behind it carries — the same bounded-stall rule
+        # chunked admission established.
+        one_wake = False
+        carry_tail: list[_Slot] = []
+        wake_keys = sorted(wakes)
+        for wi, S in enumerate(wake_keys):
+            group = wakes[S]
+            if (had_active and one_wake) or not free:
+                carry_tail.extend(group)
+                continue
+            batch = group[: len(free)]
+            carry_tail.extend(group[len(batch):])
+            rows = [free.pop(0) for _ in range(len(batch))]
+            try:
+                demoted, unused = self._admit_wake(batch, rows, S)
+            except Exception:   # noqa: BLE001
+                log.exception("wake admission failed for %d request(s)",
+                              len(batch))
+                for s in batch:
+                    s.fail("internal error: admission failed")
+                if self.kv_mode == "paged":
+                    # Same wholesale-abort rationale as the chunk path:
+                    # tables/pages may be half-installed.
+                    for s in (carry_tail
+                              + [x for S2 in wake_keys[wi + 1:]
+                                 for x in wakes[S2]] + pending):
+                        s.fail("internal error: admission failed")
+                    self._fail_all_and_reset()
+                    return
+                free.extend(rows)
+                self._recover_cache()
+                continue
+            one_wake = True
+            free.extend(unused)
+            for s in demoted:
+                # Session vanished between match and claim (replaced /
+                # evicted / taken by an earlier duplicate) or its page
+                # reservation failed: cold-admit this same round.
+                if self.kv_mode == "paged":
+                    if self._waiting or not self._try_reserve(s):
+                        self._wait_or_fail(s)
+                    else:
+                        pending.append(s)
+                else:
+                    pending.append(s)
+        if carry_tail or (had_active and one_wake):
+            rest = carry_tail + pending
+            if rest:
+                self._admit_carry = rest + self._admit_carry
+            if one_wake and pending:
+                # Cold work waited behind this wake: next contended
+                # round rotates priority (see _wake_rr_cold).
+                self._wake_rr_cold = True
+            return
         if not pending:
             return
         # Group by (cached prefix, prompt bucket): a chunk's rows must
@@ -2481,8 +2850,12 @@ class BatchScheduler:
                         # With no live streams the ladder compiles (and
                         # is cached) with nobody to stall.
                         self._start_prefill_carry(chunk, rows, S, R, C)
+                        # Append (not assign): deferred wake slots from
+                        # the fairness rotation may already sit in the
+                        # carry and must not be dropped.
                         self._admit_carry = group + [
-                            x for _, g in groups[gi + 1:] for x in g]
+                            x for _, g in groups[gi + 1:] for x in g
+                        ] + self._admit_carry
                         return
                     self._admit_chunk(chunk, rows, S, R)
                     if had_active and (group or gi + 1 < len(groups)):
@@ -2490,7 +2863,8 @@ class BatchScheduler:
                         # chunks remain: carry them so decode ticks run
                         # in between (bounded stalls per burst).
                         self._admit_carry = group + [
-                            x for _, g in groups[gi + 1:] for x in g]
+                            x for _, g in groups[gi + 1:] for x in g
+                        ] + self._admit_carry
                         return
                 except Exception:   # noqa: BLE001
                     log.exception("admission failed for %d request(s)",
@@ -3206,7 +3580,398 @@ class BatchScheduler:
             # same failed call; its per-row state maps dead rows either
             # way — rebuild alongside the target state.
             s.reset()
+        if self._tier is not None:
+            # Resident sessions' pages are ids into the allocator being
+            # rebuilt, over pool content being re-zeroed — drop them.
+            # Parked payloads live on host and survive the reset.
+            self._tier.reset_resident()
         self._reset_device_state()
+
+    # -- multi-tier KV: session park / wake (serve/kv_tier.py) ---------------
+
+    def _session_key(self, slot: _Slot) -> Optional[str]:
+        """Stable key for the conversation this slot belongs to: the
+        client's explicit session id (api front: ``X-Session-Id`` header
+        / ``session`` body field — the router's affinity id, so a
+        session's KV and its routing home coincide), else a hash of the
+        prompt's first HEAD_GRAIN token ids (context continuation names
+        no session, but a follow-up's prompt head is verbatim the prior
+        turn's — so the derived key matches across turns). None = too
+        short to index and anonymous: not worth retaining."""
+        sid = getattr(slot.req, "session", "")
+        if sid:
+            return f"sid:{sid}"
+        from .kv_tier import HEAD_GRAIN
+        toks = slot.prompt_ids
+        if len(toks) < HEAD_GRAIN:
+            return None
+        import hashlib
+        # graftcheck: sync-ok host token ids -> bytes for hashing, no device readback
+        h = hashlib.sha1(np.asarray(toks[:HEAD_GRAIN],
+                                    np.int64).tobytes()).hexdigest()[:16]
+        return f"head:{h}"
+
+    # graftcheck: runs-on _loop
+    def _retain_session(self, slot: _Slot, row: int) -> bool:
+        """Keep a finished request's KV open as a session instead of
+        freeing it. Returns True when the row's cleanup (table zero +
+        page ownership) was fully handled here — the caller skips the
+        legacy free path. The trusted content is tokens[0:ctx_len]
+        (prompt + all generated but the last; the final emitted token's
+        KV was never written), spanning ceil(ctx_len / page_size)
+        pages; trailing growth pages return to the pool. An in-flight
+        pipelined tick may still garbage-write past ctx_len through the
+        pre-zero table — those writes land beyond the trusted region
+        (kept tail page slack), in a trimmed page that any re-user
+        fully overwrites AFTER the in-flight tick by dispatch order,
+        or in garbage page 0. All contained."""
+        key = self._session_key(slot)
+        if key is None or slot.ctx_len <= 0:
+            return False
+        toks = (list(slot.prompt_ids) + list(slot.ids))[: slot.ctx_len]
+        if len(toks) < slot.ctx_len:
+            return False          # host mirror out of sync — don't trust
+        from .kv_tier import SessionKV
+        if self.kv_mode == "paged":
+            if not slot.pages:
+                return False
+            keep = min(len(slot.pages),
+                       self._alloc.pages_for(slot.ctx_len))
+            kept, extra = slot.pages[:keep], slot.pages[keep:]
+            try:
+                self._cache = self._zero_row_j(
+                    self._cache, jnp.asarray(row, jnp.int32))
+            except Exception:   # noqa: BLE001 — same contract as _release
+                log.exception("row-table zero failed; resetting")
+                self._fail_all_and_reset()
+                return True
+            if extra:
+                self._alloc.free(extra)
+            slot.pages = None
+            old = self._tier.take(key)
+            if old is not None:
+                self._recycle_session(old)
+            self._tier.insert(SessionKV(key=key, tokens=tuple(toks),
+                                        length=slot.ctx_len, pages=kept))
+            self._tier_enforce()
+            return True
+        # Dense rows have no pool residency to retain: park the row's
+        # KV to host immediately (one slice-gather dispatch + readback).
+        W = _bucket(slot.ctx_len, self.max_seq)
+        k, v = self._extract_row_for(W)(self._cache,
+                                        jnp.asarray(row, jnp.int32))
+        # graftcheck: sync-ok the park IS the host copy — one readback per finished session
+        payload = (np.asarray(k), np.asarray(v))
+        old = self._tier.take(key)
+        if old is not None:
+            self._recycle_session(old)
+        self._tier.insert(SessionKV(
+            key=key, tokens=tuple(toks), length=slot.ctx_len,
+            host=(payload, W), nbytes=sum(p.nbytes for p in payload)))
+        self._tier.n_parked_total += 1
+        self._tier_enforce()
+        return False
+
+    def _recycle_session(self, sess) -> None:
+        """Return a replaced session's resident pages to the allocator
+        (parked payloads are plain host arrays — refcount frees them)."""
+        if sess.pages:
+            self._alloc.free(sess.pages)
+            sess.pages = None
+
+    # graftcheck: runs-on _loop
+    def _park_session(self, sess) -> None:
+        """Demote one resident session to a host-RAM copy (paged mode):
+        ONE gather dispatch of the raw pool words (int8 + head-major
+        scales included), one readback, pages back to the allocator.
+        Wake re-uploads the same bits, so a parked-then-resumed greedy
+        stream is byte-identical to one that never left HBM."""
+        sess = self._tier.take(sess.key)
+        if sess is None or not sess.pages:
+            return
+        pages, n = sess.pages, len(sess.pages)
+        P2 = 1 << max(0, n - 1).bit_length()    # pow2 shape bucket
+        padded = pages + [0] * (P2 - n)
+        out = self._gather_pages_j(self._cache,
+                                   jnp.asarray(padded, jnp.int32))
+        # graftcheck: sync-ok the park IS the host copy — one readback per parked session
+        payload = tuple(None if a is None else np.asarray(a) for a in out)
+        self._alloc.free(pages)
+        from .kv_tier import SessionKV
+        self._tier.insert(SessionKV(
+            key=sess.key, tokens=sess.tokens, length=sess.length,
+            host=(payload, n),
+            nbytes=sum(a.nbytes for a in payload if a is not None),
+            last_used=sess.last_used))
+        self._tier.n_parked_total += 1
+        self._tier.n_pages_freed_total += n
+        self._tier_enforce()
+
+    # graftcheck: runs-on _loop
+    def _reclaim_pages(self, need: int) -> None:
+        """Page-pool pressure: park resident sessions (LRU first) until
+        ``need`` pages are free or none remain — idle sessions' HBM
+        turns into admission room instead of blocking requests."""
+        for sess in self._tier.park_candidates(force=True):
+            if self._alloc.free_pages >= need:
+                return
+            self._park_session(sess)
+
+    # graftcheck: runs-on _loop
+    def _tier_enforce(self) -> None:
+        """Apply the tier policies after an insert: the host byte
+        budget (cost = bytes x recency over parked sessions) and the
+        session index cap (plain LRU). Resident victims' pages return
+        to the allocator; parked victims just drop (their follow-up
+        cold-admits — tiering is invisible in outputs)."""
+        for sess in self._tier.host_victims():
+            self._tier.drop(sess)
+        for sess in self._tier.overflow_victims():
+            pages = self._tier.drop(sess)
+            if pages:
+                self._alloc.free(pages)
+
+    # graftcheck: runs-on _loop
+    def _tier_sweep(self) -> None:
+        """Idle parking: at most one park per ~250 ms loop pass (each
+        is a gather dispatch + readback — a bounded stall, amortised
+        the way promotion builds are)."""
+        if self._tier is None or self.kv_mode != "paged":
+            return
+        now = time.monotonic()
+        if now - self._last_tier_sweep < 0.25:
+            return
+        self._last_tier_sweep = now
+        cands = self._tier.park_candidates(now=now)
+        if cands:
+            self._park_session(cands[0])
+
+    def _wake_window(self, S: int, start: int) -> int:
+        """Attention window for a wake dispatch: covers every live
+        row's context plus the deepest waking session's start + S
+        suffix slots (the wake forward's query j attends positions
+        <= start + j)."""
+        deepest = max((s.ctx_len for s in self._slots if s is not None),
+                      default=0)
+        need = max(deepest + 1, start + S)
+        w = min(128, self.max_seq)
+        while w < need:
+            w *= 2
+        return min(w, self.max_seq)
+
+    # graftcheck: runs-on _loop
+    def _wake_candidate(self, slot: _Slot) -> Optional[int]:
+        """Suffix bucket S when ``slot`` can wake an open session, else
+        None (cold admission). Peeks only — _admit_wake claims the
+        session when the dispatch actually happens. For parked sessions
+        this also starts the host->device payload transfer NOW
+        (device_put is async), so the copy flies while any admission
+        work queued ahead — a chunked-prefill ladder included — runs."""
+        sess = self._tier.lookup(self._session_key(slot) or "",
+                                 slot.prompt_ids)
+        if sess is None:
+            return None
+        S = self._serving_bucket(len(slot.prompt_ids) - sess.length)
+        if sess.length + S > self.max_seq or S > _WAKE_MAX_SUFFIX:
+            return None
+        if self._any_active():
+            w = self._wake_window(S, sess.length)
+            if (w, S) not in self._wake_shapes_run:
+                return None   # a lazy compile would stall live streams
+        slot.wake_key = sess.key
+        if sess.parked:
+            if slot.wake_dev is None or slot.wake_dev[0] is not sess:
+                # (Re)start the async H2D prefetch — a stamp mismatch
+                # means the session was replaced/re-parked since the
+                # last match and the old payload is stale.
+                slot.wake_dev = (sess, tuple(
+                    None if a is None else jnp.asarray(a)
+                    for a in sess.host[0]))
+        else:
+            slot.wake_dev = None
+        return S
+
+    # graftcheck: runs-on _loop
+    def _wake_install_kv(self, slot: _Slot, row: int, sess,
+                         tables: "np.ndarray") -> bool:
+        """Paged wake KV placement: reserve the row's full page budget,
+        scatter a parked payload into the first pages (one dispatch —
+        the prefetched device arrays land here), and point the host
+        table at session pages + growth pages in logical order. False =
+        reservation failed even after parking others; the session goes
+        back untouched and the request cold-admits."""
+        need = self._alloc.pages_for(len(slot.prompt_ids)
+                                     + slot.max_new + 1)
+        need = min(need, self._cache.max_pages_per_row)
+        if sess.parked:
+            arrays, n = sess.host
+            need = max(need, n)
+            pages = self._alloc.alloc(need)
+            if pages is None:
+                self._reclaim_pages(need)
+                pages = self._alloc.alloc(need)
+            if pages is None:
+                self._tier.insert(sess)
+                slot.wake_dev = None     # demote must not pin the copy
+                return False
+            # The prefetched payload is only usable if it came from THIS
+            # session object — a replaced/re-parked session's bytes (and
+            # possibly shapes) differ.
+            dev = None
+            if slot.wake_dev is not None and slot.wake_dev[0] is sess:
+                dev = slot.wake_dev[1]
+            slot.wake_dev = None
+            if dev is None:
+                dev = tuple(None if a is None else jnp.asarray(a)
+                            for a in arrays)
+            P2 = arrays[0].shape[1]
+            padded = pages[:n] + [0] * (P2 - n)
+            self._cache = self._scatter_pages_j(
+                self._cache, jnp.asarray(padded, jnp.int32),
+                dev[0], dev[1], dev[2], dev[3])
+        else:
+            extra = need - len(sess.pages)
+            if extra > 0:
+                more = self._alloc.alloc(extra)
+                if more is None:
+                    self._reclaim_pages(extra)
+                    more = self._alloc.alloc(extra)
+                if more is None:
+                    self._tier.insert(sess)
+                    slot.wake_dev = None
+                    return False
+                pages = sess.pages + more
+            else:
+                pages = sess.pages
+            sess.pages = None          # ownership moves to the slot
+        slot.pages = pages
+        slot.ctx_budget = min(len(pages) * self.page_size, self.max_seq)
+        tables[row, : len(pages)] = pages
+        return True
+
+    # graftcheck: runs-on _loop
+    def _admit_wake(self, chunk: list[_Slot], rows: list[int],
+                    S: int) -> tuple[list[_Slot], list[int]]:
+        """One fused wake dispatch for up to len(chunk) sessions sharing
+        a suffix bucket: claim each session, place its KV (resident
+        pages re-enter the new row's table; parked payloads scatter
+        back in one dispatch), then the wake program installs
+        tables/lengths ATOMICALLY with the suffix forward and the
+        first-token sample. Returns (demoted, unused_rows): slots whose
+        session vanished since matching or whose reservation failed —
+        the caller cold-admits them this same round."""
+        failpoint("serve.scheduler.admit")
+        t0 = time.monotonic()
+        B = self.num_slots
+        demoted: list[_Slot] = []
+        unused: list[int] = []
+        claimed: list[tuple[_Slot, int, object]] = []
+        for slot, row in zip(chunk, rows):
+            sess = self._tier.claim(slot.wake_key or "", slot.prompt_ids)
+            slot.wake_key = None
+            if sess is None:
+                slot.wake_dev = None
+                demoted.append(slot)
+                unused.append(row)
+                continue
+            claimed.append((slot, row, sess))
+        if not claimed:
+            return demoted, unused
+        w = self._wake_window(S, max(s.length for _, _, s in claimed))
+        if self._any_active() and (w, S) not in self._wake_shapes_run:
+            # The batched window outgrew the per-slot estimate (another
+            # waking session is deeper): compiling now would stall live
+            # streams — put everything back and cold-admit.
+            for slot, row, sess in claimed:
+                self._tier.insert(sess)
+                slot.wake_dev = None
+                demoted.append(slot)
+                unused.append(row)
+            return demoted, unused
+        mppr = (self._cache.max_pages_per_row
+                if self.kv_mode == "paged" else 0)
+        tokens = np.zeros((B, S), np.int32)
+        ints = np.zeros((4, B), np.int32)
+        floats = np.zeros((3, B), np.float32)
+        floats[1] = 1.0
+        floats[2] = 1.0
+        rings = np.full((B, _RING), self.config.vocab_size, np.int32)
+        tables = (np.zeros((B, mppr), np.int32)
+                  if self.kv_mode == "paged" else None)
+        live: list[tuple[_Slot, int]] = []
+        for slot, row, sess in claimed:
+            if self.kv_mode == "paged":
+                if not self._wake_install_kv(slot, row, sess, tables):
+                    demoted.append(slot)
+                    unused.append(row)
+                    continue
+            else:
+                arrays, Wb = sess.host
+                dev = None
+                if slot.wake_dev is not None and slot.wake_dev[0] is sess:
+                    dev = slot.wake_dev[1]
+                slot.wake_dev = None
+                if dev is None:
+                    dev = tuple(jnp.asarray(a) for a in arrays)
+                self._cache = self._inject_row_for(Wb)(
+                    self._cache, jnp.asarray(row, jnp.int32),
+                    dev[0], dev[1])
+            suffix = slot.prompt_ids[sess.length:]
+            o = slot.req.options
+            tokens[row, : len(suffix)] = suffix
+            ints[:, row] = (len(suffix), sess.length, slot.seed, o.top_k)
+            floats[:, row] = (o.temperature, o.top_p, o.repeat_penalty)
+            if o.repeat_penalty != 1.0:
+                start_i = max(0, len(slot.prompt_ids) - _RING)
+                for p_i in range(start_i, len(slot.prompt_ids)):
+                    rings[row, p_i % _RING] = slot.prompt_ids[p_i]
+            live.append((slot, row))
+        if not live:
+            return demoted, unused
+        self._admit_since_tick = True
+        prog = self._wake_for(w, S)
+        args = [self._params, jnp.asarray(tokens), jnp.asarray(ints),
+                jnp.asarray(floats), jnp.asarray(rings)]
+        if self.kv_mode == "paged":
+            args.append(jnp.asarray(tables))
+        args += [self._cache, self._keys, self._next_dev,
+                 self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                 self._ring_dev, self._rps_dev]
+        (toks_dev, self._cache, self._keys, self._next_dev,
+         self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+         self._ring_dev, self._rps_dev) = prog(*args)
+        self._wake_shapes_run.add((w, S))
+        # graftcheck: sync-ok B int32 first tokens — wake TTFT depends on it
+        first_toks = np.asarray(toks_dev)
+        # Draft-source admission before the install loop (same ordering
+        # contract as _install_admitted: release never precedes admit).
+        if self.spec_k and self._sources:
+            ctxs = {row: slot.prompt_ids for slot, row in live}
+            rws = [row for _, row in live]
+            for s in self._sources:
+                pf = getattr(s, "prefill", None)
+                if pf is not None:
+                    pf(rws, ctxs)
+                else:
+                    for r in rws:
+                        s.admit(r, ctxs[r])
+        now = time.monotonic()
+        wake_ms = (now - t0) * 1e3
+        self._n_admitted += len(live)
+        self._tier.n_waked_total += len(live)
+        for slot, row in live:
+            self._wake_hist.observe(wake_ms)
+            # Prompt tokens whose prefill the wake skipped (everything
+            # but the new turn's suffix) — the compute-saved counter.
+            self._tier.n_wake_tokens_total += int(ints[1, row])
+            slot.depart()
+            if slot.stats is not None:
+                slot.stats.ttft_s = now - slot.req.arrival_time
+            slot.ctx_len = len(slot.prompt_ids)
+            self._slots[row] = slot
+            if not self._append_token(slot, row, int(first_toks[row])):
+                self._release(row)
+        return demoted, unused
 
     def _release(self, row: int) -> None:
         """Free a row (finish() has already been queued where a consumer is
@@ -3218,6 +3983,9 @@ class BatchScheduler:
         self._slots[row] = None
         for s in self._sources:
             s.release(row)
+        if slot is not None and self._tier is not None:
+            if self._retain_session(slot, row):
+                return
         if self.kv_mode == "paged" and slot is not None and slot.pages:
             try:
                 self._cache = self._zero_row_j(

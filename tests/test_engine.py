@@ -142,6 +142,7 @@ def test_cancellation_frees_slot_and_others_complete(engine):
     assert text == oracle("after cancel", 8)
 
 
+@pytest.mark.slow   # ~30 s/mode (decode to context-full); ci.sh full
 def test_num_predict_unlimited(engine):
     """Ollama num_predict=-1 means until-EOS/context, not one token."""
     limited, _ = run(engine, "unbounded", max_tokens=2)

@@ -162,6 +162,7 @@ def _shutdown(procs):
             p.kill()
 
 
+@pytest.mark.slow   # ~35 s: two OS processes + compiles; ci.sh full
 def test_two_process_dp_serving_matches_oracle():
     coord = f"127.0.0.1:{_free_port()}"
     serve_port = _free_port()

@@ -34,7 +34,8 @@ def _oracle(params, tokens, lens, max_seq, steps, active=None):
     return outs
 
 
-@pytest.mark.parametrize("microbatches", [2, 4])
+@pytest.mark.parametrize("microbatches", [
+    2, pytest.param(4, marks=pytest.mark.slow)])   # tier-1 budget
 def test_pp_prefill_matches_dense(microbatches):
     mesh = make_mesh(MeshConfig(pp=2))
     rng = np.random.default_rng(0)
@@ -52,6 +53,7 @@ def test_pp_prefill_matches_dense(microbatches):
                              CFG.head_dim)
 
 
+@pytest.mark.slow   # ~32 s; prefill legs keep tier-1 pp coverage
 def test_pp_prefill_then_decode_matches_dense():
     """Full serving step through the pipeline: prefill + 3 decode ticks
     with the last row parked (the scheduler's continuous-batching mask)."""

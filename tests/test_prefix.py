@@ -135,6 +135,64 @@ def test_store_lru_eviction_bounds_entries():
     assert len(st) == 2
     assert st.match([2] * 64 + [0]) is None
     assert st.match([3] * 64 + [0]) is c
+    assert st.evictions_total == 1
+
+
+def test_store_counters_and_byte_budget_cost_eviction():
+    """Round-11 policy eviction: with max_bytes set, cost = bytes x
+    recency picks victims (one giant stale entry goes before small warm
+    ones), and the hit/miss/eviction counters export the store's
+    efficacy."""
+    st = PrefixStore(max_entries=10, max_bytes=100)
+    big = PrefixEntry(ids=tuple(range(64)),
+                      k=np.zeros(40, np.int8), v=np.zeros(40, np.int8))
+    st.put(big)
+    big.last_used -= 1000.0                       # long idle
+    small = PrefixEntry(ids=tuple(range(100, 132)),
+                        k=np.zeros(10, np.int8), v=np.zeros(10, np.int8))
+    st.put(small)                                 # 100 bytes total: fits
+    assert len(st) == 2 and st.evictions_total == 0
+    assert st.match(list(range(64)) + [7]) is big
+    assert st.hits_total == 1
+    assert st.match([999] * 70) is None
+    assert st.misses_total == 1
+    st.put(PrefixEntry(ids=tuple(range(200, 232)),
+                       k=np.zeros(10, np.int8), v=np.zeros(10, np.int8)))
+    # 120 bytes > 100: the big stale entry is the cost victim — NOT the
+    # small LRU-oldest-insert.
+    assert st.evictions_total == 1
+    assert st.match(list(range(64)) + [7]) is None
+    assert st.nbytes == 40
+
+
+def test_store_export_import_roundtrip_by_token_hash():
+    """The cross-replica shared tier: export on the promoting store,
+    import on a peer — ids, KV bits (f32 wire is lossless for f32/bf16
+    entries), and match behavior all survive; junk is rejected."""
+    from p2p_llm_chat_tpu.serve.prefix import token_hash
+    ids = tuple(int(t) for t in np.arange(24) % 7)
+    rng = np.random.RandomState(1)
+    k = jnp.asarray(rng.randn(CFG.num_layers, 24, CFG.num_kv_heads,
+                              CFG.head_dim), jnp.float32)
+    v = jnp.asarray(rng.randn(CFG.num_layers, 24, CFG.num_kv_heads,
+                              CFG.head_dim), jnp.float32)
+    src = PrefixStore()
+    src.put(PrefixEntry(ids=ids, k=k, v=v))
+    h = token_hash(ids)
+    assert h in src.hashes()
+    assert src.hashes()[h]["len"] == 24
+    data = src.export_payload(h)
+    assert data and src.export_payload("beef") is None
+
+    dst = PrefixStore()
+    entry = dst.import_payload(data)
+    assert entry is not None and entry.ids == ids
+    np.testing.assert_array_equal(np.asarray(entry.k), np.asarray(k))
+    np.testing.assert_array_equal(np.asarray(entry.v), np.asarray(v))
+    got = dst.match(list(ids) + [3])
+    assert got is entry
+    assert dst.import_payload(b"not an npz") is None
+    assert dst.import_payload(data[:40]) is None
 
 
 # -- admission parity against the uncached oracle -----------------------------

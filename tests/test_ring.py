@@ -77,6 +77,7 @@ def test_ring_prefill_ragged_rows_match_dense():
                                    atol=2e-4, rtol=2e-3)
 
 
+@pytest.mark.slow   # ~17 s; the tp-sp composition leg covers sp decode
 def test_sp_decode_matches_dense_decode():
     """Ring prefill -> several sp decode steps == dense prefill -> dense
     decode steps, including the parked-row (active) contract."""
@@ -125,7 +126,9 @@ def test_ring_prefill_rejects_mixed_mesh():
         ring_prefill(params, CFG, _tokens(2, 16), jnp.array([16, 16]), mesh)
 
 
-@pytest.mark.parametrize("tp,sp", [(2, 4), (2, 2)])
+@pytest.mark.parametrize("tp,sp", [
+    pytest.param(2, 4, marks=pytest.mark.slow),    # tier-1 budget
+    (2, 2)])
 def test_ring_tp_sp_composition_matches_dense(tp, sp):
     """Ring attention with heads tensor-parallel INSIDE the shard_map
     body (the 70B-class long-context configuration): prefill + decode

@@ -474,6 +474,120 @@ def test_metrics_replica_labels_and_fleet_totals():
         _stop(rt, reps)
 
 
+class PrefixStoreLLM(FakeLLM):
+    """Backend exposing a REAL PrefixStore through the round-11 share
+    hooks (the engine's surface, without the model): the router's
+    reconciliation pass must move entries between replicas."""
+
+    def __init__(self, name: str = "rep") -> None:
+        super().__init__(name=name)
+        from p2p_llm_chat_tpu.serve.prefix import PrefixStore
+        self.store = PrefixStore()
+
+    def prefix_hashes(self):
+        return self.store.hashes()
+
+    def prefix_export(self, h):
+        return self.store.export_payload(h)
+
+    def prefix_import(self, data):
+        return self.store.import_payload(data)
+
+
+def test_prefix_share_syncs_replicas():
+    """A prefix promoted on replica 0 appears on replica 1 within a few
+    scrape passes: the router lists by token hash and has the lacking
+    replica PULL the payload from the promoting one."""
+    import numpy as np
+    import jax.numpy as jnp
+    from p2p_llm_chat_tpu.serve.prefix import PrefixEntry, token_hash
+
+    backends = []
+
+    def factory(i):
+        b = PrefixStoreLLM()
+        backends.append(b)
+        return b
+
+    rt, reps = _fleet(2, backend_factory=factory, prefix_share=True)
+    try:
+        ids = tuple(int(t) for t in range(40))
+        rng = np.random.RandomState(0)
+        k = jnp.asarray(rng.randn(2, 40, 2, 4), jnp.float32)
+        # hits >= 1: only proven entries ship (the sync's hotness floor).
+        backends[0].store.put(PrefixEntry(ids=ids, k=k, v=k + 1, hits=3))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if len(backends[1].store) >= 1:
+                break
+            time.sleep(0.05)
+        got = backends[1].store.snapshot()
+        assert got and got[0].ids == ids, "prefix never synced"
+        assert got[0].token_hash == token_hash(ids)
+        np.testing.assert_array_equal(np.asarray(got[0].k),
+                                      np.asarray(k))
+        with urllib.request.urlopen(f"{rt.url}/metrics", timeout=5) as r:
+            snap = parse_metrics_text(r.read().decode())
+        assert snap["router_prefix_syncs_total"] >= 1.0
+        # Stable state: both replicas list the hash; no resync churn.
+        time.sleep(0.4)
+        assert len(backends[1].store) == 1
+    finally:
+        _stop(rt, reps)
+
+
+def test_prefix_share_skips_storeless_replicas():
+    """FakeLLM replicas answer 501 on /admin/prefix — the router marks
+    them unsupported once and the sync pass stays a no-op (no error
+    spam, no counter movement)."""
+    rt, reps = _fleet(2, prefix_share=True)
+    try:
+        time.sleep(0.5)              # several scrape+sync passes
+        with urllib.request.urlopen(f"{rt.url}/metrics", timeout=5) as r:
+            snap = parse_metrics_text(r.read().decode())
+        assert snap.get("router_prefix_syncs_total", 0) == 0
+        assert snap.get("router_prefix_sync_failures_total", 0) == 0
+        assert rt._prefix_unsupported == {0, 1}
+    finally:
+        _stop(rt, reps)
+
+
+class KVTierMetricsLLM(FakeLLM):
+    """Backend exporting the round-11 kv_* session gauges."""
+
+    def __init__(self, name: str = "rep", parked: float = 2.0) -> None:
+        super().__init__(name=name)
+        self.parked = parked
+
+    def metrics_snapshot(self):
+        return {"kv_parked_sessions": self.parked,
+                "kv_open_sessions": self.parked + 1,
+                "kv_host_bytes": 1000.0 * self.parked,
+                "kv_waked_total": self.parked,
+                "kv_wake_p50_ms": 5.0}
+
+
+def test_metrics_kv_tier_fleet_aggregation():
+    """Session/byte gauges sum into unsuffixed fleet totals (capacity
+    numbers an operator adds up); wake quantiles stay per-replica only
+    (summing a p50 would fabricate a number under the real name)."""
+    rt, reps = _fleet(2, backend_factory=lambda i: KVTierMetricsLLM(
+        parked=float(i + 1)))
+    try:
+        with urllib.request.urlopen(f"{rt.url}/metrics", timeout=5) as r:
+            snap = parse_metrics_text(r.read().decode())
+        assert snap['kv_parked_sessions{replica="0"}'] == 1.0
+        assert snap['kv_parked_sessions{replica="1"}'] == 2.0
+        assert snap["kv_parked_sessions"] == 3.0           # fleet sum
+        assert snap["kv_open_sessions"] == 5.0
+        assert snap["kv_host_bytes"] == 3000.0
+        assert snap["kv_waked_total"] == 3.0               # counter sums
+        assert 'kv_wake_p50_ms{replica="0"}' in snap
+        assert "kv_wake_p50_ms" not in snap   # no fabricated fleet p50
+    finally:
+        _stop(rt, reps)
+
+
 def test_merge_label_and_parse_helpers():
     assert _merge_label("m_total", 'replica="2"') == 'm_total{replica="2"}'
     assert (_merge_label('m_total{a="b"}', 'replica="2"')

@@ -203,7 +203,9 @@ def test_spec_verify_forced_rejection_samples_unmodified_distribution():
 
 # -- end-to-end ---------------------------------------------------------------
 
-@pytest.mark.parametrize("kv_mode", ["dense", "paged"])
+@pytest.mark.parametrize("kv_mode", [
+    pytest.param("dense", marks=pytest.mark.slow),   # tier-1 budget
+    "paged"])
 def test_spec_engine_greedy_matches_oracle(kv_mode):
     """Greedy speculative serving is bit-exact with the sequential greedy
     oracle — accepted drafts and corrections interleave invisibly — on
@@ -222,7 +224,9 @@ def test_spec_engine_greedy_matches_oracle(kv_mode):
         eng.stop()
 
 
-@pytest.mark.parametrize("kv_mode", ["dense", "paged"])
+@pytest.mark.parametrize("kv_mode", [
+    pytest.param("dense", marks=pytest.mark.slow),   # tier-1 budget
+    "paged"])
 def test_spec_engine_moe_greedy_matches_oracle(kv_mode):
     """The MoE leg of the same bit-exactness bar (round-4 verdict #3):
     speculative serving under a mixtral engine — the n-gram drafter
@@ -384,7 +388,8 @@ def _penalty_oracle(prompt: str, max_new: int, rp: float,
     return TOK.decode(out)
 
 
-@pytest.mark.parametrize("spec_k", [0, 4])
+@pytest.mark.parametrize("spec_k", [
+    0, pytest.param(4, marks=pytest.mark.slow)])     # tier-1 budget
 def test_repeat_penalty_greedy_matches_oracle(spec_k):
     """Engine greedy with repeat_penalty equals the sequential penalised
     oracle — with and without speculation (the per-position draft-prefix
