@@ -91,12 +91,23 @@ if [ "${1:-}" = "full" ]; then
   echo "== multi-tier KV: park/wake matrix (CPU)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tier.py -q || rc=1
 
+  # Loadgen: the WHOLE file including the slow-marked 4-peer end-to-end
+  # leg (directory + CPU-tiny engine + node/UI waves through
+  # tools/e2e_bench.py, failpoints armed at low probability, durable
+  # E2E row + chaos contracts asserted). Excluded from the sweep below
+  # so each case executes exactly once.
+  echo "== loadgen: stub contracts + 4-peer e2e leg with chaos (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_loadgen.py \
+    tests/test_devcrypto.py -q || rc=1
+
   echo "== full test suite"
   python -m pytest tests/ -q \
     --ignore=tests/test_flash_append_geometry.py \
     --ignore=tests/test_failpoints.py \
     --ignore=tests/test_router.py \
-    --ignore=tests/test_kv_tier.py || rc=1
+    --ignore=tests/test_kv_tier.py \
+    --ignore=tests/test_loadgen.py \
+    --ignore=tests/test_devcrypto.py || rc=1
 else
   # Fused-decode parity pinned explicitly on CPU: the K-fused-steps ≡
   # K-plain-ticks bit-identity contract (serve/scheduler.py
@@ -162,8 +173,21 @@ else
   JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tier.py -q -x \
     -m 'not slow' || rc=1
 
+  # Loadgen stub-server contracts (tier-1 legs): seeded schedule
+  # determinism, scenario-mix proportions, SLO-ledger percentile math,
+  # shed-vs-error-vs-truncated classification, the open-loop property,
+  # chaos window + degradation-contract checks — all against the
+  # in-process stub (no chip, no launcher). The slow-marked 4-peer
+  # end-to-end leg runs in full mode. Excluded from the sweep below so
+  # each case executes exactly once.
+  echo "== loadgen: stub-server + dev-crypto contracts (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_loadgen.py \
+    tests/test_devcrypto.py -q -x -m 'not slow' || rc=1
+
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
+    --ignore=tests/test_loadgen.py \
+    --ignore=tests/test_devcrypto.py \
     --ignore=tests/test_router.py \
     --ignore=tests/test_kv_tier.py \
     --ignore=tests/test_spec_draft.py \

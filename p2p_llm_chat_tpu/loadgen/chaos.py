@@ -1,0 +1,178 @@
+"""Chaos-under-load: arm failpoints during a run, then hold the line.
+
+Two halves:
+
+- :class:`ChaosWindow` arms a ``FAIL_POINTS``-grammar spec (utils/
+  failpoints.py) at ``arm_at_s`` into the run and disarms at
+  ``disarm_at_s`` — for drivers sharing a process with the servers
+  (stub tests, in-process engines). Multi-process runs instead pass the
+  spec through the launcher environment (tools/e2e_bench.py does this)
+  and use a window with ``in_process=False`` so the ledger still knows
+  which records flew under chaos.
+
+- :func:`check_contracts` re-asserts the PR 5 degradation contracts
+  *under load* from the driver's trace records:
+
+  1. every shed answered fast (< ``SHED_LATENCY_BUDGET_MS``) and
+     carrying ``Retry-After`` — backpressure a client can act on;
+  2. no hung streams — no OPENED request ran into the wall budget
+     (driver ``error_kind == "timeout"``: an in-stream stall or a
+     request wedged past the join deadline; pre-response connect
+     timeouts are ``conn-timeout`` and belong to the error-fraction
+     budget instead);
+  3. recovery after disarm — requests scheduled after
+     ``disarm_at_s + grace`` complete clean (ok, or a well-formed shed;
+     never error/truncated).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from ..utils import failpoints as _failpoints
+from ..utils.log import get_logger
+from .driver import SHED_LATENCY_BUDGET_MS, TraceRecord
+
+log = get_logger("loadgen.chaos")
+
+
+def parse_fail_points(spec: str) -> list:
+    """``FAIL_POINTS`` grammar -> [(site, action_spec), ...], validated
+    all-or-nothing exactly like utils.failpoints.load_env."""
+    parsed = []
+    for entry in spec.replace(";", ",").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, sep, action = entry.partition("=")
+        if not sep:
+            raise ValueError(f"chaos entry {entry!r} is not site=action")
+        _failpoints.parse_spec(action)
+        parsed.append((site.strip(), action))
+    return parsed
+
+
+class ChaosWindow:
+    """Arm ``spec`` for [arm_at_s, disarm_at_s) of a driver run."""
+
+    def __init__(self, spec: str, arm_at_s: float = 0.0,
+                 disarm_at_s: Optional[float] = None,
+                 in_process: bool = True) -> None:
+        self.spec = spec
+        self.arm_at_s = arm_at_s
+        self.disarm_at_s = disarm_at_s
+        self.in_process = in_process
+        self._entries = parse_fail_points(spec) if spec else []
+        self._timers: list = []
+        self._done = threading.Event()
+
+    def _arm(self) -> None:
+        for site, action in self._entries:
+            _failpoints.arm(site, action)
+        log.info("chaos armed: %s", self.spec)
+
+    def _disarm(self) -> None:
+        for site, _ in self._entries:
+            _failpoints.disarm(site)
+        log.info("chaos disarmed")
+
+    def start(self, t0: float) -> None:   # t0 unused: offsets are relative
+        if not self.in_process or not self._entries:
+            return
+        t_arm = threading.Timer(self.arm_at_s, self._arm)
+        t_arm.daemon = True
+        t_arm.start()
+        self._timers.append(t_arm)
+        if self.disarm_at_s is not None:
+            t_dis = threading.Timer(self.disarm_at_s, self._disarm)
+            t_dis.daemon = True
+            t_dis.start()
+            self._timers.append(t_dis)
+
+    def stop(self) -> None:
+        if self._done.is_set():
+            return
+        self._done.set()
+        for t in self._timers:
+            t.cancel()
+        if self.in_process and self._entries:
+            self._disarm()
+
+
+@dataclass
+class ContractReport:
+    sheds: int = 0
+    sheds_with_retry_after: int = 0
+    shed_max_ms: float = 0.0
+    sheds_fast: bool = True
+    hung_streams: int = 0
+    post_disarm_bad: int = 0
+    recovery_checked: bool = False
+    ok: bool = True
+    violations: tuple = ()
+
+    def to_dict(self) -> dict:
+        return {
+            "sheds": self.sheds,
+            "sheds_with_retry_after": self.sheds_with_retry_after,
+            "shed_max_ms": round(self.shed_max_ms, 1),
+            "hung_streams": self.hung_streams,
+            "post_disarm_bad": self.post_disarm_bad,
+            "recovery_checked": self.recovery_checked,
+            "ok": self.ok,
+            "violations": list(self.violations),
+        }
+
+
+def check_contracts(records: list, disarm_at_s: Optional[float] = None,
+                    recovery_grace_s: float = 2.0,
+                    shed_budget_ms: Optional[float] = None) -> ContractReport:
+    """Assert the degradation contracts over a run's trace records.
+
+    ``shed_budget_ms`` defaults to the 100 ms contract scaled by
+    ``LOADGEN_SLO_SCALE`` — the same host-profile scaling every other
+    client-side latency target gets (a 2-core container serving 128
+    processes puts a scheduler-starvation floor under EVERY response,
+    503s included; on real serving hosts scale is 1.0 and the strict
+    100 ms stands)."""
+    from .scenarios import slo_scale
+    if shed_budget_ms is None:
+        shed_budget_ms = SHED_LATENCY_BUDGET_MS * slo_scale()
+    rep = ContractReport()
+    violations = []
+    for r in records:
+        assert isinstance(r, TraceRecord)
+        if r.status == "shed":
+            rep.sheds += 1
+            if r.retry_after:
+                rep.sheds_with_retry_after += 1
+            if r.shed_ms is not None:
+                rep.shed_max_ms = max(rep.shed_max_ms, r.shed_ms)
+        if r.error_kind == "timeout":
+            rep.hung_streams += 1
+        if (disarm_at_s is not None
+                and r.sched_s >= disarm_at_s + recovery_grace_s
+                and r.status in ("error", "truncated")):
+            rep.post_disarm_bad += 1
+    rep.recovery_checked = disarm_at_s is not None
+    if rep.sheds and rep.sheds_with_retry_after < rep.sheds:
+        violations.append(
+            f"{rep.sheds - rep.sheds_with_retry_after}/{rep.sheds} sheds "
+            "missing Retry-After")
+    if rep.shed_max_ms > shed_budget_ms:
+        rep.sheds_fast = False
+        violations.append(
+            f"slowest shed answered in {rep.shed_max_ms:.0f} ms "
+            f"(budget {shed_budget_ms:.0f} ms)")
+    if rep.hung_streams:
+        violations.append(f"{rep.hung_streams} hung stream(s) hit the "
+                          "request wall budget")
+    if rep.post_disarm_bad:
+        violations.append(
+            f"{rep.post_disarm_bad} request(s) scheduled after chaos "
+            "disarm (+grace) still failed — no recovery")
+    rep.violations = tuple(violations)
+    rep.ok = not violations
+    return rep

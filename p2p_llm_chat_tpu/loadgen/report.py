@@ -1,0 +1,200 @@
+"""The SLO ledger: trace records in, one BENCH-style JSON row out.
+
+Per scenario and in aggregate: TTFT p50/p95 (queue lag included — the
+open-loop driver's stall signal), inter-token p95, the shed/error
+taxonomy, goodput (completions *meeting their SLO* per second — the
+serving-evaluation convention bench.py's mixed phase follows), and a
+pass/fail verdict against the scenario targets from scenarios.py.
+
+Rows are durable by the same convention as the bench: the first free
+``E2E_r0N.json`` slot in the repo root (``BENCH_r0N.json``'s sibling),
+and a failed run writes an *error row* rather than nothing — a crashed
+64-peer run that silently prints to a lost stdout is an hour of chip
+time unrecorded.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from .chaos import ContractReport
+from .driver import TraceRecord
+from .scenarios import SLO, slo_scale
+
+# Beyond sheds (bounded per-scenario by the SLO), a run where more than
+# this fraction of a scenario's arrivals error/truncate cannot pass —
+# broken is not slow. Sized ABOVE the standard armed-chaos fault rates
+# (a run with stream-chaos at 2%/delta expects a few percent of
+# client-visible anomalies BY DESIGN; a tighter gate would fail runs
+# for injecting exactly the faults they armed).
+MAX_BAD_FRAC = 0.10
+# Fraction gates (shed/bad) need a minimum sample to mean anything: at
+# n=2 a single pulse-shed reads as "50% shed" and fails a scenario on
+# one coin flip. Below this count the fractions are still REPORTED,
+# just not judged; latency percentiles are judged at any n (weak at
+# small n, but never flipped by a single event the budget allows).
+MIN_FRACTION_N = 8
+
+
+def percentile(xs: list, p: float) -> Optional[float]:
+    """Nearest-rank on the sorted sample (bench.py's _pct convention)."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))]
+
+
+def _judge_scenario(name: str, recs: list, slo: SLO, duration_s: float,
+                    scale: float) -> dict:
+    n = len(recs)
+    by = {s: sum(1 for r in recs if r.status == s)
+          for s in ("ok", "shed", "error", "truncated")}
+    ttfts = [r.slo_ttft_ms() for r in recs
+             if r.status == "ok" and r.slo_ttft_ms() is not None]
+    itls: list = []
+    for r in recs:
+        if r.status == "ok":
+            itls.extend(r.itl_ms)
+    p50 = percentile(ttfts, 50)
+    p95 = percentile(ttfts, 95)
+    itl_p95 = percentile(itls, 95)
+    shed_frac = by["shed"] / n if n else 0.0
+    bad_frac = (by["error"] + by["truncated"]) / n if n else 0.0
+
+    t_p50 = slo.ttft_p50_ms * scale
+    t_p95 = slo.ttft_p95_ms * scale
+    t_itl = slo.itl_p95_ms * scale if slo.itl_p95_ms is not None else None
+    violations = []
+    if n == 0:
+        pass    # nothing arrived for this scenario: vacuous pass
+    elif not ttfts:
+        # All arrivals shed/errored. At a judgeable sample size that is
+        # a dead scenario; below MIN_FRACTION_N it is the same
+        # coin-flip problem as the fraction gates (e.g. 3 arrivals all
+        # landing inside the chaos pulse) — reported, not judged.
+        if n >= MIN_FRACTION_N:
+            violations.append("no completion survived to judge")
+    else:
+        if p50 is not None and p50 > t_p50:
+            violations.append(f"ttft_p50 {p50:.0f} ms > {t_p50:.0f} ms")
+        if p95 is not None and p95 > t_p95:
+            violations.append(f"ttft_p95 {p95:.0f} ms > {t_p95:.0f} ms")
+        if t_itl is not None and itl_p95 is not None and itl_p95 > t_itl:
+            violations.append(f"itl_p95 {itl_p95:.0f} ms > {t_itl:.0f} ms")
+    if n >= MIN_FRACTION_N and shed_frac > slo.max_shed_frac:
+        violations.append(
+            f"shed_frac {shed_frac:.2f} > {slo.max_shed_frac:.2f}")
+    if n >= MIN_FRACTION_N and bad_frac > MAX_BAD_FRAC:
+        violations.append(f"error+truncated frac {bad_frac:.2f} > "
+                          f"{MAX_BAD_FRAC:.2f}")
+
+    # Goodput: completions that individually met the SLO, per second of
+    # scheduled run time.
+    good = 0
+    for r in recs:
+        if r.status != "ok":
+            continue
+        t = r.slo_ttft_ms()
+        if t is None or t > t_p95:
+            continue
+        own_itl = percentile(r.itl_ms, 95)
+        if t_itl is not None and own_itl is not None and own_itl > t_itl:
+            continue
+        good += 1
+
+    bad_kinds: dict = {}
+    for r in recs:
+        if r.status in ("error", "truncated"):
+            k = r.error_kind or r.status
+            bad_kinds[k] = bad_kinds.get(k, 0) + 1
+    return {
+        "n": n, "ok": by["ok"], "shed": by["shed"], "error": by["error"],
+        "truncated": by["truncated"],
+        "bad_kinds": bad_kinds,
+        "ttft_p50_ms": round(p50, 1) if p50 is not None else None,
+        "ttft_p95_ms": round(p95, 1) if p95 is not None else None,
+        "itl_p95_ms": round(itl_p95, 2) if itl_p95 is not None else None,
+        "lag_p95_ms": round(percentile(
+            [r.lag_ms for r in recs], 95) or 0.0, 1) if n else None,
+        "tokens": sum(r.tokens for r in recs),
+        "shed_frac": round(shed_frac, 4),
+        "goodput_rps": round(good / duration_s, 3) if duration_s else None,
+        "slo": {"ttft_p50_ms": t_p50, "ttft_p95_ms": t_p95,
+                "itl_p95_ms": t_itl, "max_shed_frac": slo.max_shed_frac},
+        "pass": not violations,
+        "violations": violations,
+    }
+
+
+def build_ledger(records: list, registry: dict, duration_s: float,
+                 meta: Optional[dict] = None,
+                 contract: Optional[ContractReport] = None) -> dict:
+    """All trace records -> the run's ledger row (JSON-serialisable)."""
+    scale = slo_scale()
+    per: dict = {}
+    for name, scen in registry.items():
+        recs = [r for r in records if r.scenario == name]
+        per[name] = _judge_scenario(name, recs, scen.slo, duration_s, scale)
+
+    n = len(records)
+    ok = sum(1 for r in records if r.status == "ok")
+    shed = sum(1 for r in records if r.status == "shed")
+    bad = sum(1 for r in records if r.status in ("error", "truncated"))
+    failures = [f"{name}: {v}" for name, s in sorted(per.items())
+                for v in s["violations"]]
+    if contract is not None:
+        failures.extend(f"chaos: {v}" for v in contract.violations)
+    row = {
+        "metric": "loadgen_e2e",
+        "schema": 1,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "duration_s": round(duration_s, 2),
+        "arrivals": n,
+        "ok": ok, "shed": shed, "bad": bad,
+        "shed_frac": round(shed / n, 4) if n else None,
+        "goodput_rps": round(sum(
+            s["goodput_rps"] or 0.0 for s in per.values()), 3),
+        "slo_scale": scale,
+        "scenarios": per,
+        "chaos": contract.to_dict() if contract is not None else None,
+        "verdict": "pass" if (not failures and n > 0) else "fail",
+        "failures": failures,
+    }
+    if meta:
+        row.update(meta)
+    return row
+
+
+def next_row_path(directory: str, prefix: str = "E2E") -> str:
+    """First free ``<prefix>_r0N.json`` slot — the BENCH_r0N convention."""
+    for i in range(1, 100):
+        p = os.path.join(directory, f"{prefix}_r{i:02d}.json")
+        if not os.path.exists(p):
+            return p
+    raise RuntimeError(f"no free {prefix}_rNN.json slot in {directory}")
+
+
+def write_row(row: dict, directory: str, prefix: str = "E2E") -> str:
+    path = next_row_path(directory, prefix)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(row, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def error_row(exc: BaseException, meta: Optional[dict] = None) -> dict:
+    row = {
+        "metric": "loadgen_e2e",
+        "schema": 1,
+        "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "verdict": "error",
+        "error": f"{type(exc).__name__}: {exc}",
+    }
+    if meta:
+        row.update(meta)
+    return row

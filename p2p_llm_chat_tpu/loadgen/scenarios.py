@@ -1,0 +1,247 @@
+"""Loadgen scenario registry: what traffic looks like, and what it owes.
+
+Each :class:`Scenario` bundles a mix weight, a payload builder that
+emits the concrete HTTP steps for one arrival (against the real wire
+paths — UI ``/api/suggest/stream``, node ``/send``, serve
+``/api/generate|chat|embed``), and the per-scenario SLO the ledger
+(report.py) judges the run against.
+
+The five registered scenarios map one-to-one onto the ROADMAP's
+"scenario-diverse load" list:
+
+=============== ==========================================================
+``short_chat``  one chat turn end-to-end: peer i's node delivers a short
+                message to peer i+1 over the encrypted P2P stream, then
+                the recipient's UI fires the co-pilot suggestion (the
+                exact browser path, NDJSON streamed). Falls back to a
+                serve-level ``/api/chat`` turn when the run has no
+                chat plane (stub mode).
+``long_ctx``    a ~3k-token prompt through ``/api/generate`` — the
+                prefill-pressure case chunked prefill exists for.
+``embed``       ``/api/embed`` — the non-generative endpoint class
+                (bypasses the decode scheduler; latency = full answer).
+``unbounded``   ``num_predict: -1`` (Ollama "until EOS / context
+                full") with a per-request ``num_ctx`` cap — the
+                worst-case stream length class.
+``park_wake``   two ``/api/generate`` turns under one ``X-Session-Id``
+                with a think-time pause between them: the follow-up
+                extends the first prompt, so engines with the KV tier
+                (serve/kv_tier.py) wake the parked session instead of
+                re-prefilling. The SLO is judged on the follow-up turn.
+=============== ==========================================================
+
+SLO targets default to the CPU dev-profile numbers (this is the profile
+the 64–128-peer chat-plane runs use in CI-class containers; a 2-core
+host serving 64 peers is *supposed* to be slow). ``LOADGEN_SLO_SCALE``
+multiplies every latency target — TPU operating points run with a
+fraction, e.g. ``LOADGEN_SLO_SCALE=0.05``.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..utils.env import env_float
+
+__all__ = [
+    "SLO", "Step", "Scenario", "Endpoints", "REGISTRY",
+    "default_mix", "parse_mix", "slo_scale",
+]
+
+
+@dataclass(frozen=True)
+class SLO:
+    """Per-scenario service-level objectives. Latency fields are
+    milliseconds and pre-scaled by :func:`slo_scale` at judgement time;
+    ``itl_p95_ms`` is None for non-streaming scenarios (no inter-token
+    gap exists)."""
+
+    ttft_p50_ms: float
+    ttft_p95_ms: float
+    itl_p95_ms: Optional[float]
+    max_shed_frac: float
+
+
+@dataclass(frozen=True)
+class Step:
+    """One HTTP call of a scenario plan. ``measured`` marks the step the
+    SLO is judged on (exactly one per plan); non-measured steps still
+    fail the record on error. ``stream`` selects NDJSON reading; the
+    delta text is found under ``delta``/``response``/``message.content``
+    whichever the endpoint speaks."""
+
+    url: str
+    payload: dict
+    stream: bool = False
+    measured: bool = False
+    session: str = ""
+    pause_before_s: float = 0.0
+
+
+@dataclass(frozen=True)
+class Endpoints:
+    """Where the driver aims. ``node_urls``/``ui_urls`` empty = no chat
+    plane in this run (stub / serve-only); scenarios degrade to their
+    serve-level equivalent. ``users`` aligns with ``node_urls`` —
+    ``users[i]`` is the username registered by node i."""
+
+    serve_url: str
+    ui_urls: tuple = ()
+    node_urls: tuple = ()
+    users: tuple = ()
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    weight: float
+    slo: SLO
+    build: Callable[[random.Random, int, Endpoints], list] = field(repr=False)
+
+
+def slo_scale() -> float:
+    return env_float("LOADGEN_SLO_SCALE", 1.0)
+
+
+# ---------------------------------------------------------------------------
+# payload builders
+# ---------------------------------------------------------------------------
+
+_FILLER = ("Earlier in this thread we discussed the quarterly plans, "
+           "the picnic schedule, and who brings which dish. ")
+
+
+def _chat_text(rng: random.Random, to: str) -> str:
+    # Unique head per request: identical heads would trip prefix
+    # auto-promotion builds mid-run (a compile stall the e2e bench
+    # learned to avoid) and would collapse router affinity onto one
+    # home replica.
+    return (f"[{rng.getrandbits(32):08x}] Hey {to}, are we still meeting "
+            f"tomorrow at {8 + rng.randrange(9)}:{15 * rng.randrange(4):02d}?")
+
+
+def _build_short_chat(rng: random.Random, peer: int,
+                      ep: Endpoints) -> list:
+    if ep.node_urls and ep.ui_urls:
+        n = len(ep.node_urls)
+        to = (peer + 1) % n
+        msg = _chat_text(rng, ep.users[to] if ep.users else f"peer{to:02d}")
+        return [
+            Step(url=f"{ep.node_urls[peer]}/send",
+                 payload={"to_username": ep.users[to] if ep.users
+                          else f"peer{to:02d}", "content": msg}),
+            Step(url=f"{ep.ui_urls[to]}/api/suggest/stream",
+                 payload={"content": msg}, stream=True, measured=True),
+        ]
+    msg = _chat_text(rng, "there")
+    return [Step(url=f"{ep.serve_url}/api/chat",
+                 payload={"messages": [{"role": "user", "content": msg}],
+                          "options": {"num_predict": 16}, "stream": True},
+                 stream=True, measured=True)]
+
+
+def _build_long_ctx(rng: random.Random, peer: int, ep: Endpoints) -> list:
+    # ~3k byte-level tokens: unique head + filler body (the serve
+    # tokenizer falls back to bytes for synthetic configs, so chars are
+    # a faithful token-count proxy there).
+    head = f"[long {rng.getrandbits(32):08x}] summarize this thread: "
+    body = (_FILLER * (3000 // len(_FILLER) + 1))[: max(0, 3000 - len(head))]
+    return [Step(url=f"{ep.serve_url}/api/generate",
+                 payload={"prompt": head + body,
+                          "options": {"num_predict": 16}, "stream": True},
+                 stream=True, measured=True)]
+
+
+def _build_embed(rng: random.Random, peer: int, ep: Endpoints) -> list:
+    return [Step(url=f"{ep.serve_url}/api/embed",
+                 payload={"input": [f"note {rng.getrandbits(32):08x}",
+                                    "what time is the picnic?"]},
+                 measured=True)]
+
+
+def _build_unbounded(rng: random.Random, peer: int, ep: Endpoints) -> list:
+    return [Step(url=f"{ep.serve_url}/api/generate",
+                 payload={"prompt": _chat_text(rng, "all") + "\n\nReply:",
+                          "options": {"num_predict": -1, "num_ctx": 64},
+                          "stream": True},
+                 stream=True, measured=True)]
+
+
+def _build_park_wake(rng: random.Random, peer: int, ep: Endpoints) -> list:
+    sid = f"lg-{peer}-{rng.getrandbits(32):08x}"
+    base = (f"[{sid}] My favorite fruits are apples, pears and plums. "
+            "Which should I bring to the picnic?")
+    return [
+        Step(url=f"{ep.serve_url}/api/generate",
+             payload={"prompt": base, "options": {"num_predict": 8},
+                      "stream": True},
+             stream=True, session=sid),
+        # Think time lets an idle-sweep engine park the session, so the
+        # follow-up exercises the wake path rather than a hot hit.
+        Step(url=f"{ep.serve_url}/api/generate",
+             payload={"prompt": base + " Oh, and grapes too — rank them.",
+                      "options": {"num_predict": 8}, "stream": True},
+             stream=True, session=sid, pause_before_s=0.5, measured=True),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+REGISTRY: dict = {
+    s.name: s for s in (
+        Scenario("short_chat", weight=4.0,
+                 slo=SLO(ttft_p50_ms=4000, ttft_p95_ms=12000,
+                         itl_p95_ms=2000, max_shed_frac=0.25),
+                 build=_build_short_chat),
+        Scenario("long_ctx", weight=1.0,
+                 slo=SLO(ttft_p50_ms=8000, ttft_p95_ms=20000,
+                         itl_p95_ms=2000, max_shed_frac=0.25),
+                 build=_build_long_ctx),
+        Scenario("embed", weight=1.0,
+                 slo=SLO(ttft_p50_ms=4000, ttft_p95_ms=12000,
+                         itl_p95_ms=None, max_shed_frac=0.25),
+                 build=_build_embed),
+        Scenario("unbounded", weight=1.0,
+                 slo=SLO(ttft_p50_ms=4000, ttft_p95_ms=12000,
+                         itl_p95_ms=2000, max_shed_frac=0.25),
+                 build=_build_unbounded),
+        Scenario("park_wake", weight=1.0,
+                 slo=SLO(ttft_p50_ms=5000, ttft_p95_ms=15000,
+                         itl_p95_ms=2000, max_shed_frac=0.25),
+                 build=_build_park_wake),
+    )
+}
+
+
+def default_mix() -> list:
+    """[(scenario, weight), ...] in registry order."""
+    return [(s, s.weight) for s in REGISTRY.values()]
+
+
+def parse_mix(spec: str) -> list:
+    """``"short_chat=4,embed=1"`` -> [(scenario, weight), ...]. Unknown
+    names and non-positive weights fail loudly (a typo'd mix must not
+    silently drop a scenario class). Empty spec = the default mix."""
+    if not spec.strip():
+        return default_mix()
+    out = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, w = entry.partition("=")
+        name = name.strip()
+        if name not in REGISTRY:
+            raise ValueError(
+                f"unknown scenario {name!r} (have: {sorted(REGISTRY)})")
+        weight = float(w) if sep else REGISTRY[name].weight
+        if weight <= 0:
+            raise ValueError(f"scenario weight must be > 0: {entry!r}")
+        out.append((REGISTRY[name], weight))
+    if not out:
+        raise ValueError(f"empty scenario mix: {spec!r}")
+    return out

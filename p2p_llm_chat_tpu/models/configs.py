@@ -128,6 +128,17 @@ _register(ModelConfig(
     bos_token_id=1, eos_token_ids=(2,),
 ))
 
+# Loadgen CPU profile: ``tiny`` dims with a real context window, so the
+# e2e long-context scenario (docs/loadtest.md) prefills thousands of
+# tokens through chunked admission on CPU-class hosts instead of
+# truncating at tiny's 256.
+_register(ModelConfig(
+    name="tiny-long", vocab_size=512, hidden_size=128,
+    intermediate_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=32, max_seq_len=4096, rope_theta=10000.0,
+    bos_token_id=1, eos_token_ids=(2,),
+))
+
 # Like ``tiny`` but every tp-sharded dim (heads, KV heads, mlp, vocab)
 # divides a tp=4 mesh: the multi-chip dryrun validates SHARDED wk/wv/KV
 # paths with it — `tiny`'s 2 kv heads at tp=4 silently fall back to

@@ -65,7 +65,14 @@ from concurrent.futures import ThreadPoolExecutor, TimeoutError as FutTimeout, a
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from cryptography.exceptions import InvalidSignature
+try:
+    from cryptography.exceptions import InvalidSignature
+except ImportError:
+    # Gated stdlib dev fallback (P2P_DEV_CRYPTO=1): identity.py resolves
+    # the same way, and its dev verify raises this class.
+    from .devcrypto import require_dev_crypto
+    require_dev_crypto("p2p.dht")
+    from .devcrypto import InvalidSignature     # type: ignore[assignment]
 
 from .identity import Identity, peer_id_to_public_key
 from ..utils.backoff import Backoff, note_retry

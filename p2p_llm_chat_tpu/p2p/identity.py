@@ -14,17 +14,33 @@ import os
 from dataclasses import dataclass
 from typing import Optional
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives import serialization
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives import serialization
+    DEV_CRYPTO = False
+except ImportError:
+    # Containers without the cryptography package can opt in to the
+    # INSECURE stdlib dev fallback (P2P_DEV_CRYPTO=1 — loopback dev and
+    # loadgen scale-out only); anything else keeps the loud ImportError.
+    from .devcrypto import require_dev_crypto
+    require_dev_crypto("p2p.identity")
+    from .devcrypto import (            # type: ignore[assignment]
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+        serialization,
+    )
+    DEV_CRYPTO = True
 
 from ..utils.base58 import b58decode, b58encode
 
 # 2-byte tag prefixed to the raw public key before base58 encoding, giving
-# peer IDs a stable leading character and versioning the key type.
-_ED25519_TAG = b"\x01\xed"
+# peer IDs a stable leading character and versioning the key type. Dev
+# fallback ids carry their own tag so a null-signature dev identity can
+# never parse as — or verify against — a real Ed25519 peer id.
+_ED25519_TAG = b"\x01\xdd" if DEV_CRYPTO else b"\x01\xed"
 
 
 def public_key_to_peer_id(pub: Ed25519PublicKey) -> str:
@@ -37,7 +53,10 @@ def public_key_to_peer_id(pub: Ed25519PublicKey) -> str:
 def peer_id_to_public_key(peer_id: str) -> Ed25519PublicKey:
     raw = b58decode(peer_id)
     if len(raw) != 34 or raw[:2] != _ED25519_TAG:
-        raise ValueError(f"not an ed25519 peer id: {peer_id!r}")
+        raise ValueError(
+            f"not an ed25519 peer id (this node runs "
+            f"{'dev-crypto' if DEV_CRYPTO else 'real'} identities): "
+            f"{peer_id!r}")
     return Ed25519PublicKey.from_public_bytes(raw[2:])
 
 

@@ -41,15 +41,33 @@ import threading
 import time
 from typing import Callable, Optional
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PublicKey
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+except ImportError:
+    # INSECURE stdlib dev fallback, explicit opt-in only (P2P_DEV_CRYPTO=1
+    # — see p2p/devcrypto.py for exactly what is and is not provided).
+    from .devcrypto import require_dev_crypto
+    require_dev_crypto("p2p.transport")
+    from .devcrypto import (            # type: ignore[assignment]
+        ChaCha20Poly1305,
+        Ed25519PublicKey,
+        HKDF,
+        X25519PrivateKey,
+        X25519PublicKey,
+        hashes,
+        serialization,
+    )
 
+from ..utils.env import env_bool
 from ..utils.failpoints import failpoint
 from ..utils.log import get_logger
 from .addr import Multiaddr
@@ -451,8 +469,7 @@ class P2PHost:
         blocked). ``P2P_HOLEPUNCH=0`` disables the attempt."""
         if maddr.is_circuit:
             deadline = time.monotonic() + timeout
-            punch_ok = (os.environ.get("P2P_HOLEPUNCH", "1")
-                        not in ("0", "false"))
+            punch_ok = env_bool("P2P_HOLEPUNCH", True)
             # Negative cache keyed by REAL peer ids only (id-less circuit
             # addrs would all share one slot and suppress each other),
             # pruned on insert so long-lived hosts don't accumulate

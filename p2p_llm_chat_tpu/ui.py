@@ -32,7 +32,7 @@ import urllib.parse
 from importlib import resources
 from typing import Optional
 
-from .utils.env import env_or
+from .utils.env import env_float, env_int, env_or
 from .utils.http import HttpServer, Request, Response, Router, http_json
 from .utils.log import get_logger
 
@@ -44,7 +44,8 @@ SUGGEST_TEMPLATE = (
     "You are a helpful assistant. Draft a concise, friendly reply to the "
     "following message:\n\n{msg}\n\nReply:"
 )
-LLM_TIMEOUT_S = 60.0   # streamlit_app.py:95
+LLM_TIMEOUT_S = 60.0   # streamlit_app.py:95 (reference default;
+#                        UI_LLM_TIMEOUT_S overrides per deployment)
 
 
 class ChatUI:
@@ -58,6 +59,15 @@ class ChatUI:
                            else env_or("OLLAMA_URL", "http://127.0.0.1:11434")).rstrip("/")
         self.llm_model = llm_model if llm_model is not None else env_or("LLM_MODEL", "llama3.1")
         self.addr_cfg = addr if addr is not None else env_or("UI_ADDR", "127.0.0.1:8501")
+        # Suggestion length bound. The reference sends NO num_predict
+        # (server default applies) and 0 preserves that; operators and
+        # the loadgen CPU profile cap it — an unbounded co-pilot reply
+        # is the single biggest per-request cost on small hosts.
+        self.suggest_predict = env_int("UI_SUGGEST_PREDICT", 0)
+        # Upstream LLM deadline. 60 s is the reference's (streamlit_app
+        # :95); slow dev-profile hosts raise it so a suggestion that is
+        # slow-but-within-SLO completes instead of becoming an error.
+        self.llm_timeout_s = env_float("UI_LLM_TIMEOUT_S", LLM_TIMEOUT_S)
         self.router = Router()
         self.router.add("GET", "/", self._index)
         self.router.add("GET", "/config.json", lambda r: Response(200, {
@@ -84,12 +94,17 @@ class ChatUI:
         except ValueError:
             return Response(400, {"error": "invalid json"})
         content = str(body.get("content") or "")
+        payload = {
+            "model": self.llm_model,
+            "prompt": SUGGEST_TEMPLATE.format(msg=content),
+            "stream": False,
+        }
+        if self.suggest_predict > 0:
+            payload["options"] = {"num_predict": self.suggest_predict}
         try:
-            status, resp = http_json("POST", f"{self.ollama_url}/api/generate", {
-                "model": self.llm_model,
-                "prompt": SUGGEST_TEMPLATE.format(msg=content),
-                "stream": False,
-            }, timeout=LLM_TIMEOUT_S, raise_for_status=False)
+            status, resp = http_json(
+                "POST", f"{self.ollama_url}/api/generate", payload,
+                timeout=self.llm_timeout_s, raise_for_status=False)
             if status == 200 and isinstance(resp, dict) and "response" in resp:
                 suggestion = str(resp["response"]).strip()   # :97-98
             else:
@@ -105,6 +120,7 @@ class ChatUI:
         incrementally instead of after the full generation. The
         non-streaming ``/api/suggest`` keeps the reference's buffered
         contract (streamlit_app.py:89-101) for stream:false clients."""
+        import urllib.error
         import urllib.request
 
         try:
@@ -113,18 +129,50 @@ class ChatUI:
             return Response(400, {"error": "invalid json"})
         content = str(body.get("content") or "")
 
+        # Open the upstream BEFORE committing to a 200 NDJSON stream —
+        # the serve front's own discipline ("never a mid-NDJSON error
+        # record after a 200 already went out"). In particular a shed
+        # (503 + Retry-After, the overload contract) forwards verbatim
+        # with its Retry-After, so the browser/loadgen sees well-formed
+        # backpressure instead of a buried mid-stream error line.
+        payload = {
+            "model": self.llm_model,
+            "prompt": SUGGEST_TEMPLATE.format(msg=content),
+            "stream": True,
+        }
+        if self.suggest_predict > 0:
+            payload["options"] = {"num_predict": self.suggest_predict}
+        data = json.dumps(payload).encode("utf-8")
+        r = urllib.request.Request(
+            f"{self.ollama_url}/api/generate", data=data,
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        try:
+            resp = urllib.request.urlopen(r, timeout=self.llm_timeout_s)
+        except urllib.error.HTTPError as e:
+            detail = e.read()[:300].decode("utf-8", "replace")
+            headers = {}
+            retry = e.headers.get("Retry-After")
+            if retry:
+                headers["Retry-After"] = retry
+            e.close()
+            return Response(e.code, {"error": detail or "LLM error"},
+                            headers=headers)
+        except Exception as e:  # noqa: BLE001 — same degradation
+            # strings as the buffered path (streamlit_app.py:100-101);
+            # error:true lets the browser treat the text as a failure
+            # marker instead of appending it to a partial suggestion.
+            def unavailable(msg=str(e)):
+                yield (json.dumps({
+                    "delta": f"(LLM unavailable: {msg})", "done": True,
+                    "error": True,
+                }) + "\n").encode("utf-8")
+            return Response(200, stream=unavailable(),
+                            content_type="application/x-ndjson")
+
         def gen():
             try:
-                data = json.dumps({
-                    "model": self.llm_model,
-                    "prompt": SUGGEST_TEMPLATE.format(msg=content),
-                    "stream": True,
-                }).encode("utf-8")
-                r = urllib.request.Request(
-                    f"{self.ollama_url}/api/generate", data=data,
-                    headers={"Content-Type": "application/json"},
-                    method="POST")
-                with urllib.request.urlopen(r, timeout=LLM_TIMEOUT_S) as resp:
+                with resp:
                     for line in resp:
                         try:
                             obj = json.loads(line)
@@ -139,10 +187,9 @@ class ChatUI:
                             return
                 yield (json.dumps({"delta": "", "done": True})
                        + "\n").encode("utf-8")
-            except Exception as e:  # noqa: BLE001 — same degradation
-                # strings as the buffered path (streamlit_app.py:100-101);
-                # error:true lets the browser treat the text as a failure
-                # marker instead of appending it to a partial suggestion.
+            except Exception as e:  # noqa: BLE001 — mid-stream failure
+                # after deltas already went out: the error record keeps
+                # the browser from treating a half suggestion as whole.
                 yield (json.dumps({
                     "delta": f"(LLM unavailable: {e})", "done": True,
                     "error": True,

@@ -131,14 +131,32 @@ class HttpServer:
                     # Terminate the chunked stream ONLY on clean completion:
                     # a mid-stream failure must look truncated to the client
                     # (dropped connection), not like a well-formed response.
-                    for chunk in resp.stream:
-                        if not chunk:
-                            continue
-                        self.wfile.write(f"{len(chunk):x}\r\n".encode())
-                        self.wfile.write(chunk)
-                        self.wfile.write(b"\r\n")
-                        self.wfile.flush()
-                    self.wfile.write(b"0\r\n\r\n")
+                    # A client hanging up mid-stream (browser tab closed,
+                    # loadgen driver done with the deltas it needed) is
+                    # normal operation, not a server error — swallow the
+                    # reset instead of letting socketserver print a
+                    # traceback per disconnect (at 64-peer load that is
+                    # a log storm).
+                    try:
+                        for chunk in resp.stream:
+                            if not chunk:
+                                continue
+                            self.wfile.write(f"{len(chunk):x}\r\n".encode())
+                            self.wfile.write(chunk)
+                            self.wfile.write(b"\r\n")
+                            self.wfile.flush()
+                        self.wfile.write(b"0\r\n\r\n")
+                    except (ConnectionResetError, BrokenPipeError):
+                        log.debug("client disconnected mid-stream on %s %s",
+                                  self.command, parsed.path)
+                        self.close_connection = True
+                        try:
+                            # Run the generator's finally blocks NOW
+                            # (inflight gauges, stats observers) rather
+                            # than at some later GC.
+                            resp.stream.close()
+                        except Exception:  # noqa: BLE001 — teardown only
+                            pass
                     return
                 payload = resp.encode()
                 self.send_response(resp.status)

@@ -36,15 +36,76 @@ from p2p_llm_chat_tpu.utils.env import env_float, env_int, env_or
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 
 
-def wait_http(url: str, timeout: float = 30.0) -> None:
+def wait_http(url: str, timeout: float = 30.0,
+              procs: list | None = None) -> None:
+    """Poll ``url`` until 200. When ``procs`` is given, a child that
+    exits while we wait fails the boot IMMEDIATELY — a dead node must
+    not burn the full readiness deadline before anyone notices (the
+    e2e launcher path learned this at 64-peer scale: one bad port =
+    4 minutes of silence)."""
     deadline = time.time() + timeout
     while time.time() < deadline:
+        for name, p in procs or ():
+            code = p.poll()
+            if code is not None:
+                raise RuntimeError(
+                    f"{name} exited with code {code} while waiting for "
+                    f"{url}")
         try:
             with urllib.request.urlopen(url, timeout=1):
                 return
         except Exception:
             time.sleep(0.25)
     raise TimeoutError(f"service at {url} not ready after {timeout}s")
+
+
+def check_port_ranges(n_users: int, node_base: int, ui_base: int,
+                      dir_port: int, serve_port: int,
+                      replicas: int = 0) -> None:
+    """Fail at parse time when any service port ranges collide. With 2
+    users the reference layout can't collide; at 64–128 peers the node
+    and UI ranges are wide enough to plow into each other or into the
+    serve/replica ports, and the failure mode without this check is a
+    node that binds, a UI that doesn't, and a half-booted stack."""
+    ranges = {
+        "nodes": range(node_base, node_base + n_users),
+        "UIs": range(ui_base, ui_base + n_users),
+        "directory": range(dir_port, dir_port + 1),
+        # replica mode: serve_port + 1..replicas are the engines
+        "serve": range(serve_port, serve_port + 1 + max(0, replicas)),
+    }
+    names = list(ranges)
+    for i, a in enumerate(names):
+        for b in names[i + 1:]:
+            ra, rb = ranges[a], ranges[b]
+            if ra.start < rb.stop and rb.start < ra.stop:
+                raise SystemExit(
+                    f"port ranges collide: {a} [{ra.start},{ra.stop}) "
+                    f"overlaps {b} [{rb.start},{rb.stop}) — move the "
+                    "bases apart (--node-port-base/--ui-port-base/"
+                    "--dir-port/--serve-port)")
+    for name, r in ranges.items():
+        if r.stop > 65536:
+            raise SystemExit(f"{name} port range runs past 65535 "
+                             f"([{r.start},{r.stop}))")
+    # Ephemeral-range overlap is a WARNING, not an error: small runs
+    # rarely collide, but at 64–128 peers ~2N booting processes make
+    # outbound connections whose kernel-chosen source ports can land on
+    # a service port that has not bound yet (observed: a random node
+    # dying with EADDRINUSE mid-boot). Move the bases below the floor,
+    # or reserve the ranges via ip_local_reserved_ports.
+    try:
+        with open("/proc/sys/net/ipv4/ip_local_port_range") as f:
+            eph_lo, eph_hi = (int(x) for x in f.read().split())
+    except (OSError, ValueError):
+        return
+    for name, r in ranges.items():
+        if r.start <= eph_hi and eph_lo < r.stop and n_users >= 16:
+            print(f"⚠️ {name} ports [{r.start},{r.stop}) overlap the "
+                  f"kernel ephemeral range [{eph_lo},{eph_hi}] — at "
+                  f"{n_users} peers a booting service can lose its port "
+                  "to an outbound connection; use bases below "
+                  f"{eph_lo} (or ip_local_reserved_ports)")
 
 
 def spawn(name: str, module: str, env_extra: dict[str, str],
@@ -82,9 +143,19 @@ def main() -> int:
                          "single engine, the default)")
     ap.add_argument("--relay-port", type=int,
                     default=env_int("RELAY_PORT", 4100))
+    ap.add_argument("--boot-wave", type=int,
+                    default=env_int("LOADGEN_BOOT_WAVE", 1),
+                    help="node/UI boot wave size: spawn N nodes, then "
+                         "health-gate the whole wave, then their UIs "
+                         "(default 1 = the reference's strictly "
+                         "sequential boot; 64–128-peer loadgen runs "
+                         "use 8–16)")
     args = ap.parse_args()
 
     users = [u.strip() for u in args.users.split(",") if u.strip()]
+    check_port_ranges(len(users), args.node_port_base, args.ui_port_base,
+                      args.dir_port, args.serve_port,
+                      args.replicas if args.replicas >= 2 else 0)
     procs: list[tuple[str, subprocess.Popen]] = []
 
     def shutdown(*_, exit_code: int = 0):
@@ -154,7 +225,7 @@ def main() -> int:
                 relay_addrs = f.read().strip()
             shutil.rmtree(os.path.dirname(addr_file), ignore_errors=True)
             print(f"  relay multiaddr: {relay_addrs}")
-        wait_http(f"{dir_url}/healthz")
+        wait_http(f"{dir_url}/healthz", procs=procs)
         # Big-model TPU boots (8B checkpoint restore + streamed int8
         # quantize + warmup compile) legitimately take many minutes;
         # SERVE_WAIT_S widens the readiness budget. /readyz (not
@@ -165,15 +236,16 @@ def main() -> int:
         # not-ready (urlopen raises on it) and keeps polling.
         serve_wait = env_float(
             "SERVE_WAIT_S", 300.0 if args.backend != "fake" else 30.0)
-        wait_http(f"{serve_url}/readyz", timeout=serve_wait)
+        # procs: a serve crash at boot (bad port, OOM mid-restore) must
+        # fail NOW, not after burning SERVE_WAIT_S (up to 30 min for 8B).
+        wait_http(f"{serve_url}/readyz", timeout=serve_wait, procs=procs)
 
         dht_seed = ""
-        for i, user in enumerate(users):
-            node_port = args.node_port_base + i
-            ui_port = args.ui_port_base + i
+
+        def boot_node(i: int, user: str) -> None:
             node_env = {
                 "MYNAMEIS": user,
-                "HTTP_ADDR": f"127.0.0.1:{node_port}",
+                "HTTP_ADDR": f"127.0.0.1:{args.node_port_base + i}",
                 "DIRECTORY_URL": dir_url,
             }
             if relay_addrs:
@@ -184,22 +256,50 @@ def main() -> int:
                 # outage out of the box (node.py lookup ladder rung 3).
                 node_env["DHT_BOOTSTRAP"] = dht_seed
             spawn(f"node-{user}", "p2p_llm_chat_tpu.node", node_env, procs)
-            # 60 s: a loaded host (32-node boots alongside a TPU serve)
-            # can starve a fresh interpreter's startup past 30 s.
-            wait_http(f"http://127.0.0.1:{node_port}/healthz", timeout=60)
-            if not dht_seed:
-                try:
-                    with urllib.request.urlopen(
-                            f"http://127.0.0.1:{node_port}/me",
-                            timeout=5) as r:
-                        dht_seed = json.loads(r.read()).get("dht_addr", "")
-                except Exception:  # noqa: BLE001 — DHT stays optional
-                    pass
+
+        def boot_ui(i: int, user: str) -> None:
             spawn(f"ui-{user}", "p2p_llm_chat_tpu.ui", {
-                "NODE_HTTP": f"http://127.0.0.1:{node_port}",
+                "NODE_HTTP": f"http://127.0.0.1:{args.node_port_base + i}",
                 "OLLAMA_URL": serve_url,
-                "UI_ADDR": f"127.0.0.1:{ui_port}",
+                "UI_ADDR": f"127.0.0.1:{args.ui_port_base + i}",
             }, procs)
+
+        def grab_dht_seed(node_port: int) -> str:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{node_port}/me",
+                        timeout=5) as r:
+                    return json.loads(r.read()).get("dht_addr", "")
+            except Exception:  # noqa: BLE001 — DHT stays optional
+                return ""
+
+        wave = max(1, args.boot_wave)
+        first = 1 if wave > 1 and len(users) > 1 else 0
+        if first:
+            # Node 0 boots ALONE so every later wave (including the rest
+            # of wave 1) can chain its DHT off it — the same bootstrap
+            # topology the sequential path builds.
+            boot_node(0, users[0])
+            wait_http(f"http://127.0.0.1:{args.node_port_base}/healthz",
+                      timeout=60, procs=procs)
+            dht_seed = grab_dht_seed(args.node_port_base)
+            boot_ui(0, users[0])
+        for w0 in range(first, len(users), wave):
+            batch = list(enumerate(users))[w0:w0 + wave]
+            for i, user in batch:
+                boot_node(i, user)
+            for i, user in batch:
+                # 60 s: a loaded host (64-node boots alongside a TPU
+                # serve) can starve a fresh interpreter's startup well
+                # past 30 s; a crashed child fails the whole boot now,
+                # not at the deadline.
+                wait_http(
+                    f"http://127.0.0.1:{args.node_port_base + i}/healthz",
+                    timeout=60, procs=procs)
+            if not dht_seed:
+                dht_seed = grab_dht_seed(args.node_port_base + batch[0][0])
+            for i, user in batch:
+                boot_ui(i, user)
     except Exception as e:  # noqa: BLE001 — never leave orphaned children
         print(f"❌ startup failed: {e}; cleaning up")
         shutdown(exit_code=1)

@@ -1,0 +1,182 @@
+"""Contract tests for the gated stdlib dev-crypto fallback.
+
+p2p/devcrypto.py stands in for the `cryptography` package in containers
+that don't ship it (opt-in via P2P_DEV_CRYPTO=1 — conftest sets it when
+the real package is absent). These tests pin the FUNCTIONAL contracts
+the p2p plane relies on — sign/verify round trips, tamper detection,
+commutative key agreement, AEAD integrity, RFC 5869 HKDF — plus the
+gate itself (no opt-in = loud ImportError), and run a loopback secure-
+stream handshake through the real transport module on whichever crypto
+resolved in this container.
+"""
+
+import os
+import socket
+import threading
+
+import pytest
+
+# Must precede the transport import below: in cryptography-less
+# containers the p2p modules resolve their primitives through the gate
+# at import time (conftest also sets this; the setdefault is for
+# running this file standalone).
+os.environ.setdefault("P2P_DEV_CRYPTO", "1")
+
+from p2p_llm_chat_tpu.p2p import devcrypto  # noqa: E402
+from p2p_llm_chat_tpu.p2p import transport  # noqa: E402
+from p2p_llm_chat_tpu.p2p.identity import Identity  # noqa: E402
+
+
+# -- signatures --------------------------------------------------------------
+
+def test_sign_verify_round_trip():
+    priv = devcrypto.Ed25519PrivateKey.generate()
+    sig = priv.sign(b"hello picnic")
+    assert len(sig) == 64            # the length transport.py frames
+    priv.public_key().verify(sig, b"hello picnic")   # no raise
+
+
+def test_verify_rejects_tampered_message_and_sig():
+    priv = devcrypto.Ed25519PrivateKey.generate()
+    pub = priv.public_key()
+    sig = priv.sign(b"msg")
+    with pytest.raises(devcrypto.InvalidSignature):
+        pub.verify(sig, b"msg2")
+    with pytest.raises(devcrypto.InvalidSignature):
+        pub.verify(bytes(64), b"msg")
+
+
+def test_verify_rejects_wrong_signer():
+    a = devcrypto.Ed25519PrivateKey.generate()
+    b = devcrypto.Ed25519PrivateKey.generate()
+    sig = a.sign(b"msg")
+    with pytest.raises(devcrypto.InvalidSignature):
+        b.public_key().verify(sig, b"msg")
+
+
+def test_private_key_persistence_round_trip():
+    priv = devcrypto.Ed25519PrivateKey.generate()
+    raw = priv.private_bytes(None, None, None)
+    again = devcrypto.Ed25519PrivateKey.from_private_bytes(raw)
+    assert (again.public_key().public_bytes()
+            == priv.public_key().public_bytes())
+
+
+# -- key agreement -----------------------------------------------------------
+
+def test_dh_exchange_commutes():
+    a = devcrypto.X25519PrivateKey.generate()
+    b = devcrypto.X25519PrivateKey.generate()
+    s1 = a.exchange(b.public_key())
+    s2 = b.exchange(a.public_key())
+    assert s1 == s2
+    assert len(s1) == 32
+    c = devcrypto.X25519PrivateKey.generate()
+    assert a.exchange(c.public_key()) != s1
+
+
+def test_dh_rejects_degenerate_public_value():
+    a = devcrypto.X25519PrivateKey.generate()
+    with pytest.raises(ValueError):
+        a.exchange(devcrypto.X25519PublicKey((0).to_bytes(32, "big")))
+    with pytest.raises(ValueError):
+        a.exchange(devcrypto.X25519PublicKey((1).to_bytes(32, "big")))
+
+
+# -- HKDF (the one real construction) ---------------------------------------
+
+def test_hkdf_rfc5869_vector_a1():
+    ikm = bytes.fromhex("0b" * 22)
+    salt = bytes.fromhex("000102030405060708090a0b0c")
+    info = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9")
+    okm = devcrypto.HKDF(length=42, salt=salt, info=info).derive(ikm)
+    assert okm == bytes.fromhex(
+        "3cb25f25faacd57a90434f64d0362f2a2d2d0a90cf1a5a4c5db02d56ecc4c5bf"
+        "34007208d5b887185865")
+
+
+# -- AEAD --------------------------------------------------------------------
+
+def test_aead_round_trip_and_tamper():
+    key = os.urandom(32)
+    aead = devcrypto.ChaCha20Poly1305(key)
+    nonce = (7).to_bytes(12, "little")
+    ct = aead.encrypt(nonce, b"secret payload", None)
+    assert aead.decrypt(nonce, ct, None) == b"secret payload"
+    with pytest.raises(devcrypto.InvalidTag):
+        aead.decrypt(nonce, ct[:-1] + bytes([ct[-1] ^ 1]), None)
+    with pytest.raises(devcrypto.InvalidTag):
+        aead.decrypt((8).to_bytes(12, "little"), ct, None)
+    # Different key cannot decrypt.
+    with pytest.raises(devcrypto.InvalidTag):
+        devcrypto.ChaCha20Poly1305(os.urandom(32)).decrypt(nonce, ct, None)
+
+
+# -- the gate ----------------------------------------------------------------
+
+def test_require_dev_crypto_gate(monkeypatch):
+    monkeypatch.delenv("P2P_DEV_CRYPTO", raising=False)
+    with pytest.raises(ImportError, match="P2P_DEV_CRYPTO"):
+        devcrypto.require_dev_crypto("test.site")
+    monkeypatch.setenv("P2P_DEV_CRYPTO", "1")
+    devcrypto.require_dev_crypto("test.site")   # no raise
+
+
+# -- through the real transport ---------------------------------------------
+
+def test_loopback_secure_stream_round_trip():
+    """Full dialer/listener handshake + framed round trip through
+    p2p/transport.py on whichever crypto this container resolved
+    (real cryptography, or the dev fallback)."""
+    li = Identity.generate()
+    di = Identity.generate()
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    got: dict = {}
+
+    def serve():
+        c, _ = lsock.accept()
+        s = transport.listener_handshake(c, li)
+        got["peer"] = s.remote_peer_id
+        got["data"] = s.read_all()
+        s.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    c = socket.create_connection(("127.0.0.1", lsock.getsockname()[1]))
+    st = transport.dialer_handshake(c, di, li.peer_id)
+    assert st.remote_peer_id == li.peer_id
+    st.send_frame(b"proto")
+    st.send_frame(b"payload bytes")
+    st.close_write()
+    t.join(10)
+    lsock.close()
+    st.close()
+    assert got["peer"] == di.peer_id
+    assert got["data"] == b"protopayload bytes"
+
+
+def test_dialer_rejects_wrong_expected_peer():
+    li = Identity.generate()
+    di = Identity.generate()
+    other = Identity.generate()
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+
+    def serve():
+        try:
+            c, _ = lsock.accept()
+            transport.listener_handshake(c, li)
+        except Exception:   # noqa: BLE001 — dialer aborts mid-handshake
+            pass
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    c = socket.create_connection(("127.0.0.1", lsock.getsockname()[1]))
+    with pytest.raises(transport.HandshakeError):
+        transport.dialer_handshake(c, di, other.peer_id)
+    c.close()
+    t.join(5)
+    lsock.close()

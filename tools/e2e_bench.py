@@ -1,25 +1,37 @@
-"""End-to-end north-star rehearsal THROUGH THE CHAT PLANE.
+"""End-to-end loadgen CLI: the chat plane under open-loop scenario load.
 
-bench.py measures the scheduler directly; this drives the full reference
-deployment instead (VERDICT r3 #8): start_all.py boots the directory,
-the TPU serve front, N node daemons and N UI servers; every peer
-receives a real P2P message (UI -> node /send -> encrypted stream ->
-peer inbox), then all N UIs fire their co-pilot suggestion concurrently
-(POST /api/suggest/stream — the exact HTTP path the browser JS calls)
-and we record time-to-first-delta at the UI boundary. The HTTP hops,
-node hops, UI server, serve front, scheduler and chip are all in the
-number.
+Thin operator front over ``p2p_llm_chat_tpu.loadgen`` (docs/loadtest.md):
+boots the full reference deployment via start_all.py (directory, serve
+front, N node daemons, N UI servers — staged boot waves at 64–128
+peers), then drives the seeded open-loop Poisson scenario mix through
+the real wire paths (UI ``/api/suggest/stream``, node ``/send``, serve
+``/api/generate|chat|embed``), judges the run against the per-scenario
+SLOs, and records the ledger row DURABLY as ``E2E_r0N.json`` (the
+``BENCH_r0N.json`` convention) — an error row if the run dies, never
+stdout-only.
 
-Usage: python tools/e2e_bench.py [--peers 32] [--config bench-1b]
-Prints a one-line JSON summary (p50/p95 UI-boundary TTFT).
+Chaos rides along instead of beside: ``--chaos`` arms ``FAIL_POINTS``
+in every launched process at low probability for the whole run, and the
+ledger re-asserts the PR 5 degradation contracts under load (sheds
+answered <100 ms with Retry-After, no hung streams, stack still answers
+after the run).
+
+Usage:
+    python tools/e2e_bench.py --peers 64 --backend tpu --config tiny \
+        --rate 8 --duration 60 --chaos 'serve.api.stream=drop@0.02'
+    python tools/e2e_bench.py --stub --duration 5      # no launcher smoke
+
+In containers without the ``cryptography`` package the node plane runs
+the explicit INSECURE dev fallback (p2p/devcrypto.py) — set
+automatically, flagged in the row as ``"dev_crypto": true``.
 """
 
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
 import os
-import statistics
 import subprocess
 import sys
 import tempfile
@@ -28,11 +40,30 @@ import time
 import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from p2p_llm_chat_tpu.loadgen import (   # noqa: E402
+    ChaosWindow, Endpoints, LoadDriver, REGISTRY, build_ledger,
+    build_schedule, check_contracts, error_row, parse_mix, write_row)
+from p2p_llm_chat_tpu.loadgen.chaos import parse_fail_points  # noqa: E402
+from p2p_llm_chat_tpu.utils.env import (   # noqa: E402
+    env_float, env_int, env_or)
 
 
-def wait_http(url: str, deadline_s: float = 240.0) -> None:
+def wait_http(url: str, deadline_s: float = 240.0,
+              launcher: "subprocess.Popen | None" = None) -> None:
+    """Poll until 200. A dead launcher fails FAST with its captured
+    output tail — not after burning the full deadline (the pre-round-12
+    behavior: a boot crash meant 240–1800 s of silence)."""
     t0 = time.monotonic()
     while time.monotonic() - t0 < deadline_s:
+        if launcher is not None:
+            code = launcher.poll()
+            if code is not None:
+                raise RuntimeError(
+                    f"launcher exited with code {code} while waiting for "
+                    f"{url} (tail: "
+                    f"{b''.join(globals().get('_TAIL', []))[-1200:]!r})")
         try:
             urllib.request.urlopen(url, timeout=2)
             return
@@ -59,92 +90,229 @@ def post(url: str, body: dict, timeout: float = 120.0):
             f"{tail!r})") from None
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
+def build_quote_checkpoint(config: str, env: dict) -> None:
+    """Synthetic quote checkpoint (models/synth.py) in a CPU subprocess
+    (importing jax HERE would grab the accelerator away from the serve).
+    E2E_CKPT_DIR caches across runs — at 8B dims the build + save is
+    ~16 GB and ~15 minutes, far too slow to repeat per run."""
+    cache = os.environ.get("E2E_CKPT_DIR", "")
+    meta_path = os.path.join(cache, "native_meta.json") if cache else ""
+    cached_cfg = None
+    if meta_path and os.path.exists(meta_path):
+        with open(meta_path) as f:
+            cached_cfg = json.load(f).get("config")
+    if cached_cfg == config:
+        env["CKPT_DIR"] = cache
+        env["LLM_MODEL"] = config
+        return
+    if cached_cfg is not None:
+        print(f"E2E_CKPT_DIR holds {cached_cfg!r}, need {config!r}; "
+              "rebuilding")
+    ckpt_dir = cache or tempfile.mkdtemp(prefix="e2e_quote_")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    build = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp\n"
+        "from p2p_llm_chat_tpu.models.synth import quote_params\n"
+        "from p2p_llm_chat_tpu.models.configs import get_config\n"
+        "from p2p_llm_chat_tpu.models.checkpoint import save_checkpoint\n"
+        f"cfg = get_config({config!r})\n"
+        "params = quote_params(cfg, jax.random.PRNGKey(0), "
+        "dtype=jnp.bfloat16)\n"
+        f"save_checkpoint({ckpt_dir!r}, params, cfg)\n")
+    subprocess.run([sys.executable, "-c", build], env=env, check=True)
+    env["CKPT_DIR"] = ckpt_dir
+    env["LLM_MODEL"] = config
+
+
+def drive(ep: Endpoints, args, chaos: "ChaosWindow | None") -> dict:
+    """Schedule + drive + judge: the loadgen core, shared by the
+    launcher and --stub paths."""
+    mix = parse_mix(args.mix)
+    schedule = build_schedule(mix, rate_rps=args.rate,
+                              duration_s=args.duration, seed=args.seed,
+                              n_peers=max(1, len(ep.ui_urls) or args.peers))
+    print(f"schedule: {len(schedule)} arrivals over {args.duration}s "
+          f"(rate {args.rate}/s, seed {args.seed})", file=sys.stderr)
+    driver = LoadDriver(ep, REGISTRY, workers=args.workers,
+                        timeout_s=args.timeout)
+    t0 = time.monotonic()
+    records = driver.run(schedule, chaos=chaos)
+    wall = time.monotonic() - t0
+    contract = check_contracts(
+        records,
+        disarm_at_s=chaos.disarm_at_s if chaos is not None else None)
+    row = build_ledger(records, REGISTRY, duration_s=args.duration,
+                       contract=contract)
+    row["wall_s"] = round(wall, 2)
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--peers", type=int, default=32)
     ap.add_argument("--config", default="bench-1b")
-    ap.add_argument("--node-base", type=int, default=19081)
-    ap.add_argument("--ui-base", type=int, default=19501)
-    ap.add_argument("--dir-port", type=int, default=19480)
-    ap.add_argument("--serve-port", type=int, default=19490)
-    ap.add_argument("--identical", action="store_true",
-                    help="all peers send the SAME text (stress case: "
-                         "triggers prefix auto-promotion mid-burst)")
+    ap.add_argument("--backend", default="tpu",
+                    choices=["tpu", "fake"],
+                    help="serve backend: tpu = the JAX engine (runs on "
+                         "CPU where no accelerator exists), fake = "
+                         "FakeLLM echo (chat-plane-only runs)")
+    # Default bases sit BELOW common ephemeral-port floors (32768
+    # standard, 16000 in some containers): at 64–128 peers the wide
+    # node/UI ranges otherwise collide with the outbound source ports
+    # of ~2N booting processes — observed as a random node dying with
+    # EADDRINUSE mid-boot. start_all.py's port check warns on overlap.
+    ap.add_argument("--node-base", type=int, default=12081)
+    ap.add_argument("--ui-base", type=int, default=12501)
+    ap.add_argument("--dir-port", type=int, default=12480)
+    ap.add_argument("--serve-port", type=int, default=12490)
+    ap.add_argument("--rate", type=float,
+                    default=env_float("LOADGEN_RATE", 8.0),
+                    help="open-loop Poisson arrival rate, 1/s")
+    ap.add_argument("--duration", type=float,
+                    default=env_float("LOADGEN_DURATION_S", 60.0))
+    ap.add_argument("--seed", type=int, default=env_int("LOADGEN_SEED", 0))
+    ap.add_argument("--workers", type=int,
+                    default=env_int("LOADGEN_WORKERS", 64),
+                    help="bounded executor pool (a stall surfaces as "
+                         "SLO-visible lag, never generator backpressure)")
+    ap.add_argument("--timeout", type=float,
+                    default=env_float("LOADGEN_TIMEOUT_S", 120.0))
+    ap.add_argument("--mix", default=env_or("LOADGEN_MIX", ""),
+                    help="scenario weights, e.g. 'short_chat=4,embed=1' "
+                         "(default: registry weights)")
+    ap.add_argument("--chaos", default=env_or("LOADGEN_CHAOS", ""),
+                    help="FAIL_POINTS grammar armed in EVERY launched "
+                         "process for the whole run, e.g. "
+                         "'serve.api.stream=drop@0.02,p2p.dht.rpc="
+                         "drop@0.05'")
+    ap.add_argument("--boot-wave", type=int,
+                    default=env_int("LOADGEN_BOOT_WAVE", 8))
+    ap.add_argument("--slots", type=int, default=0,
+                    help="SERVE_SLOTS override (default: peers, capped "
+                         "at 32 — undersize it to find the overload "
+                         "edge)")
+    ap.add_argument("--queue-max", type=int, default=-1,
+                    help="SERVE_QUEUE_MAX override (sizes the shed "
+                         "edge; -1 = server auto)")
+    ap.add_argument("--suggest-predict", type=int, default=24,
+                    help="UI_SUGGEST_PREDICT for the launched UIs: token "
+                         "bound on co-pilot suggestions (0 = reference "
+                         "behavior, the server's 256 default)")
+    ap.add_argument("--out-dir", default=REPO,
+                    help="directory for the durable E2E_r0N.json row")
+    ap.add_argument("--no-row", action="store_true",
+                    help="print the ledger only; skip the durable row")
+    ap.add_argument("--stub", action="store_true",
+                    help="drive the in-process stub server instead of "
+                         "launching the stack (CI smoke; implies "
+                         "--no-row unless --out-dir is explicit)")
     ap.add_argument("--workload", default="quote",
                     choices=["quote", "random"],
                     help="quote (default): serve a synthetic checkpoint "
                          "whose output is a repeating printable phrase "
                          "(models/synth.py) so suggestions stream as "
-                         "text; random: raw random init, whose non-UTF-8 "
-                         "byte stream buffers in the detokenizer and "
-                         "degrades streaming TTFT to completion time")
+                         "text; random: raw random init (non-UTF-8 "
+                         "streams buffer in the detokenizer)")
     args = ap.parse_args()
+    if args.chaos:
+        parse_fail_points(args.chaos)   # typos fail before any boot
+
+    meta = {"peers": args.peers, "config": args.config,
+            "backend": args.backend, "rate_rps": args.rate,
+            "seed": args.seed, "mix": args.mix or "default",
+            "chaos_spec": args.chaos or None,
+            "path": "UI HTTP -> serve front -> scheduler -> chip; "
+                    "node /send -> encrypted stream -> peer inbox"}
+
+    if args.stub:
+        from p2p_llm_chat_tpu.loadgen import StubServer
+        stub = StubServer(ttft_s=0.005, itl_s=0.002, deltas=4).start()
+        try:
+            n = max(1, min(args.peers, 8))
+            ep = Endpoints(serve_url=stub.url, ui_urls=(stub.url,) * n,
+                           node_urls=(stub.url,) * n,
+                           users=tuple(f"peer{i:02d}" for i in range(n)))
+            chaos = (ChaosWindow(args.chaos,
+                                 disarm_at_s=args.duration * 0.75)
+                     if args.chaos else None)
+            row = drive(ep, args, chaos)
+            row.update(meta)
+            row["stub"] = True
+            if args.out_dir != REPO and not args.no_row:
+                # An explicitly-chosen out dir opts the stub smoke back
+                # into a durable row (per the --stub help text); the
+                # default never pollutes the repo's E2E_r0N sequence.
+                path = write_row(row, args.out_dir)
+                print(f"ledger row -> {path}", file=sys.stderr)
+            print(json.dumps(row), flush=True)
+            return 0 if row["verdict"] == "pass" else 1
+        finally:
+            stub.stop()
+
     n = args.peers
     users = [f"peer{i:02d}" for i in range(n)]
+    dev_crypto = importlib.util.find_spec("cryptography") is None
+    meta["dev_crypto"] = dev_crypto
 
     env = dict(
         os.environ,
         MODEL_CONFIG=args.config,
-        SERVE_SLOTS=str(n),
-        SERVE_MAX_SEQ="1024",
-        SERVE_KV="paged",
-        SERVE_QUANT="int8",
-        SERVE_KV_QUANT="int8",
-        SERVE_WARMUP="64,128,256",
-        # 8B-scale checkpoint boots (16 GB restore + streamed int8 +
-        # warmup compiles) take ~10 min; the launcher waits this long.
-        SERVE_WAIT_S="1800",
+        SERVE_SLOTS=str(args.slots or min(n, 32)),
+        LOADGEN_BOOT_WAVE=str(args.boot_wave),
         # PREPEND to PYTHONPATH: clobbering it drops /root/.axon_site,
         # where the axon TPU PJRT plugin lives, and the serve subprocess
         # silently loses the chip.
         PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
     )
-    if args.workload == "quote":
-        # Build the quote checkpoint in a CPU subprocess (importing jax
-        # HERE would grab the axon TPU tunnel away from the serve).
-        # E2E_CKPT_DIR reuses a previous build — at 8B dims the build +
-        # save is ~16 GB and ~15 minutes, far too slow to repeat per run.
-        cache = os.environ.get("E2E_CKPT_DIR", "")
-        meta_path = os.path.join(cache, "native_meta.json") if cache else ""
-        cached_cfg = None
-        if meta_path and os.path.exists(meta_path):
-            with open(meta_path) as f:
-                cached_cfg = json.load(f).get("config")
-        if cached_cfg == args.config:
-            env["CKPT_DIR"] = cache
-            env["LLM_MODEL"] = args.config
-            ckpt_dir = None
-        else:
-            if cached_cfg is not None:
-                print(f"E2E_CKPT_DIR holds {cached_cfg!r}, need "
-                      f"{args.config!r}; rebuilding")
-            ckpt_dir = cache or tempfile.mkdtemp(prefix="e2e_quote_")
-            os.makedirs(ckpt_dir, exist_ok=True)
-        if ckpt_dir is not None:
-            build = (
-                "import os\n"
-                "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
-                "import jax\n"
-                "jax.config.update('jax_platforms', 'cpu')\n"
-                "import jax.numpy as jnp\n"
-                "from p2p_llm_chat_tpu.models.synth import quote_params\n"
-                "from p2p_llm_chat_tpu.models.configs import get_config\n"
-                "from p2p_llm_chat_tpu.models.checkpoint import save_checkpoint\n"
-                f"cfg = get_config({args.config!r})\n"
-                "params = quote_params(cfg, jax.random.PRNGKey(0), "
-                "dtype=jnp.bfloat16)\n"
-                f"save_checkpoint({ckpt_dir!r}, params, cfg)\n")
-            subprocess.run([sys.executable, "-c", build], env=env, check=True)
-            env["CKPT_DIR"] = ckpt_dir
-            env["LLM_MODEL"] = args.config
+    for k, v in (
+            ("SERVE_MAX_SEQ", "4096"),
+            ("SERVE_KV", "paged"),
+            ("SERVE_QUANT", "int8"),
+            ("SERVE_KV_QUANT", "int8"),
+            # The warmup ladder MUST include the top prompt bucket: the
+            # long-context scenario's ~3k-token prompts land there, and
+            # an unwarmed bucket lazily compiles its whole chunked-
+            # admission ladder mid-serving — each compile stalls every
+            # live stream (observed as 90 s p95 TTFT tails at 64 peers).
+            ("SERVE_WARMUP", "64,128,256,4096"),
+            # 8B-scale checkpoint boots (16 GB restore + streamed int8 +
+            # warmup compiles) take ~10 min; the launcher waits this
+            # long.
+            ("SERVE_WAIT_S", "1800"),
+    ):
+        env.setdefault(k, v)
+    # Loopback deployment: don't probe the host's real gateway for
+    # NAT-PMP from 64–128 nodes (explicit NATPMP=1 in the caller's env
+    # still wins).
+    env.setdefault("NATPMP", "0")
+    # Bound the co-pilot suggestion length (the reference sends no
+    # num_predict, i.e. the server's 256 default — the single biggest
+    # per-request cost; one short sentence is the product-shaped reply).
+    env.setdefault("UI_SUGGEST_PREDICT", str(args.suggest_predict))
+    if args.queue_max >= 0:
+        env["SERVE_QUEUE_MAX"] = str(args.queue_max)
+    if dev_crypto:
+        print("NOTE: 'cryptography' not installed — node plane runs the "
+              "INSECURE dev fallback (P2P_DEV_CRYPTO=1, p2p/devcrypto.py)",
+              file=sys.stderr)
+        env["P2P_DEV_CRYPTO"] = "1"
+    if args.chaos:
+        env["FAIL_POINTS"] = args.chaos
+    if args.workload == "quote" and args.backend == "tpu":
+        build_quote_checkpoint(args.config, env)
 
     launcher = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "start_all.py"),
-         "--backend", "tpu", "--users", ",".join(users),
+         "--backend", args.backend, "--users", ",".join(users),
          "--node-port-base", str(args.node_base),
          "--ui-port-base", str(args.ui_base),
          "--dir-port", str(args.dir_port),
-         "--serve-port", str(args.serve_port)],
+         "--serve-port", str(args.serve_port),
+         "--boot-wave", str(args.boot_wave)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     # Drain launcher output (an undrained PIPE fills and BLOCKS the
     # launcher mid-boot); keep a tail for diagnostics.
@@ -154,120 +322,83 @@ def main() -> None:
     def drain() -> None:
         for line in launcher.stdout:
             tail.append(line)
-            del tail[:-50]
+            del tail[:-80]
 
     threading.Thread(target=drain, daemon=True).start()
+
+    serve_url = f"http://127.0.0.1:{args.serve_port}"
+    row: dict = {}
+    rc = 1
     try:
-        # The launcher boots the serve front FIRST (model init + warmup on
-        # the chip can take minutes) and only then the nodes/UIs.
-        wait_http(f"http://127.0.0.1:{args.serve_port}/api/tags",
-                  deadline_s=1800.0)   # 8B checkpoint boots take ~10 min
-        for i in range(n):
-            wait_http(f"http://127.0.0.1:{args.node_base + i}/healthz")
-            wait_http(f"http://127.0.0.1:{args.ui_base + i}/")
-        post(f"http://127.0.0.1:{args.serve_port}/api/generate",
-             {"model": args.config, "prompt": "warm", "stream": False,
-              "options": {"num_predict": 4}}, timeout=900).read()
-        # Practice suggestion through one UI: compiles any admission/
-        # decode program the warmup ladder missed, so the measured burst
-        # sees the steady-state TTFT (bench.py does the same).
-        post(f"http://127.0.0.1:{args.ui_base}/api/suggest",
-             {"content": "warmup message, please ignore"},
-             timeout=900).read()
-
-        # Each peer i sends a message to peer (i+1) % n over the real
-        # node path; the recipient's UI then has an inbox message to
-        # suggest a reply to.
-        # Distinct per-peer texts (real peers don't send 32 identical
-        # messages; an identical-prompt burst additionally triggers a
-        # prefix-cache auto-promotion build mid-burst, whose compile
-        # stalls the scheduler thread for seconds).
-        msgs = [f"Hey {users[(i + 1) % n]}, are we still meeting "
-                f"tomorrow at {8 + i % 9}:{15 * (i % 4):02d}?"
-                for i in range(n)]
-        if args.identical:
-            msgs = ["Hey, are we still meeting tomorrow at 10?"] * n
-        for i in range(n):
-            to = users[(i + 1) % n]
-            with post(f"http://127.0.0.1:{args.ui_base + i}/node/send",
-                      {"to_username": to, "content": msgs[i]}) as r:
-                assert json.loads(r.read()).get("status") == "sent"
-        time.sleep(1.0)
-
-        # All peers fire the co-pilot suggestion concurrently; TTFT =
-        # time to the first NDJSON delta at the UI boundary.
-        ttfts: list[float] = [0.0] * n
-        errs: list[str] = []
-
-        def suggest(i: int) -> None:
-            t0 = time.monotonic()
-            try:
-                r = post(
-                    f"http://127.0.0.1:{args.ui_base + i}/api/suggest/stream",
-                    {"content": msgs[(i - 1) % n]})
-                first = None
-                nline = 0
-                for line in r:
-                    d = json.loads(line)
-                    nline += 1
-                    if nline <= 3 and i < 4:
-                        print(f"peer{i} line{nline} @{time.monotonic()-t0:.2f}s: "
-                              f"{line[:80]!r}", file=sys.stderr)
-                    if d.get("error"):
-                        errs.append(str(d))
-                        return
-                    if first is None and d.get("delta"):
-                        first = time.monotonic() - t0
-                    if d.get("done"):
-                        break
-                ttfts[i] = first if first is not None else -1.0
-            except Exception as e:   # noqa: BLE001
-                errs.append(f"peer{i}: {e}")
-
-        threads = [threading.Thread(target=suggest, args=(i,))
-                   for i in range(n)]
-        t0 = time.monotonic()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=180)
-        wall = time.monotonic() - t0
-        if errs:
-            print(f"suggest errors ({len(errs)}): {errs[:3]}",
-                  file=sys.stderr)
-        if len(errs) > n // 4:
-            raise RuntimeError(f"too many suggest errors: {errs[:5]}")
         try:
-            with urllib.request.urlopen(
-                    f"http://127.0.0.1:{args.serve_port}/metrics",
-                    timeout=10) as m:
-                for line in m.read().decode().splitlines():
-                    if any(k in line for k in ("ttft", "admit", "queue",
-                                               "prefix", "occupancy")):
-                        print("serve-metric:", line, file=sys.stderr)
-        except Exception:
-            pass
-        good = sorted(t * 1e3 for t in ttfts if t > 0)
-        if not good:
-            raise RuntimeError(
-                f"no peer recorded a first delta (errors: {errs[:5]}; "
-                "empty generations or all streams done-without-delta)")
-        p50 = statistics.median(good)
-        p95 = good[min(len(good) - 1, int(0.95 * len(good)))]
-        print(json.dumps({
-            "metric": f"e2e_ui_ttft_ms_{n}_peers_{args.config}",
-            "p50_ttft_ms": round(p50, 1), "p95_ttft_ms": round(p95, 1),
-            "peers": n, "samples": len(good), "errors": len(errs),
-            "wall_s": round(wall, 2),
-            "path": "UI HTTP -> serve front -> scheduler -> chip",
-        }), flush=True)
+            # The launcher boots the serve front FIRST (model init +
+            # warmup on the chip can take minutes) and only then the
+            # node/UI waves.
+            wait_http(f"{serve_url}/api/tags", deadline_s=1800.0,
+                      launcher=launcher)
+            for i in range(n):
+                wait_http(f"http://127.0.0.1:{args.node_base + i}/healthz",
+                          launcher=launcher)
+                wait_http(f"http://127.0.0.1:{args.ui_base + i}/",
+                          launcher=launcher)
+            # Warm the serving path: compiles any admission/decode
+            # program the warmup ladder missed, so the measured run sees
+            # steady-state TTFT (bench.py does the same).
+            post(f"{serve_url}/api/generate",
+                 {"model": args.config, "prompt": "warm", "stream": False,
+                  "options": {"num_predict": 4}}, timeout=900).read()
+            post(f"http://127.0.0.1:{args.ui_base}/api/suggest",
+                 {"content": "warmup message, please ignore"},
+                 timeout=900).read()
+
+            ep = Endpoints(
+                serve_url=serve_url,
+                ui_urls=tuple(f"http://127.0.0.1:{args.ui_base + i}"
+                              for i in range(n)),
+                node_urls=tuple(f"http://127.0.0.1:{args.node_base + i}"
+                                for i in range(n)),
+                users=tuple(users))
+            # Env-armed chaos spans the whole run (every process arms at
+            # boot); the window object only annotates — recovery is the
+            # post-run probe below.
+            chaos = (ChaosWindow(args.chaos, in_process=False)
+                     if args.chaos else None)
+            row = drive(ep, args, chaos)
+
+            # Recovery probe: after the storm, the stack still answers.
+            probe_ok = False
+            try:
+                with post(f"{serve_url}/api/generate",
+                          {"model": args.config, "prompt": "probe",
+                           "stream": False,
+                           "options": {"num_predict": 4}},
+                          timeout=120) as r:
+                    probe_ok = bool(json.loads(r.read()).get("done"))
+            except Exception as e:   # noqa: BLE001 — recorded, not fatal
+                row.setdefault("failures", []).append(
+                    f"post-run probe failed: {e}")
+                row["verdict"] = "fail"
+            row["post_run_probe_ok"] = probe_ok
+            row.update(meta)
+            rc = 0 if row["verdict"] == "pass" else 1
+        except BaseException as e:
+            row = error_row(e, meta)
+            row["launcher_tail"] = (
+                b"".join(tail)[-1500:].decode("utf-8", "replace"))
+            raise
     finally:
         launcher.terminate()
         try:
             launcher.wait(timeout=15)
         except subprocess.TimeoutExpired:
             launcher.kill()
+        if row and not args.no_row:
+            path = write_row(row, args.out_dir)
+            print(f"ledger row -> {path}", file=sys.stderr)
+        if row:
+            print(json.dumps(row), flush=True)
+    return rc
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

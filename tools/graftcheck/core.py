@@ -52,7 +52,8 @@ class Finding:
 class Config:
     """Knobs shared by the analyzers (defaults match this repo)."""
 
-    env_prefixes: tuple[str, ...] = ("SERVE_", "BENCH_", "PAGED_", "FAIL_")
+    env_prefixes: tuple[str, ...] = ("SERVE_", "BENCH_", "PAGED_", "FAIL_",
+                                     "LOADGEN_", "P2P_")
     env_module: str = "utils/env.py"           # the one blessed reader
     docs_files: tuple[str, ...] = ("docs/serving.md",)
     pytest_ini: str = "pytest.ini"
