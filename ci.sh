@@ -13,10 +13,11 @@ cd "$(dirname "$0")"
 rc=0
 
 # Static analysis runs FIRST: it needs no device and fails in seconds,
-# so a trace-safety/lock-discipline/env-hygiene regression never waits
-# on a compile. Any new finding fails the gate — suppress only with a
+# so a trace-safety/lock-discipline/lock-order/blocking-under-lock/
+# metrics-contract/stream-close/env-hygiene regression never waits on a
+# compile. Any new finding fails the gate — suppress only with a
 # reasoned annotation (docs/static-analysis.md).
-echo "== graftcheck static analysis"
+echo "== graftcheck static analysis (all analyzers)"
 python -m tools.graftcheck p2p_llm_chat_tpu bench.py start_all.py tests \
   || exit 1
 
@@ -99,6 +100,19 @@ if [ "${1:-}" = "full" ]; then
   echo "== loadgen: stub contracts + 4-peer e2e leg with chaos (CPU)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_loadgen.py \
     tests/test_devcrypto.py -q || rc=1
+
+  # Runtime guarded-by enforcement (tools/graftcheck/lockcheck.py):
+  # re-run the THREADED suites with every `# guarded-by:` attribute
+  # rewritten into a held-by-this-thread assertion — the annotations
+  # the static analyzer reads get exercised by real concurrent
+  # schedules, TSan-style. Deliberately out of tier-1: the instrumented
+  # classes re-run whole files the sweep already covers, and the 870 s
+  # tier-1 budget has no room for a second pass (docs/static-analysis.md
+  # §lockcheck runbook).
+  echo "== lockcheck: runtime guarded-by assertions over the threaded suites"
+  GRAFTCHECK_LOCKCHECK=1 JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_router.py tests/test_kv_tier.py tests/test_loadgen.py \
+    tests/test_stress.py -q || rc=1
 
   echo "== full test suite"
   python -m pytest tests/ -q \

@@ -153,6 +153,11 @@ class LoadDriver:
         self._mu = threading.Lock()
         self._records: list = []        # guarded-by: _mu
         self._inflight: dict = {}       # guarded-by: _mu (worker id -> Arrival)
+        # The work queue needs no guarded-by: queue.Queue is internally
+        # locked, and the pacer is its only producer / the workers its
+        # only consumers (blocking .get() with no timeout is the worker
+        # park state by design — never under _mu, which the blocking
+        # analyzer would flag).
         self._q: "queue.Queue" = queue.Queue()
 
     # -- request execution -------------------------------------------------

@@ -79,7 +79,7 @@ class StubServer:
 
     # -- handlers -----------------------------------------------------------
 
-    def _stream(self, key: str, wrap, n: int) -> Iterator[bytes]:
+    def _stream(self, key: str, wrap, n: int) -> Iterator[bytes]:  # graftcheck: stream-ok pure generator: sleeps + yields only, no gauges or upstream to settle
         time.sleep(self.ttft_s + self.stall_s)
         truncate = bool(self.truncate_every
                         and n % self.truncate_every == 0)

@@ -32,6 +32,13 @@ Opt-in is explicit: importing through :func:`require_dev_crypto` raises
 ImportError unless ``P2P_DEV_CRYPTO=1`` is set, so a production node
 missing its real dependency still fails loudly at boot instead of
 silently downgrading to this.
+
+Threading: every class here is immutable after construction (key
+material only; per-call state is local) — audited for the round-13
+lock-discipline sweep, so there is nothing to ``guarded-by``-annotate
+and instances are safe to share across the transport's threads without
+locks. Keep it that way: any future mutable cache added here must grow
+a lock and the annotation.
 """
 
 from __future__ import annotations

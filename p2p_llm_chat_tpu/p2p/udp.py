@@ -82,6 +82,11 @@ class ReliableDgram:
         self._cond = threading.Condition()
         self._timeout: Optional[float] = None
         self._closed = threading.Event()
+        # Intended hierarchy (machine-checked by graftcheck lock-order):
+        # the sender path holds _send_mu across a whole stop-and-wait
+        # chunk exchange and takes _acks_mu briefly inside it; nothing
+        # may ever take them in the other order.
+        # lock-order: ReliableDgram._send_mu < ReliableDgram._acks_mu
         self._send_mu = threading.Lock()
         self._fin_sent = False
         self._pump = threading.Thread(target=self._pump_loop, daemon=True)

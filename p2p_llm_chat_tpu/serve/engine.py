@@ -235,11 +235,11 @@ class TPUEngine:
                 for r, seq in enumerate(chunk):
                     toks[r, : len(seq)] = seq
                     lens[r] = max(1, len(seq))
-                # graftcheck: sync-ok embed responses need the vectors now
+                # graftcheck: sync-ok,block-ok embed responses need the vectors now; the lock exists to serialize device embeds, the sync IS the guarded work
                 vecs = np.asarray(self._embed_j(
                     sched._params, tokens=jnp.asarray(toks),
                     lens=jnp.asarray(lens)))
-                # graftcheck: sync-ok host numpy rows, already materialized above
+                # graftcheck: sync-ok,block-ok host numpy rows, already materialized above
                 out.extend(vecs[r].tolist() for r in range(len(chunk)))
         return out, n_tokens
 

@@ -129,13 +129,17 @@ class KVTier:
         self._sessions: dict[str, SessionKV] = {}   # guarded-by: _mu
         self._by_head: dict[tuple, str] = {}        # guarded-by: _mu
         self.host_bytes = 0                         # guarded-by: _mu
-        # Counters (monotonic; torn reads harmless for /metrics).
-        self.n_parked_total = 0
-        self.n_waked_total = 0
-        self.n_wake_cold_total = 0    # follow-ups that found no session
-        self.n_wake_tokens_total = 0  # prompt tokens wake did NOT re-prefill
-        self.n_evicted_total = 0
-        self.n_pages_freed_total = 0  # HBM pages released by parking
+        # Counters: monotonic, written through the note_* helpers (or
+        # internally under the lock) so the guarded-by annotation is
+        # executable under GRAFTCHECK_LOCKCHECK=1 — round-13 replaced
+        # the bare "torn reads harmless" += pokes, which were true but
+        # unverifiable.
+        self.n_parked_total = 0       # guarded-by: _mu
+        self.n_waked_total = 0        # guarded-by: _mu
+        self.n_wake_cold_total = 0    # guarded-by: _mu — follow-ups that found no session
+        self.n_wake_tokens_total = 0  # guarded-by: _mu — prompt tokens wake did NOT re-prefill
+        self.n_evicted_total = 0      # guarded-by: _mu
+        self.n_pages_freed_total = 0  # guarded-by: _mu — HBM pages released by parking
 
     # -- index ---------------------------------------------------------------
 
@@ -178,14 +182,16 @@ class KVTier:
         indexable = bool(key) or self._head(prompt_ids) is not None
         if s is None:
             if count_miss and indexable:
-                self.n_wake_cold_total += 1
+                with self._mu:
+                    self.n_wake_cold_total += 1
             return None
         if not (0 < s.length < len(prompt_ids)
                 and tuple(prompt_ids[: s.length]) == s.tokens):
             if key and s.key == key:
                 self.drop(s)        # diverged history: stale forever
             if count_miss and indexable:
-                self.n_wake_cold_total += 1
+                with self._mu:
+                    self.n_wake_cold_total += 1
             return None
         s.last_used = time.monotonic()
         return s
@@ -233,8 +239,38 @@ class KVTier:
         s = self.take(sess.key)
         if s is None:
             return None
-        self.n_evicted_total += 1
+        with self._mu:
+            self.n_evicted_total += 1
         return s.pages
+
+    # -- counters (the scheduler's write API; lock taken here so the
+    # guarded-by annotations hold under runtime lockcheck) -------------------
+
+    def note_parked(self, pages_freed: int = 0) -> None:
+        with self._mu:
+            self.n_parked_total += 1
+            self.n_pages_freed_total += pages_freed
+
+    def note_waked(self, n: int, tokens_saved: int = 0) -> None:
+        with self._mu:
+            self.n_waked_total += n
+            self.n_wake_tokens_total += tokens_saved
+
+    def stats(self) -> dict[str, float]:
+        """One consistent locked snapshot of the counters + host pool —
+        the read API for /metrics and tests (a bare ``tier.n_*`` read
+        from another thread fails under GRAFTCHECK_LOCKCHECK=1, by
+        design)."""
+        with self._mu:
+            return {
+                "host_bytes": self.host_bytes,
+                "parked_total": self.n_parked_total,
+                "waked_total": self.n_waked_total,
+                "wake_cold_total": self.n_wake_cold_total,
+                "wake_tokens_total": self.n_wake_tokens_total,
+                "evicted_total": self.n_evicted_total,
+                "pages_freed_total": self.n_pages_freed_total,
+            }
 
     # -- policy --------------------------------------------------------------
 

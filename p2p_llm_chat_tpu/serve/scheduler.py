@@ -139,7 +139,12 @@ class _Slot:
     # ahead of the wake dispatch, and the session stamp invalidates the
     # prefetch if the session is replaced/re-parked before the claim (a
     # stale payload scattered under a NEWER session's sizes would break
-    # the byte-identity contract, or crash the jitted scatter).
+    # the byte-identity contract, or crash the jitted scatter). Like
+    # every _Slot field, the stamps are confined to the scheduler loop
+    # thread (the _Replica precedent: the guard lives on the OWNING
+    # scheduler, whose tables carry the machine-checked annotations) —
+    # set at match time, cleared on claim/demote/error, never read off
+    # the loop.
     wake_key: Optional[str] = None
     wake_dev: Optional[tuple] = None
     last_emit_t: float = 0.0                           # inter-token gap tracking
@@ -434,6 +439,12 @@ class BatchScheduler:
         if queue_max is not None and queue_max < 0:
             raise ValueError(f"queue_max must be >= 0, got {queue_max}")
         self.queue_max = (8 * num_slots if queue_max is None else queue_max)
+        # Intended serving-plane hierarchy (machine-checked by
+        # graftcheck lock-order): the admission-depth lock orders before
+        # the KV tier's index lock — scheduler code may touch the tier
+        # while accounting depth, but KVTier must never call back into
+        # submit/depart while holding its own lock.
+        # lock-order: BatchScheduler._depth_mu < KVTier._mu
         self._depth_mu = threading.Lock()
         self._queued_requests = 0     # guarded-by: _depth_mu
         self._n_shed = 0              # guarded-by: _depth_mu
@@ -2536,14 +2547,18 @@ class BatchScheduler:
             out["kv_resident_sessions"] = res
             out["kv_parked_sessions"] = parked
             out["kv_open_sessions"] = res + parked
-            out["kv_host_bytes"] = self._tier.host_bytes
-            out["kv_parked_total"] = self._tier.n_parked_total
-            out["kv_waked_total"] = self._tier.n_waked_total
-            out["kv_wake_cold_total"] = self._tier.n_wake_cold_total
-            out["kv_wake_tokens_saved_total"] = \
-                self._tier.n_wake_tokens_total
-            out["kv_evicted_total"] = self._tier.n_evicted_total
-            out["kv_pages_freed_total"] = self._tier.n_pages_freed_total
+            # One locked snapshot (KVTier.stats) instead of seven bare
+            # cross-object reads: consistent values on the wire, and no
+            # reliance on this function's advisory suppression for
+            # another object's guarded state under runtime lockcheck.
+            st = self._tier.stats()
+            out["kv_host_bytes"] = st["host_bytes"]
+            out["kv_parked_total"] = st["parked_total"]
+            out["kv_waked_total"] = st["waked_total"]
+            out["kv_wake_cold_total"] = st["wake_cold_total"]
+            out["kv_wake_tokens_saved_total"] = st["wake_tokens_total"]
+            out["kv_evicted_total"] = st["evicted_total"]
+            out["kv_pages_freed_total"] = st["pages_freed_total"]
             out["kv_wake_p50_ms"] = round(
                 self._wake_hist.percentile(50) or 0.0, 3)
             out["kv_wake_p95_ms"] = round(
@@ -3668,7 +3683,7 @@ class BatchScheduler:
         self._tier.insert(SessionKV(
             key=key, tokens=tuple(toks), length=slot.ctx_len,
             host=(payload, W), nbytes=sum(p.nbytes for p in payload)))
-        self._tier.n_parked_total += 1
+        self._tier.note_parked()
         self._tier_enforce()
         return False
 
@@ -3703,8 +3718,7 @@ class BatchScheduler:
             host=(payload, n),
             nbytes=sum(a.nbytes for a in payload if a is not None),
             last_used=sess.last_used))
-        self._tier.n_parked_total += 1
-        self._tier.n_pages_freed_total += n
+        self._tier.note_parked(pages_freed=n)
         self._tier_enforce()
 
     # graftcheck: runs-on _loop
@@ -3958,12 +3972,13 @@ class BatchScheduler:
         now = time.monotonic()
         wake_ms = (now - t0) * 1e3
         self._n_admitted += len(live)
-        self._tier.n_waked_total += len(live)
+        # Prompt tokens whose prefill the wake skipped (everything but
+        # the new turn's suffix) — the compute-saved counter.
+        self._tier.note_waked(
+            len(live),
+            tokens_saved=sum(int(ints[1, row]) for _, row in live))
         for slot, row in live:
             self._wake_hist.observe(wake_ms)
-            # Prompt tokens whose prefill the wake skipped (everything
-            # but the new turn's suffix) — the compute-saved counter.
-            self._tier.n_wake_tokens_total += int(ints[1, row])
             slot.depart()
             if slot.stats is not None:
                 slot.stats.ttft_s = now - slot.req.arrival_time

@@ -162,6 +162,7 @@ class ChatUI:
             # strings as the buffered path (streamlit_app.py:100-101);
             # error:true lets the browser treat the text as a failure
             # marker instead of appending it to a partial suggestion.
+            # graftcheck: stream-ok single constant yield, no upstream or gauge held
             def unavailable(msg=str(e)):
                 yield (json.dumps({
                     "delta": f"(LLM unavailable: {msg})", "done": True,
@@ -171,6 +172,12 @@ class ChatUI:
                             content_type="application/x-ndjson")
 
         def gen():
+            # The finally (not the `with` alone) is what settles things
+            # on CLIENT disconnect: HttpServer close()es this generator,
+            # GeneratorExit lands at the current yield — which sits
+            # OUTSIDE the `with resp:` on the error path — and the
+            # upstream serve connection (still holding a decode slot)
+            # must be released now, not at GC.
             try:
                 with resp:
                     for line in resp:
@@ -194,6 +201,11 @@ class ChatUI:
                     "delta": f"(LLM unavailable: {e})", "done": True,
                     "error": True,
                 }) + "\n").encode("utf-8")
+            finally:
+                try:
+                    resp.close()
+                except Exception:   # noqa: BLE001 — teardown only
+                    pass
 
         return Response(200, stream=gen(),
                         content_type="application/x-ndjson")

@@ -138,22 +138,31 @@ class HttpServer:
                     # traceback per disconnect (at 64-peer load that is
                     # a log storm).
                     try:
-                        for chunk in resp.stream:
-                            if not chunk:
-                                continue
-                            self.wfile.write(f"{len(chunk):x}\r\n".encode())
-                            self.wfile.write(chunk)
-                            self.wfile.write(b"\r\n")
-                            self.wfile.flush()
-                        self.wfile.write(b"0\r\n\r\n")
-                    except (ConnectionResetError, BrokenPipeError):
-                        log.debug("client disconnected mid-stream on %s %s",
-                                  self.command, parsed.path)
-                        self.close_connection = True
                         try:
-                            # Run the generator's finally blocks NOW
-                            # (inflight gauges, stats observers) rather
-                            # than at some later GC.
+                            for chunk in resp.stream:
+                                if not chunk:
+                                    continue
+                                self.wfile.write(
+                                    f"{len(chunk):x}\r\n".encode())
+                                self.wfile.write(chunk)
+                                self.wfile.write(b"\r\n")
+                                self.wfile.flush()
+                            self.wfile.write(b"0\r\n\r\n")
+                        except (ConnectionResetError, BrokenPipeError):
+                            log.debug(
+                                "client disconnected mid-stream on %s %s",
+                                self.command, parsed.path)
+                            self.close_connection = True
+                    finally:
+                        # Run the generator's finally blocks NOW
+                        # (inflight gauges, stats observers, upstream
+                        # connections) rather than at some later GC — on
+                        # EVERY exit path, not just the two reset types:
+                        # a socket timeout or any other write error that
+                        # propagates out of the chunk loop must settle
+                        # the gauges too (a no-op when the generator ran
+                        # to exhaustion).
+                        try:
                             resp.stream.close()
                         except Exception:  # noqa: BLE001 — teardown only
                             pass

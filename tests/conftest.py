@@ -69,6 +69,23 @@ assert jax.devices()[0].platform == "cpu", (
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Runtime guarded-by enforcement (tools/graftcheck/lockcheck.py): under
+# GRAFTCHECK_LOCKCHECK=1 every class-level `# guarded-by:` attribute in
+# the serving + chat planes is rewritten into a descriptor asserting
+# the named lock is held by the current thread — the annotations the
+# static analyzer reads become executable assertions exercised by the
+# threaded suites. (Module-level globals carrying the comment, e.g.
+# utils/backoff._retries_total, are documentation only in both worlds —
+# the grammar is class-scoped; docs/static-analysis.md §lockcheck.)
+# (ci.sh full runs test_router/test_kv_tier/test_loadgen/test_stress
+# this way). Must run here, before any test module builds a scheduler,
+# router, or driver instance — pre-existing instances would keep their
+# state under the un-mangled attribute names.
+if os.environ.get("GRAFTCHECK_LOCKCHECK") == "1":
+    from tools.graftcheck import lockcheck as _lockcheck
+    _lockcheck.install(root=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
 
 # Model-heavy modules get the `model` marker automatically, so the
 # chat-plane suite stays sub-minute: `pytest -m "not model"`.

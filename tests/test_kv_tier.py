@@ -138,12 +138,14 @@ def test_host_budget_victims_and_claim():
                     host=((np.zeros(2),), 1), nbytes=80)
     tier.insert(old)
     tier.insert(new)
-    assert tier.host_bytes == 160
+    # stats() is the read API: bare tier.host_bytes reads off-thread
+    # fail under GRAFTCHECK_LOCKCHECK=1 (the annotations have teeth).
+    assert tier.stats()["host_bytes"] == 160
     victims = tier.host_victims()
     assert victims and victims[0].key == "old"   # bytes x recency
     tier.drop(victims[0])
-    assert tier.host_bytes == 80
-    assert tier.n_evicted_total == 1
+    assert tier.stats()["host_bytes"] == 80
+    assert tier.stats()["evicted_total"] == 1
     # claim removes the session; a second claim finds nothing.
     assert tier.claim("new", [3, 4, 5]) is not None
     assert tier.claim("new", [3, 4, 5]) is None
@@ -332,10 +334,10 @@ def test_eviction_under_pressure_falls_back_cold():
         eng.scheduler._tier.idle_s = 0.0
         deadline = time.monotonic() + 10.0
         while time.monotonic() < deadline:
-            if eng.scheduler._tier.n_evicted_total >= 1:
+            if eng.scheduler._tier.stats()["evicted_total"] >= 1:
                 break
             time.sleep(0.02)
-        assert eng.scheduler._tier.n_evicted_total >= 1
+        assert eng.scheduler._tier.stats()["evicted_total"] >= 1
         assert eng.scheduler._tier.counts() == (0, 0)
         t2, _ = run(eng, PROMPT2, "e", ctx=s1.context)
         snap = eng.scheduler.metrics_snapshot()

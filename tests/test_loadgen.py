@@ -229,10 +229,14 @@ def test_open_loop_arrivals_fire_on_schedule_despite_stall(stub):
     recs = drv.run(sched)
     assert len(recs) == len(sched)
     # Arrival-side evidence: every request REACHED the server roughly at
-    # its scheduled offset, though each takes ~400 ms to answer.
+    # its scheduled offset, though each takes ~400 ms to answer. Copy
+    # under the stub's lock: request_times is guarded-by _mu, enforced
+    # for test readers too under GRAFTCHECK_LOCKCHECK=1.
     lags = []
-    base = s.request_times[0] - sched[0].t      # align clocks
-    for arr, seen in zip(sched, sorted(s.request_times)):
+    with s._mu:
+        times = list(s.request_times)
+    base = times[0] - sched[0].t                # align clocks
+    for arr, seen in zip(sched, sorted(times)):
         lags.append(abs((seen - base) - arr.t))
     assert max(lags) < 0.25, f"arrivals drifted: max {max(lags):.3f}s"
     # Latency-side evidence: the stall is in the judged TTFT.

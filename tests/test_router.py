@@ -547,7 +547,11 @@ def test_prefix_share_skips_storeless_replicas():
             snap = parse_metrics_text(r.read().decode())
         assert snap.get("router_prefix_syncs_total", 0) == 0
         assert snap.get("router_prefix_sync_failures_total", 0) == 0
-        assert rt._prefix_unsupported == {0, 1}
+        # Under the router's lock: the scrape thread is still running,
+        # and GRAFTCHECK_LOCKCHECK=1 enforces the guarded-by annotation
+        # on test readers too.
+        with rt._mu:
+            assert rt._prefix_unsupported == {0, 1}
     finally:
         _stop(rt, reps)
 

@@ -1,0 +1,252 @@
+"""Blocking-call-under-lock analyzer.
+
+A lock in the serving/P2P planes is a latency fence: every thread that
+wants it waits out whatever the holder does. Holding one across a
+blocking call turns a single slow peer (or a scheduler readback) into a
+plane-wide stall — and holding it across an *unbounded* wait is a
+deadlock ingredient the lock-order analyzer cannot see. In the hot
+modules (config.hot_lock_dirs: ``serve/``, ``p2p/``, ``loadgen/``),
+any of the following lexically inside a ``with self.<lock>:`` block is
+``blocking/under-lock`` (tag ``block-ok``):
+
+- ``time.sleep(...)``
+- HTTP: ``urllib.request.urlopen``, the in-tree ``http_json`` helper
+- socket ops: ``.recv``/``.recvfrom``/``.recv_into``/``.accept``/
+  ``.sendall`` on anything, ``.send``/``.sendto``/``.connect`` on
+  receivers that name a socket
+- ``queue.get()`` with no timeout (``.get()``/``.get(True)`` on a
+  ``*_q``/``*queue*`` receiver; ``block=False`` or a timeout is fine)
+- subprocess: ``subprocess.run/call/check_call/check_output``, and
+  ``.wait()``/``.communicate()`` with no timeout (``timeout=None``
+  included — it is the documented infinite wait)
+- forced JAX syncs: ``np.asarray``/``np.array``/``jax.device_get``,
+  argless ``.block_until_ready()``/``.item()``/``.tolist()`` — a device
+  sync under a lock serializes every metrics scrape and submit behind
+  the dispatch queue
+
+Held-lock tracking is lexical, same scoping as lock-discipline: nested
+``def``/``lambda`` bodies run later on another thread and do not
+inherit the ``with``; locks are ``self.<attr>`` assigned
+``threading.Lock/RLock/Condition`` in the class (or module-level names
+assigned one).
+
+``cond.wait()`` where the receiver is itself the only held lock is the
+canonical condition-variable pattern — wait() releases the lock, so
+nothing stalls behind it; it is flagged only when a *different* lock
+stays held across the wait.
+
+Suppressions say why the wait is bounded or intentional:
+
+    with self._mu:
+        self._cv.wait(0.1)            # timeout: not flagged
+        resp = urlopen(req)           # graftcheck: block-ok <reason>
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from .core import (Config, Finding, SourceFile, dotted_name,
+                   lock_ctor, self_attr as _self_attr, walk_class_scope)
+
+_SLEEP_CALLS = {"time.sleep", "sleep"}
+_HTTP_CALLS = {"urllib.request.urlopen", "request.urlopen", "urlopen",
+               "http_json"}
+_SUBPROC_CALLS = {"subprocess.run", "subprocess.call",
+                  "subprocess.check_call", "subprocess.check_output"}
+_SYNC_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+               "jax.device_get"}
+_SYNC_METHODS = {"block_until_ready", "item", "tolist"}
+_SOCK_METHODS_ALWAYS = {"recv", "recvfrom", "recv_into", "accept",
+                        "sendall"}
+_SOCK_METHODS_NAMED = {"send", "sendto", "connect"}
+_WAIT_METHODS = {"wait", "communicate"}
+_QUEUEISH_RE = re.compile(r"(^|_)(q|queue)$|queue", re.IGNORECASE)
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    return lock_ctor(value) is not None
+
+
+def _queue_style_get(call: ast.Call) -> bool:
+    """``Queue.get``'s signature is ``(block=True, timeout=None)``: a
+    first positional bool/number reads as the block flag (``get(1)``
+    is ``block=1`` — truthy, waits); any other first positional is
+    ``dict.get(key, default)`` on a queue-NAMED mapping, not a queue
+    wait."""
+    if call.args and not (isinstance(call.args[0], ast.Constant)
+                          and isinstance(call.args[0].value,
+                                         (bool, int, float))):
+        return False
+    return True
+
+
+def _no_timeout(call: ast.Call) -> bool:
+    """True when the call has no timeout bound. ``timeout=None`` (kwarg
+    or second positional) is the documented *infinite* wait — the most
+    literal spelling of unbounded — so it still counts as no timeout;
+    ``block=False`` never waits."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None)
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return False
+    if len(call.args) >= 2:
+        t = call.args[1]
+        return isinstance(t, ast.Constant) and t.value is None
+    if (call.args and isinstance(call.args[0], ast.Constant)
+            and not call.args[0].value):
+        return False        # block=False / block=0: never waits
+    return True
+
+
+def _wait_no_timeout(call: ast.Call, meth: str) -> bool:
+    """Unbounded when ``timeout`` is absent or a literal ``None`` (the
+    documented infinite wait). ``wait(timeout=None)`` takes it first
+    positionally; ``communicate(input=None, timeout=None)`` second."""
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return (isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None)
+    idx = 1 if meth == "communicate" else 0
+    if len(call.args) > idx:
+        t = call.args[idx]
+        return isinstance(t, ast.Constant) and t.value is None
+    return True
+
+
+def _wait_on_held(call: ast.Call, held: tuple[str, ...]) -> bool:
+    """``cond.wait()`` where the receiver IS a held lock (only
+    Condition, among the lock ctors, has ``.wait``) releases that lock
+    while waiting — the canonical CV pattern stalls nobody. It still
+    blocks if some OTHER lock stays held across the wait."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "wait"):
+        return False
+    recv = dotted_name(call.func.value)
+    return recv in held and all(h == recv for h in held)
+
+
+def _classify(call: ast.Call) -> Optional[str]:
+    """A human-readable description of why this call blocks, or None."""
+    d = dotted_name(call.func)
+    base = d.rsplit(".", 1)[-1] if d else ""
+    if d in _SLEEP_CALLS or d.endswith("time.sleep"):
+        return f"`{d}(...)` sleeps"
+    if d in _HTTP_CALLS or base == "urlopen" or base == "http_json":
+        return f"`{d}(...)` performs blocking HTTP I/O"
+    if d in _SUBPROC_CALLS:
+        return f"`{d}(...)` waits on a subprocess"
+    if d in _SYNC_CALLS:
+        return f"`{d}(...)` forces a device/host sync"
+    if isinstance(call.func, ast.Attribute):
+        meth = call.func.attr
+        recv = dotted_name(call.func.value)
+        if meth in _SYNC_METHODS and not call.args and not call.keywords:
+            return f"`.{meth}()` forces a device/host sync"
+        if meth in _SOCK_METHODS_ALWAYS:
+            return f"`.{meth}(...)` is a blocking socket op"
+        if meth in _SOCK_METHODS_NAMED and "sock" in recv.lower():
+            return f"`.{meth}(...)` on `{recv}` is a blocking socket op"
+        if meth == "get" and _QUEUEISH_RE.search(
+                recv.rsplit(".", 1)[-1]) and _queue_style_get(call) \
+                and _no_timeout(call):
+            return (f"`.get()` on `{recv}` has no timeout — an empty "
+                    "queue parks this thread forever")
+        if meth in _WAIT_METHODS and _wait_no_timeout(call, meth):
+            return f"`.{meth}()` with no timeout waits unboundedly"
+    return None
+
+
+def analyze(files: list[SourceFile], config: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        norm = sf.path.replace("\\", "/")
+        if not any(d in norm for d in config.hot_lock_dirs):
+            continue
+        # Lock attributes per class + module-level lock names.
+        module_locks: set[str] = set()
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                module_locks.update(t.id for t in node.targets
+                                    if isinstance(t, ast.Name))
+        for cls in [n for n in ast.walk(sf.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            class_locks: set[str] = set()
+            for stmt in walk_class_scope(cls):
+                if isinstance(stmt, ast.Assign) \
+                        and _is_lock_ctor(stmt.value):
+                    for t in stmt.targets:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            class_locks.add(attr)
+            _scan_scope(sf, cls, class_locks, module_locks, findings)
+        # Module-level functions only: a def contained in a class is
+        # scanned by _scan_scope, and a def nested in another function
+        # is reached while visiting its container (starting it again as
+        # its own top=True root would emit every finding twice).
+        contained_ids = {id(f) for parent in ast.walk(sf.tree)
+                         if isinstance(parent, (ast.ClassDef,
+                                                ast.FunctionDef,
+                                                ast.AsyncFunctionDef))
+                         for f in ast.walk(parent)
+                         if f is not parent
+                         and isinstance(f, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))}
+        for fn in ast.walk(sf.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(fn) not in contained_ids:
+                _visit(sf, fn, (), set(), module_locks, findings,
+                       top=True)
+    return findings
+
+
+def _scan_scope(sf: SourceFile, cls: ast.ClassDef, class_locks: set[str],
+                module_locks: set[str], findings: list[Finding]) -> None:
+    for meth in cls.body:
+        if isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _visit(sf, meth, (), class_locks, module_locks, findings,
+                   top=True)
+
+
+def _visit(sf: SourceFile, node: ast.AST, held: tuple[str, ...],
+           class_locks: set[str], module_locks: set[str],
+           findings: list[Finding], top: bool = False) -> None:
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Lambda)) and not top:
+        # Runs later, on whatever thread calls it: no inherited locks.
+        for child in ast.iter_child_nodes(node):
+            _visit(sf, child, (), class_locks, module_locks, findings)
+        return
+    if isinstance(node, ast.With):
+        # Items acquire left to right: item k's context expression
+        # evaluates while items 0..k-1 are already held, so a blocking
+        # call in `with self._mu, urlopen(url):` runs under `_mu`.
+        inner = held
+        for item in node.items:
+            _visit(sf, item.context_expr, inner, class_locks,
+                   module_locks, findings)
+            attr = _self_attr(item.context_expr)
+            if attr is not None and attr in class_locks:
+                inner = inner + (f"self.{attr}",)
+            elif (isinstance(item.context_expr, ast.Name)
+                    and item.context_expr.id in module_locks):
+                inner = inner + (item.context_expr.id,)
+        for stmt in node.body:
+            _visit(sf, stmt, inner, class_locks, module_locks, findings)
+        return
+    if isinstance(node, ast.Call) and held:
+        why = _classify(node)
+        if why is not None and not _wait_on_held(node, held):
+            findings.append(Finding(
+                sf.path, node.lineno, "blocking/under-lock", "block-ok",
+                f"{why} while holding `{held[-1]}` — every thread "
+                "contending this lock stalls behind it (annotate "
+                "`# graftcheck: block-ok <reason>` if the wait is "
+                "bounded and intentional)"))
+    for child in ast.iter_child_nodes(node):
+        _visit(sf, child, held, class_locks, module_locks, findings)

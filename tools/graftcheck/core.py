@@ -61,6 +61,28 @@ class Config:
     # the serving hot path, where an unannounced sync is a latency bug.
     hot_sync_modules: tuple[str, ...] = (
         "serve/scheduler.py", "serve/engine.py", "serve/multihost.py")
+    # Directories whose locks are latency fences: a blocking call under
+    # a held lock there is a plane-wide stall (blocking analyzer).
+    hot_lock_dirs: tuple[str, ...] = ("serve/", "p2p/", "loadgen/")
+    # Metrics contract (metrics_contract analyzer): the name grammar
+    # every in-tree series follows, the docs that list series for
+    # operators, and the dirs whose string literals count as consumer
+    # references (the router's aggregation tables live under serve/).
+    metric_prefixes: tuple[str, ...] = (
+        "serve_", "kv_", "prefix_", "router_", "decode_", "inter_token_",
+        "failpoint_", "retry_", "requests_", "loop_", "prefill_")
+    metric_suffixes: tuple[str, ...] = (
+        "_total", "_seconds", "_ms", "_bytes", "_sessions", "_pages",
+        "_depth", "_slots", "_occupancy", "_requests", "_entries")
+    metrics_docs: tuple[str, ...] = ("docs/serving.md",)
+    metrics_consumer_dirs: tuple[str, ...] = ("serve/",)
+    # Source set for cross-file analyses (lock-order class models and
+    # declarations, metrics export sites): resolved against the FULL
+    # package tree even when only a few files were selected, so a
+    # partial run (`python -m tools.graftcheck serve/scheduler.py`)
+    # never false-fails on a contract whose other half lives in an
+    # unselected file.
+    package_dirs: tuple[str, ...] = ("p2p_llm_chat_tpu",)
     root: str = "."
 
 
@@ -243,7 +265,8 @@ def apply_suppressions(files: list[SourceFile],
 def run_paths(paths: Iterable[str], config: Optional[Config] = None,
               select: Optional[Iterable[str]] = None) -> list[Finding]:
     """Load files and run the selected analyzers (default: all)."""
-    from . import env_hygiene, lock_discipline, markers, trace_safety
+    from . import (blocking, env_hygiene, lock_discipline, lock_order,
+                   markers, metrics_contract, stream_close, trace_safety)
 
     config = config or Config()
     analyzers = {
@@ -251,6 +274,10 @@ def run_paths(paths: Iterable[str], config: Optional[Config] = None,
         "lock": lock_discipline.analyze,
         "env": env_hygiene.analyze,
         "markers": markers.analyze,
+        "order": lock_order.analyze,
+        "blocking": blocking.analyze,
+        "metrics": metrics_contract.analyze,
+        "streams": stream_close.analyze,
     }
     names = list(select) if select else list(analyzers)
     unknown = [n for n in names if n not in analyzers]
@@ -265,7 +292,137 @@ def run_paths(paths: Iterable[str], config: Optional[Config] = None,
     return findings
 
 
+_TREE_CACHE: dict[tuple, list[SourceFile]] = {}
+
+
+def load_package_tree(config: Config,
+                      covered: frozenset = frozenset()) -> list[SourceFile]:
+    """The full package source set (config.package_dirs under
+    config.root), cached per root — the resolution context for
+    cross-file analyzers on partial runs. Missing dirs (fixture roots)
+    yield an empty tree, which degrades those analyzers to the
+    analyzed-set-only behavior the fixture tests pin. ``covered`` paths
+    the caller already parsed short-circuit the load when they span the
+    whole tree (the CI full run — the union would discard these parses
+    anyway)."""
+    paths = [p for p in (os.path.join(config.root, d)
+                         for d in config.package_dirs)
+             if os.path.isdir(p)]
+    # Key on each file's (path, mtime, size) so a long-lived process
+    # (fixture tests rewriting sources, a future watch mode) never
+    # resolves against a stale first-load tree; listing + stat is cheap
+    # next to re-parsing.
+    sig = []
+    for p in paths:
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git",
+                                        "testdata", ".jax_cache")]
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    fp = os.path.join(dirpath, f)
+                    try:
+                        st = os.stat(fp)
+                        sig.append((fp, st.st_mtime_ns, st.st_size))
+                    except OSError:
+                        continue
+    if sig and all(os.path.normpath(fp) in covered
+                   for fp, _, _ in sig):
+        return []
+    key = (os.path.abspath(config.root), config.package_dirs,
+           tuple(sig))
+    if key not in _TREE_CACHE:
+        _TREE_CACHE.clear()     # one tree per process is plenty
+        files, _ = load_files(paths)
+        _TREE_CACHE[key] = files
+    return _TREE_CACHE[key]
+
+
+def resolution_files(files: list[SourceFile],
+                     config: Config) -> list[SourceFile]:
+    """Analyzed set ∪ package tree, analyzed objects taking precedence
+    (so node-identity side tables built during scanning stay consistent
+    with the objects other passes walk)."""
+    covered = frozenset(sf.path for sf in files)
+    union = {sf.path: sf for sf in load_package_tree(config, covered)}
+    union.update({sf.path: sf for sf in files})
+    return list(union.values())
+
+
 # -- small shared AST helpers -------------------------------------------------
+
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+REENTRANT_LOCK_CTORS = {"RLock"}
+
+
+def walk_class_scope(cls: ast.ClassDef):
+    """Like ``ast.walk(cls)`` over the class body, but without
+    descending into nested ClassDefs — a nested class's ``self.<attr>``
+    assigns belong to the nested class, not the enclosing one (it gets
+    its own model/lock set from the outer ClassDef scan)."""
+    stack = list(ast.iter_child_nodes(cls))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def walk_function_scope(fn: ast.AST):
+    """Like ``ast.walk`` over a function's body, but without descending
+    into nested defs/lambdas — those run later, on whatever thread
+    calls them, so what they acquire is not what their definer
+    acquires (the lock-discipline scoping rule)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """'x' for a bare ``self.x`` attribute node; None otherwise."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def lock_ctor(value: ast.AST) -> Optional[bool]:
+    """True/False = a threading lock constructor call (True when a
+    second same-thread acquire is legal); None = not one."""
+    if not isinstance(value, ast.Call):
+        return None
+    base = dotted_name(value.func).rsplit(".", 1)[-1]
+    if base not in LOCK_CTORS:
+        return None
+    if base in REENTRANT_LOCK_CTORS:
+        return True
+    if base == "Condition":
+        # Condition() wraps an RLock by default; Condition(lock) has
+        # the wrapped lock's reentrancy.
+        if not value.args:
+            return True
+        return bool(lock_ctor(value.args[0]))
+    if base in ("Semaphore", "BoundedSemaphore"):
+        # An initial count > 1 means a second same-thread acquire just
+        # takes another permit — not a self-deadlock. Default is 1,
+        # which does block.
+        count = None
+        if value.args:
+            count = value.args[0]
+        for kw in value.keywords:
+            if kw.arg == "value":
+                count = kw.value
+        return (isinstance(count, ast.Constant)
+                and isinstance(count.value, int) and count.value > 1)
+    return False
+
 
 def dotted_name(node: ast.AST) -> str:
     """'jax.lax.scan' for nested Attribute/Name chains; '' otherwise."""

@@ -27,15 +27,7 @@ from __future__ import annotations
 import ast
 from typing import Optional
 
-from .core import Config, Finding, SourceFile
-
-
-def _self_attr(node: ast.AST) -> Optional[str]:
-    if (isinstance(node, ast.Attribute)
-            and isinstance(node.value, ast.Name)
-            and node.value.id == "self"):
-        return node.attr
-    return None
+from .core import Config, Finding, SourceFile, self_attr as _self_attr
 
 
 class _ClassInfo:

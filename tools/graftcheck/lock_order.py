@@ -1,0 +1,387 @@
+"""Lock-order deadlock analyzer.
+
+Builds the whole-repo lock-acquisition graph and flags cycles. A node is
+one class's lock attribute (``Scheduler._depth_mu``); a directed edge
+``A -> B`` means some code path acquires ``B`` while holding ``A``
+(``with self.B:`` nested under ``with self.A:``, or a call made under
+``A`` into a method that acquires ``B``). Two threads walking a cycle's
+edges from different ends deadlock; no test schedule has to get unlucky
+for the analyzer to see it.
+
+Edge sources:
+
+- **Lexical nesting** inside one class: ``with self._mu:`` containing
+  ``with self._send_lock:``.
+- **Intra-class calls**: ``self.m()`` under a held lock contributes every
+  lock ``m`` (transitively) acquires.
+- **Cross-object calls**: ``self.tier.take(...)`` under a held lock,
+  where the attribute's class is known (``self.tier = KVTier(...)`` in
+  ``__init__``, or a constructor parameter annotated with the class
+  name), contributes ``KVTier.take``'s transitive acquisitions — the
+  router->_Replica / scheduler->kv_tier shape the per-class
+  lock-discipline grammar cannot see.
+
+Rules:
+
+- ``lock-order/cycle`` (tag ``order-ok``): a cycle in the observed ∪
+  declared graph, reported once per cycle with the witness path (each
+  edge's file:line and whether it was observed or declared).
+- ``lock-order/unknown-lock`` (tag ``order-ok``): a ``# lock-order:``
+  declaration naming a class or lock attribute the analyzed tree does
+  not define — a typo'd hierarchy would silently verify nothing (the
+  ``bad-lock`` precedent from lock-discipline).
+
+Annotation grammar (any analyzed file, own line or trailing):
+
+    # lock-order: Scheduler._depth_mu < KVTier._mu [< ...]
+
+declares the intended hierarchy; declared edges join the graph, so code
+that acquires against a declared order is a cycle finding even before a
+second thread path exists in-tree.
+
+Self-edges (``with self._mu:`` nested under itself through any call
+path) are reported unless the construction makes same-thread re-entry
+legal — ``threading.RLock``, ``Condition()`` (which wraps an RLock by
+default; ``Condition(Lock())`` does not), or a ``Semaphore`` with a
+literal initial count > 1. Re-acquiring anything else on one thread
+deadlocks instantly.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .core import (Config, Finding, SourceFile, dotted_name,
+                   lock_ctor as _lock_ctor, resolution_files,
+                   self_attr as _self_attr, walk_class_scope,
+                   walk_function_scope)
+
+_LOCK_ORDER_RE = re.compile(r"#\s*lock-order:\s*(.+)")
+
+
+@dataclass
+class _Edge:
+    src: str                  # "Class.lock"
+    dst: str
+    path: str = ""
+    line: int = 0
+    declared: bool = False
+    note: str = ""
+
+    def witness(self) -> str:
+        if self.declared:
+            return (f"{self.src} < {self.dst} declared at "
+                    f"{self.path}:{self.line}")
+        via = f" ({self.note})" if self.note else ""
+        return (f"{self.src} -> {self.dst} at {self.path}:{self.line}"
+                f"{via}")
+
+
+@dataclass
+class _ClassModel:
+    sf: SourceFile
+    node: ast.ClassDef
+    name: str
+    locks: dict[str, bool] = field(default_factory=dict)  # attr -> reentrant
+    methods: dict[str, ast.FunctionDef] = field(default_factory=dict)
+    # attr name -> class name (for cross-object call resolution)
+    attr_types: dict[str, str] = field(default_factory=dict)
+    # method -> set of "Class.lock" the method (transitively) acquires
+    acquires: dict[str, set[str]] = field(default_factory=dict)
+
+
+def _build_class_models(files: list[SourceFile]) -> dict[str, _ClassModel]:
+    """Every class in the tree, keyed by bare name (collisions keep the
+    first definition — fine for this repo's flat namespace)."""
+    models: dict[str, _ClassModel] = {}
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if node.name in models:
+                continue
+            m = _ClassModel(sf=sf, node=node, name=node.name)
+            for child in node.body:
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    m.methods[child.name] = child
+            for stmt in walk_class_scope(node):
+                if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                value = stmt.value
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    reent = _lock_ctor(value) if value is not None else None
+                    if reent is not None:
+                        m.locks[attr] = reent
+            models[node.name] = m
+    # Second pass needs the class table complete: attribute types from
+    # ctor calls (self.x = KVTier(...)) and annotated params
+    # (def __init__(self, tier: KVTier)) of ANY method.
+    for m in models.values():
+        for meth in m.methods.values():
+            ann_types: dict[str, str] = {}
+            for a in (meth.args.posonlyargs + meth.args.args
+                      + meth.args.kwonlyargs):
+                if a.annotation is None:
+                    continue
+                try:
+                    ann = ast.unparse(a.annotation)
+                except Exception:   # pragma: no cover — unparse is total
+                    continue
+                base = re.sub(r"^Optional\[(.*)\]$", r"\1", ann.strip())
+                base = base.strip('"\'').rsplit(".", 1)[-1]
+                if base in models:
+                    ann_types[a.arg] = base
+            for stmt in ast.walk(meth):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for t in stmt.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    v = stmt.value
+                    if isinstance(v, ast.Call):
+                        cname = dotted_name(v.func).rsplit(".", 1)[-1]
+                        if cname in models:
+                            m.attr_types[attr] = cname
+                    elif isinstance(v, ast.Name) and v.id in ann_types:
+                        m.attr_types[attr] = ann_types[v.id]
+    return models
+
+
+def _resolve_callee(models: dict[str, _ClassModel], m: _ClassModel,
+                    call: ast.Call) -> Optional[tuple[str, str]]:
+    """(class, method) for ``self.m()`` and typed cross-object
+    ``self.attr.m()`` calls; None when the target is unknown."""
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    recv = call.func.value
+    if isinstance(recv, ast.Name) and recv.id == "self":
+        if call.func.attr in m.methods:
+            return (m.name, call.func.attr)
+        return None
+    rattr = _self_attr(recv)
+    if rattr is not None and rattr in m.attr_types:
+        tname = m.attr_types[rattr]
+        if call.func.attr in models[tname].methods:
+            return (tname, call.func.attr)
+    return None
+
+
+def _compute_acquires(models: dict[str, _ClassModel]) -> None:
+    """Fixpoint: transitive "Class.lock" set each method may acquire,
+    through self-calls and typed cross-object attribute calls. Nested
+    defs/lambdas are excluded — they run later on another thread, so a
+    method that merely DEFINES a closure does not acquire what the
+    closure acquires (same scoping as _collect_edges)."""
+
+    def direct(m: _ClassModel, meth: ast.FunctionDef):
+        acq: set[str] = set()
+        calls: list[tuple[str, str]] = []   # (class, method) resolved
+        for node in walk_function_scope(meth):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and attr in m.locks:
+                        acq.add(f"{m.name}.{attr}")
+            elif isinstance(node, ast.Call):
+                callee = _resolve_callee(models, m, node)
+                if callee is not None:
+                    calls.append(callee)
+        return acq, calls
+
+    info: dict[tuple[str, str], tuple[set[str], list[tuple[str, str]]]] = {}
+    for m in models.values():
+        for name, meth in m.methods.items():
+            info[(m.name, name)] = direct(m, meth)
+            m.acquires[name] = set(info[(m.name, name)][0])
+    changed = True
+    while changed:
+        changed = False
+        for (cname, mname), (_acq, calls) in info.items():
+            cur = models[cname].acquires[mname]
+            before = len(cur)
+            for tc, tm in calls:
+                cur |= models[tc].acquires.get(tm, set())
+            if len(cur) != before:
+                changed = True
+
+
+def _collect_edges(models: dict[str, _ClassModel]) -> list[_Edge]:
+    """Walk every method tracking the lexically-held lock set; emit an
+    edge per (held, acquired) pair. Nested defs/lambdas run later on an
+    arbitrary thread and do not inherit held locks (the lock-discipline
+    rule), so they are visited with an empty held set."""
+    edges: list[_Edge] = []
+    seen: set[tuple[str, str]] = set()
+
+    def note_edge(src: str, dst: str, sf: SourceFile, line: int,
+                  note: str) -> None:
+        if (src, dst) in seen:
+            return
+        seen.add((src, dst))
+        edges.append(_Edge(src=src, dst=dst, path=sf.path, line=line,
+                           note=note))
+
+    def visit(m: _ClassModel, node: ast.AST,
+              held: tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            for child in ast.iter_child_nodes(node):
+                visit(m, child, ())
+            return
+        if isinstance(node, ast.With):
+            # Items acquire left to right, so item k's lock is taken
+            # while items 0..k-1 are already held — `with a, b:` is the
+            # same a->b edge as the nested form, and b's context
+            # expression evaluates under a.
+            inner = held
+            for item in node.items:
+                visit(m, item.context_expr, inner)
+                attr = _self_attr(item.context_expr)
+                if attr is not None and attr in m.locks:
+                    lock = f"{m.name}.{attr}"
+                    for h in inner:
+                        note_edge(h, lock, m.sf, item.context_expr.lineno,
+                                  "nested with")
+                    inner = inner + (lock,)
+            for stmt in node.body:
+                visit(m, stmt, inner)
+            return
+        if isinstance(node, ast.Call) and held:
+            callee = _resolve_callee(models, m, node)
+            if callee is not None:
+                tc, tm = callee
+                for lock in models[tc].acquires.get(tm, set()):
+                    for h in held:
+                        note_edge(h, lock, m.sf, node.lineno,
+                                  f"call {tc}.{tm}()")
+        for child in ast.iter_child_nodes(node):
+            visit(m, child, held)
+
+    for m in models.values():
+        for meth in m.methods.values():
+            for child in ast.iter_child_nodes(meth):
+                visit(m, child, ())
+    return edges
+
+
+def parse_declarations(files: list[SourceFile]) -> list[_Edge]:
+    """``# lock-order: A.x < B.y [< C.z]`` comments anywhere in the
+    analyzed tree."""
+    out: list[_Edge] = []
+    for sf in files:
+        for line, comment in sf.comments.items():
+            mm = _LOCK_ORDER_RE.search(comment)
+            if not mm:
+                continue
+            names = [n.strip() for n in mm.group(1).split("<")]
+            for a, b in zip(names, names[1:]):
+                out.append(_Edge(src=a, dst=b, path=sf.path, line=line,
+                                 declared=True))
+    return out
+
+
+def _find_cycles(edges: list[_Edge]) -> list[list[_Edge]]:
+    """Every elementary cycle, canonicalized so each is reported once.
+    The lock graph is tiny (tens of nodes), so a bounded DFS per node is
+    plenty."""
+    adj: dict[str, list[_Edge]] = {}
+    for e in edges:
+        adj.setdefault(e.src, []).append(e)
+    cycles: list[list[_Edge]] = []
+    seen_keys: set[tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: list[_Edge],
+            on_path: set[str]) -> None:
+        for e in adj.get(node, []):
+            if e.dst == start:
+                cyc = path + [e]
+                nodes = [c.src for c in cyc]
+                rot = min(range(len(nodes)), key=lambda i: nodes[i])
+                key = tuple(nodes[rot:] + nodes[:rot])
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(cyc)
+            elif e.dst not in on_path and e.dst > start:
+                # Only expand nodes > start: each cycle is found from
+                # its smallest node exactly once.
+                dfs(start, e.dst, path + [e], on_path | {e.dst})
+
+    for e in edges:
+        if e.src == e.dst:      # self-edge: its own cycle
+            key = (e.src,)
+            if key not in seen_keys:
+                seen_keys.add(key)
+                cycles.append([e])
+    for start in sorted(adj):
+        dfs(start, start, [], {start})
+    return cycles
+
+
+def analyze(files: list[SourceFile], config: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    # The lock graph is whole-repo by nature (a cycle's two halves live
+    # in two files); build it from the full package tree so a partial
+    # run still resolves cross-file classes and declarations — but
+    # report only findings anchored in the files actually selected
+    # (the CI gate analyzes everything, so nothing hides from it).
+    analyzed = {sf.path for sf in files}
+    all_files = resolution_files(files, config)
+    models = _build_class_models(all_files)
+    _compute_acquires(models)
+    edges = _collect_edges(models)
+    declared = parse_declarations(all_files)
+
+    # Declaration typo check: the named class must exist and the named
+    # attribute must be one of its locks.
+    valid_decls: list[_Edge] = []
+    for d in declared:
+        bad = None
+        for name in (d.src, d.dst):
+            cls, _, attr = name.partition(".")
+            if cls not in models:
+                bad = f"no class `{cls}` in the analyzed tree"
+            elif attr not in models[cls].locks:
+                bad = (f"`{cls}` has no lock attribute `{attr}` "
+                       "(locks are attrs assigned threading.Lock/RLock/"
+                       "Condition)")
+            if bad:
+                findings.append(Finding(
+                    d.path, d.line, "lock-order/unknown-lock", "order-ok",
+                    f"lock-order declaration names `{name}` but {bad}"))
+                break
+        if bad is None:
+            valid_decls.append(d)
+
+    for cyc in _find_cycles(edges + valid_decls):
+        if len(cyc) == 1 and cyc[0].src == cyc[0].dst:
+            e = cyc[0]
+            cls, _, attr = e.src.partition(".")
+            if models.get(cls) and models[cls].locks.get(attr):
+                continue        # RLock: reentrant self-acquire is fine
+            findings.append(Finding(
+                e.path, e.line, "lock-order/cycle", "order-ok",
+                f"`{e.src}` is re-acquired while already held "
+                f"({e.witness()}) — a non-reentrant Lock self-deadlocks"))
+            continue
+        # Anchor at an observed edge in the analyzed set when one
+        # exists, so a partial run that covers any leg of the cycle
+        # still reports it.
+        first = next(
+            (e for e in cyc if not e.declared and e.path in analyzed),
+            next((e for e in cyc if not e.declared), cyc[0]))
+        path_s = " ; ".join(e.witness() for e in cyc)
+        findings.append(Finding(
+            first.path, first.line, "lock-order/cycle", "order-ok",
+            f"lock-order cycle: {path_s} — two threads taking these "
+            "locks from different ends deadlock"))
+    return [f for f in findings if f.path in analyzed]

@@ -1,0 +1,339 @@
+"""Runtime guarded-by enforcement — annotations become assertions.
+
+The static lock-discipline analyzer proves every ``self.X`` access in
+the *owning class* sits under ``with self.<lock>:``. What it cannot
+prove: that the lock annotation is **true when threads actually run** —
+cross-object reads (the scheduler reading ``tier.host_bytes``), code
+reached through ``getattr``, or an annotation that quietly rotted when
+a refactor split a class. This module closes that gap TSan-style: under
+``GRAFTCHECK_LOCKCHECK=1`` (tests/conftest.py), every class carrying
+``# guarded-by:`` annotations is rewritten so each annotated attribute
+access asserts the named lock is held **by the current thread**, and
+each named lock attribute is wrapped in an owner-tracking proxy.
+
+Mechanics (no import hooks, no AST rewriting of the module under test):
+
+- ``install()`` parses the annotated source tree with the same
+  SourceFile/annotation machinery the static analyzer uses, imports
+  each module holding a guarded class, and replaces the annotated
+  attributes with data descriptors. Data descriptors shadow the
+  instance ``__dict__`` for both get and set, so every access funnels
+  through the check; the real value lives under a mangled key.
+- The lock attribute itself becomes a slot that wraps whatever
+  ``threading.Lock``/``RLock``/``Condition`` the constructor assigns in
+  an :class:`OwnedLock` proxy recording the owning thread ident on
+  ``__enter__``/``acquire`` — ``Lock.locked()`` alone can't answer
+  "held by *me*".
+- ``__init__`` bodies are exempt (construction happens-before any
+  thread start — the same rule the static analyzer applies), tracked
+  with a re-entrancy-safe depth counter so a subclass chaining to
+  ``super().__init__`` stays exempt throughout.
+- Static-analyzer suppressions stay honored at runtime: on violation
+  the access site's file:line is looked up against that file's
+  ``# graftcheck: lock-ok ...`` / ``lockcheck-ok`` suppressions
+  (including function-level ones on the enclosing ``def``) before
+  raising — the scheduler's advisory ``metrics_snapshot`` reads stay
+  legal in both worlds from the one annotation.
+
+A violation raises :class:`LockcheckError` (an AssertionError, so
+pytest reports it as a failure at the exact access site). This runs in
+a dedicated CI leg (ci.sh full) over the threaded test files — the
+annotations get exercised by real concurrent schedules, not just read.
+
+Scope: class-level attributes only, matching the static grammar —
+module-level globals carrying the comment stay documentation in both
+worlds (docs/static-analysis.md §lockcheck).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Optional
+
+_VAL_PREFIX = "_lockcheck_val_"
+_LOCK_PREFIX = "_lockcheck_lock_"
+_INIT_DEPTH = "_lockcheck_init_depth"
+_SUPPRESS_TAGS = ("lock-ok", "lockcheck-ok")
+
+
+class LockcheckError(AssertionError):
+    """An annotated attribute was touched without its lock held."""
+
+
+class OwnedLock:
+    """Owner-tracking proxy over a Lock/RLock/Condition: records the
+    holder's thread ident so guarded access can assert *this* thread
+    holds it. Supports the context-manager and acquire/release surface
+    the annotated classes use.
+
+    Ownership is a PER-THREAD depth count, not one shared owner/depth
+    pair: with a shared pair, thread B entering and exiting while
+    thread A sits in ``Condition.wait()`` (which releases the raw
+    primitive *past* the proxy) would leave A's legitimate guarded
+    access reading stale state — a false LockcheckError for A and a
+    free pass for B. Per-thread counts mean a thread parked in
+    ``wait()`` still reads as the holder, which is the right guarded-by
+    semantics: it cannot touch guarded state until wait() re-acquires
+    and returns, and whoever holds the primitive meanwhile has their
+    own count."""
+
+    def __init__(self, raw) -> None:
+        self._raw = raw
+        self._holders: dict[int, int] = {}   # thread ident -> depth
+
+    def acquire(self, *a, **kw) -> bool:
+        got = self._raw.acquire(*a, **kw)
+        if got:
+            ident = threading.get_ident()
+            self._holders[ident] = self._holders.get(ident, 0) + 1
+        return got
+
+    def release(self) -> None:
+        ident = threading.get_ident()
+        depth = self._holders.get(ident, 0) - 1
+        if depth <= 0:
+            self._holders.pop(ident, None)
+        else:
+            self._holders[ident] = depth
+        self._raw.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        try:
+            return bool(self._raw.locked())
+        except AttributeError:
+            # threading.Condition exposes no locked(); the proxy's own
+            # holder table answers the held-by-anyone question.
+            return bool(self._holders)
+
+    def held_by_current(self) -> bool:
+        return self._holders.get(threading.get_ident(), 0) > 0
+
+    # Condition wait/notify (and any other surface) pass through to the
+    # raw primitive; wait()'s internal release/re-acquire never touches
+    # the proxy, which the per-thread counts above are designed around.
+    def __getattr__(self, name):
+        return getattr(self._raw, name)
+
+
+# -- suppression lookup at runtime -------------------------------------------
+
+_sf_cache: dict[str, Optional[object]] = {}
+
+
+def _source_for(path: str):
+    sf = _sf_cache.get(path)
+    if path not in _sf_cache:
+        sf = None
+        try:
+            from .core import SourceFile
+            with open(path, encoding="utf-8") as fh:
+                sf = SourceFile(path, fh.read())
+        except (OSError, SyntaxError):
+            sf = None
+        _sf_cache[path] = sf
+    return _sf_cache[path]
+
+
+def _suppressed_at(path: str, line: int) -> bool:
+    sf = _source_for(path)
+    if sf is None:
+        return False
+    return any(sf.suppressed(line, tag) for tag in _SUPPRESS_TAGS)
+
+
+def _caller_site() -> tuple[str, int]:
+    """First frame outside this module: the attribute access site."""
+    f = sys._getframe(1)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:       # pragma: no cover — there is always a caller
+        return "<unknown>", 0
+    return f.f_code.co_filename, f.f_lineno
+
+
+# -- descriptors --------------------------------------------------------------
+
+class _LockSlot:
+    """Replaces the lock attribute: wraps assigned locks in OwnedLock."""
+
+    def __init__(self, name: str) -> None:
+        self._name = name
+        self._key = _LOCK_PREFIX + name
+
+    def __set__(self, obj, value) -> None:
+        if value is not None and not isinstance(value, OwnedLock):
+            value = OwnedLock(value)
+        obj.__dict__[self._key] = value
+
+    def __get__(self, obj, owner=None):
+        if obj is None:
+            return self
+        try:
+            return obj.__dict__[self._key]
+        except KeyError:
+            raise AttributeError(self._name) from None
+
+
+class _GuardedAttr:
+    """Replaces a guarded attribute: every get/set asserts the lock."""
+
+    def __init__(self, cls_name: str, attr: str, lock: str) -> None:
+        self._cls = cls_name
+        self._attr = attr
+        self._lock = lock
+        self._key = _VAL_PREFIX + attr
+
+    def _check(self, obj, mode: str) -> None:
+        if obj.__dict__.get(_INIT_DEPTH, 0) > 0:
+            return              # constructing: happens-before thread start
+        wrapper = obj.__dict__.get(_LOCK_PREFIX + self._lock)
+        if wrapper is None:
+            return              # lock not built (partial ctor/teardown)
+        if wrapper.held_by_current():
+            return
+        path, line = _caller_site()
+        if _suppressed_at(path, line):
+            return
+        held_note = ("held by another thread" if wrapper.locked()
+                     else "not held at all")
+        raise LockcheckError(
+            f"{mode} of {self._cls}.{self._attr} (guarded-by "
+            f"{self._lock}) at {path}:{line} without holding the lock "
+            f"on this thread ({held_note}) — the guarded-by annotation "
+            "is enforced because GRAFTCHECK_LOCKCHECK=1")
+
+    def __get__(self, obj, owner=None):
+        if obj is None:
+            return self
+        self._check(obj, "read")
+        try:
+            return obj.__dict__[self._key]
+        except KeyError:
+            raise AttributeError(self._attr) from None
+
+    def __set__(self, obj, value) -> None:
+        self._check(obj, "write")
+        obj.__dict__[self._key] = value
+
+    def __delete__(self, obj) -> None:
+        self._check(obj, "delete")
+        try:
+            del obj.__dict__[self._key]
+        except KeyError:
+            raise AttributeError(self._attr) from None
+
+
+def _wrap_init(cls) -> None:
+    orig = cls.__init__
+
+    if getattr(orig, "_lockcheck_wrapped", False):
+        return
+
+    def __init__(self, *a, **kw):        # noqa: N807 — deliberate wrap
+        self.__dict__[_INIT_DEPTH] = self.__dict__.get(_INIT_DEPTH, 0) + 1
+        try:
+            orig(self, *a, **kw)
+        finally:
+            self.__dict__[_INIT_DEPTH] -= 1
+
+    __init__._lockcheck_wrapped = True       # type: ignore[attr-defined]
+    cls.__init__ = __init__
+
+
+# -- instrumentation ----------------------------------------------------------
+
+def instrument_class(cls, guarded: dict[str, str]) -> list[str]:
+    """Install the descriptors for one class. Returns what was armed."""
+    armed: list[str] = []
+    for lock in sorted(set(guarded.values())):
+        setattr(cls, lock, _LockSlot(lock))
+    for attr, lock in sorted(guarded.items()):
+        setattr(cls, attr, _GuardedAttr(cls.__name__, attr, lock))
+        armed.append(f"{cls.__name__}.{attr}<-{lock}")
+    _wrap_init(cls)
+    return armed
+
+
+def _guarded_map(sf) -> dict[str, dict[str, str]]:
+    """{class name: {attr: lock}} from one parsed source file, via the
+    same _ClassInfo scan the static analyzer runs."""
+    import ast
+
+    from .lock_discipline import _ClassInfo
+    out: dict[str, dict[str, str]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            info = _ClassInfo(sf, node)
+            if info.guarded:
+                out[node.name] = dict(info.guarded)
+    return out
+
+
+def instrument_module(module, source_path: str) -> list[str]:
+    """Instrument every guarded-by-annotated class defined in
+    ``module`` (classes merely imported into it are skipped — their
+    defining module instruments them)."""
+    sf = _source_for(source_path)
+    if sf is None:
+        return []
+    armed: list[str] = []
+    for cls_name, guarded in _guarded_map(sf).items():
+        cls = getattr(module, cls_name, None)
+        if cls is None or getattr(cls, "__module__", "") != module.__name__:
+            continue
+        armed.extend(instrument_class(cls, guarded))
+    return armed
+
+
+# Packages whose guarded annotations get runtime teeth: the threaded
+# serving + chat planes (the ISSUE-10 surface).
+_DEFAULT_DIRS = ("p2p_llm_chat_tpu/serve", "p2p_llm_chat_tpu/p2p",
+                 "p2p_llm_chat_tpu/loadgen", "p2p_llm_chat_tpu/utils")
+
+
+def install(root: Optional[str] = None,
+            dirs: tuple[str, ...] = _DEFAULT_DIRS) -> list[str]:
+    """Parse the annotated tree, import each module that defines a
+    guarded class, and arm the descriptors. Returns every armed
+    ``Class.attr<-lock``; call once, before instances are built (the
+    conftest hook runs at collection start, before any engine/test
+    constructs a scheduler or router)."""
+    import importlib
+
+    root = root or os.getcwd()
+    armed: list[str] = []
+    for d in dirs:
+        full = os.path.join(root, d)
+        if not os.path.isdir(full):
+            continue
+        for fname in sorted(os.listdir(full)):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(full, fname)
+            sf = _source_for(path)
+            if sf is None or not _guarded_map(sf):
+                continue
+            rel = os.path.relpath(path, root)
+            mod_name = rel[:-3].replace(os.sep, ".")
+            try:
+                module = importlib.import_module(mod_name)
+            except Exception as e:  # noqa: BLE001 — optional deps gate
+                print(f"lockcheck: skipping {mod_name} ({e})",
+                      file=sys.stderr)
+                continue
+            armed.extend(instrument_module(module, path))
+    if armed:
+        print(f"lockcheck: armed {len(armed)} guarded attribute(s) "
+              f"across {len(dirs)} package dir(s)", file=sys.stderr)
+    return armed

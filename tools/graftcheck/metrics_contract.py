@@ -1,0 +1,310 @@
+"""Metrics-contract analyzer.
+
+A series name is a wire contract: the replica router's aggregation
+tables sum it, tests grep it, docs/serving.md tells operators to alert
+on it. Nothing ties those consumers to a registration site — which is
+how ``prefix_hits_total`` was tracked-but-unexported for five rounds
+before PR 8 noticed. This analyzer closes the loop:
+
+- **Exports** — where a series is actually emitted:
+  ``registry.counter("x")``/``.gauge("x")``/``.histogram("x")`` calls
+  with a literal name; string keys of the dicts built inside
+  ``metrics_snapshot`` methods (the scheduler's exposition channel),
+  including the f-string keys of labeled series (the base name before
+  ``{``); and hand-rendered exposition literals (``# TYPE x ...`` lines
+  and f-strings whose constant head is ``x{`` or ``x `` followed by an
+  interpolated value). A ``histogram("x")`` also exports ``x_sum`` and
+  ``x_count``.
+- **Consumers** — where a series name is *referenced*: a metric-shaped
+  string literal in the serving plane or the test suite appearing in a
+  consumer context — a list/tuple/set display (the router's
+  ``_ADDITIVE_GAUGES`` table), a comparison (``assert "x" in text``), a
+  subscript read (``snap["x"]``), or the read-style calls
+  (``total("x")``, ``.count("x")``, ``.startswith("x")``,
+  ``.get("x")``) — plus backticked names inside the docs' marked
+  metrics-catalog regions (``<!-- metrics-contract:begin/end -->`` in
+  config.metrics_docs; brace shorthand like ``kv_{parked,waked}_total``
+  expands, label suffixes strip; a prefix match alone suffices there,
+  since the region is a curated catalog — the suffix grammar below
+  only filters code literals).
+
+"Metric-shaped" = lowercase identifier carrying one of
+config.metric_prefixes AND ending in one of config.metric_suffixes —
+the grammar every in-tree series follows. Names outside it (bench row
+keys, loadgen ledger keys, config gauges) are out of scope by
+construction.
+
+Rules (tag ``metrics-ok``):
+
+- ``metrics-contract/unexported``: a consumed name no export site
+  emits — the consumer reads a series that will never exist.
+- ``metrics-contract/duplicate-export``: one unlabeled name emitted by
+  more than one registration site — double emission is malformed
+  exposition, and two sites silently disagreeing about semantics is
+  how counters drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import (Config, Finding, SourceFile, dotted_name,
+                   resolution_files, str_const)
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_REG_CTORS = {"Counter", "Gauge", "Histogram"}
+_READ_CALLS = {"total"}
+_READ_METHODS = {"count", "startswith", "endswith", "get"}
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+_TYPE_LINE_RE = re.compile(r"#\s*TYPE\s+([a-z][a-z0-9_]*)\s")
+_EXPO_HEAD_RE = re.compile(r"^([a-z][a-z0-9_]*)[ {]")
+_DOC_TOKEN_RE = re.compile(r"`([a-z][a-z0-9_{},]*)`")
+_DOC_BEGIN = "<!-- metrics-contract:begin -->"
+_DOC_END = "<!-- metrics-contract:end -->"
+
+
+def _metric_shaped(name: str, config: Config) -> bool:
+    return (bool(_NAME_RE.match(name))
+            and name.startswith(config.metric_prefixes)
+            and name.endswith(config.metric_suffixes))
+
+
+def _expand_doc_token(tok: str) -> list[str]:
+    """``kv_{parked,waked}_total`` -> both names; ``x{label=...}`` ->
+    ``x``; tokens with unexpandable shorthand are skipped."""
+    m = re.match(r"^([a-z0-9_]*)\{([a-z0-9_,]+)\}([a-z0-9_]*)$", tok)
+    if m and "," in m.group(2):
+        return [m.group(1) + alt + m.group(3)
+                for alt in m.group(2).split(",")]
+    if "{" in tok:
+        head = tok.split("{", 1)[0]
+        return [head] if head else []
+    return [tok]
+
+
+class _Sites:
+    def __init__(self) -> None:
+        # name -> [(path, line, labeled)]
+        self.exports: dict[str, list[tuple[str, int, bool]]] = {}
+        self.consumers: dict[str, list[tuple[str, int]]] = {}
+        self.export_node_ids: set[int] = set()
+
+    def export(self, name: str, path: str, line: int,
+               labeled: bool = False) -> None:
+        self.exports.setdefault(name, []).append((path, line, labeled))
+
+    def consume(self, name: str, path: str, line: int) -> None:
+        self.consumers.setdefault(name, []).append((path, line))
+
+
+def _scan_exports(sf: SourceFile, sites: _Sites, config: Config) -> None:
+    in_snapshot: set[int] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "metrics_snapshot":
+            for child in ast.walk(node):
+                in_snapshot.add(id(child))
+    for node in ast.walk(sf.tree):
+        # registry.counter("x") / .gauge / .histogram, and the direct
+        # Counter("x")/Gauge("x")/Histogram("x") constructor form.
+        reg = None
+        if isinstance(node, ast.Call) and node.args:
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _REG_METHODS:
+                reg = node.func.attr
+            else:
+                ctor = dotted_name(node.func).rsplit(".", 1)[-1]
+                if ctor in _REG_CTORS:
+                    reg = ctor.lower()
+        if reg is not None:
+            name = str_const(node.args[0])
+            if name and _NAME_RE.match(name):
+                # Direct ctor form (Histogram("x") held privately, its
+                # percentiles re-exported under derived snapshot keys)
+                # satisfies consumers but is not an exposition site —
+                # only registry registrations render verbatim, so only
+                # those count toward the one-site rule.
+                ctor_form = not isinstance(node.func, ast.Attribute)
+                sites.export(name, sf.path, node.lineno,
+                             labeled=ctor_form)
+                sites.export_node_ids.add(id(node.args[0]))
+                if reg == "histogram" and not ctor_form:
+                    for suffix in ("_sum", "_count"):
+                        sites.export(name + suffix, sf.path, node.lineno,
+                                     labeled=True)
+        # metrics_snapshot dict keys: {"x": v} and out["x"] = v,
+        # including f-string keys for labeled series.
+        if id(node) in in_snapshot:
+            keys: list[ast.AST] = []
+            if isinstance(node, ast.Dict):
+                keys = [k for k in node.keys if k is not None]
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Store)):
+                keys = [node.slice]
+            for k in keys:
+                name = str_const(k)
+                labeled = False
+                if name is None and isinstance(k, ast.JoinedStr) \
+                        and k.values:
+                    head = str_const(k.values[0])
+                    if head and "{" in head:
+                        name, labeled = head.split("{", 1)[0], True
+                if name and _NAME_RE.match(name):
+                    sites.export(name, sf.path, k.lineno, labeled=labeled)
+                    sites.export_node_ids.add(id(k))
+        # Hand-rendered exposition: "# TYPE x ..." literals and
+        # f-strings whose constant head is "x{" / "x " + interpolation.
+        const = None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            const = node.value
+        elif isinstance(node, ast.JoinedStr) and node.values \
+                and len(node.values) > 1:
+            const = str_const(node.values[0])
+        if const:
+            m = _TYPE_LINE_RE.search(const)
+            if m:
+                sites.export(m.group(1), sf.path, node.lineno,
+                             labeled=True)
+                sites.export_node_ids.add(id(node))
+            elif isinstance(node, ast.JoinedStr):
+                m = _EXPO_HEAD_RE.match(const)
+                if m and _metric_shaped(m.group(1), config):
+                    sites.export(m.group(1), sf.path, node.lineno,
+                                 labeled="{" in const)
+                    sites.export_node_ids.add(id(node))
+
+
+def _scan_consumers(sf: SourceFile, sites: _Sites,
+                    config: Config) -> None:
+    """Metric-shaped literals in consumer contexts only: display
+    elements (aggregation tables), comparison operands (test greps),
+    subscript reads, and read-style call args. Dict keys / kwarg
+    defaults / row keys never count — those are JSON shapes, not
+    scrapes."""
+    consumers: list[ast.AST] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+            consumers.extend(node.elts)
+        elif isinstance(node, ast.Compare):
+            consumers.append(node.left)
+            consumers.extend(node.comparators)
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            consumers.append(node.slice)
+        elif isinstance(node, ast.Call) and node.args:
+            fname = ""
+            if isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+                if fname in _READ_METHODS:
+                    consumers.append(node.args[0])
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in _READ_CALLS:
+                consumers.append(node.args[0])
+    for node in consumers:
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            continue
+        if id(node) in sites.export_node_ids:
+            continue
+        if _metric_shaped(node.value, config):
+            sites.consume(node.value, sf.path, node.lineno)
+
+
+def analyze(files: list[SourceFile], config: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    sites = _Sites()
+    # Export sites are resolved against the FULL package tree (the
+    # contract is whole-repo: the docs catalog below is parsed on every
+    # run, and a partial run — `graftcheck p2p/udp.py` — must not
+    # report every documented series as unexported just because its
+    # registration site wasn't in the selected paths). Consumers come
+    # from the analyzed set only.
+    consumer_files: list[SourceFile] = []
+    for sf in resolution_files(files, config):
+        norm = sf.path.replace("\\", "/")
+        is_test = "tests/" in norm or os.path.basename(norm).startswith(
+            "test_")
+        if not is_test:
+            _scan_exports(sf, sites, config)
+    for sf in files:
+        norm = sf.path.replace("\\", "/")
+        is_test = "tests/" in norm or os.path.basename(norm).startswith(
+            "test_")
+        if is_test or any(d in norm for d in config.metrics_consumer_dirs):
+            consumer_files.append(sf)
+    for sf in consumer_files:
+        _scan_consumers(sf, sites, config)
+
+    # Docs: backticked metric names inside the marked catalog regions
+    # are operator contracts too. Only marked regions count — prose
+    # elsewhere mentions bench row keys and parameters that share the
+    # suffix grammar.
+    for rel in config.metrics_docs:
+        path = os.path.join(config.root, rel)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc_lines = fh.readlines()
+        except OSError:
+            continue
+        in_catalog = False
+        for i, line in enumerate(doc_lines, 1):
+            if _DOC_BEGIN in line:
+                in_catalog = True
+                continue
+            if _DOC_END in line:
+                in_catalog = False
+                continue
+            if not in_catalog:
+                continue
+            for tok in _DOC_TOKEN_RE.findall(line):
+                for name in _expand_doc_token(tok):
+                    # The marked region is a curated series catalog, so
+                    # a prefix match alone makes a token contract — the
+                    # suffix grammar only filters CODE literals, where
+                    # row keys share it. Requiring the suffix here let
+                    # `serve_draining` / `decode_fused_mean_k` rows sit
+                    # listed-but-unchecked, falsifying the docs' claim
+                    # that deleting a listed series' export fails CI.
+                    if _NAME_RE.match(name) \
+                            and name.startswith(config.metric_prefixes):
+                        sites.consume(name, rel, i)
+
+    exported = set(sites.exports)
+    reported: set[str] = set()
+    for name, refs in sorted(sites.consumers.items()):
+        if name in exported or name in reported:
+            continue
+        reported.add(name)
+        path, line = refs[0]
+        findings.append(Finding(
+            path, line, "metrics-contract/unexported", "metrics-ok",
+            f"series `{name}` is consumed here ({len(refs)} reference"
+            f"{'s' if len(refs) != 1 else ''}) but no registration site "
+            "exports it — the consumer reads a series that never "
+            "exists"))
+    analyzed = {sf.path for sf in files}
+    for name, exps in sorted(sites.exports.items()):
+        unlabeled = [(p, ln) for p, ln, labeled in exps if not labeled]
+        distinct = sorted(set(unlabeled))
+        if len(distinct) > 1:
+            # Exports are scanned tree-wide, so on a partial run a
+            # site can sit in an unanalyzed file — whose metrics-ok
+            # suppressions we never loaded. Anchor at an analyzed-set
+            # site so the finding stays suppressible at its own file;
+            # a duplicate wholly outside the selected paths belongs to
+            # the full run (the CI gate analyzes everything).
+            anchored = [s for s in distinct if s[0] in analyzed]
+            if not anchored:
+                continue
+            anchor = anchored[0]
+            where = ", ".join(f"{p}:{ln}" for p, ln in distinct
+                              if (p, ln) != anchor)
+            findings.append(Finding(
+                anchor[0], anchor[1],
+                "metrics-contract/duplicate-export", "metrics-ok",
+                f"series `{name}` is exported unlabeled at more than one "
+                f"site (also {where}) — exactly one registration site "
+                "per series"))
+    return findings

@@ -1,0 +1,152 @@
+"""Stream-close discipline analyzer.
+
+Every NDJSON/chunked HTTP response in this stack is backed by a Python
+generator handed to ``Response(stream=...)``. When the client
+disconnects mid-stream, the HTTP writer calls ``generator.close()``
+(utils/http.py) — which raises ``GeneratorExit`` *at the current
+yield*. Cleanup that is not in a ``finally`` (or an enclosing ``with``)
+below that yield simply never runs: inflight gauges never settle,
+upstream connections leak until GC. That is exactly the round-12 bug
+class (the UI inflight gauge that only settled on clean completion).
+
+Rule ``stream-close/no-finally`` (tag ``stream-ok``): a generator
+function passed to ``Response(stream=gen(...))`` must have every
+``yield`` lexically inside a ``try:``/``finally:`` or a ``with`` block,
+so GeneratorExit runs its cleanup. Generators with nothing to clean up
+(a single constant yield) suppress with a reason.
+
+The check resolves ``stream=<name>(...)`` calls against function
+definitions in the same file (nested handler closures included) and
+``stream=self.<m>(...)`` against the enclosing class's methods — the
+shapes every in-tree handler uses.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Config, Finding, SourceFile, dotted_name
+
+
+def _yields(fn: ast.AST) -> list[ast.AST]:
+    """Yield nodes in the function's own body (not nested defs)."""
+    out: list[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _protected_lines(fn: ast.AST) -> list[tuple[int, int]]:
+    """(start, end) spans covered by try/finally or with, within fn."""
+    spans: list[tuple[int, int]] = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if (isinstance(node, ast.Try) and node.finalbody) \
+                or isinstance(node, (ast.With, ast.AsyncWith)):
+            spans.append((node.lineno,
+                          getattr(node, "end_lineno", node.lineno)))
+        stack.extend(ast.iter_child_nodes(node))
+    return spans
+
+
+def _own_defs(scope_node: ast.AST) -> dict[str, ast.FunctionDef]:
+    """Function defs local to this scope (module or function body),
+    not descending into nested functions — each handler's `def gen():`
+    belongs to that handler, not the file."""
+    out: dict[str, ast.FunctionDef] = {}
+    stack = list(ast.iter_child_nodes(scope_node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[n.name] = n
+            continue
+        if isinstance(n, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _check_gen(sf: SourceFile, gen: ast.FunctionDef,
+               findings: list[Finding], checked: set[int]) -> None:
+    if id(gen) in checked:
+        return
+    checked.add(id(gen))
+    ys = _yields(gen)
+    if not ys:
+        return      # not a generator (factory returning one)
+    spans = _protected_lines(gen)
+    for y in ys:
+        line = getattr(y, "lineno", gen.lineno)
+        if not any(s <= line <= e for s, e in spans):
+            findings.append(Finding(
+                sf.path, gen.lineno,
+                "stream-close/no-finally", "stream-ok",
+                f"stream generator `{gen.name}` has a yield "
+                f"(line {line}) outside any try/finally or "
+                "with — on client disconnect its cleanup "
+                "(gauges, upstream close) never runs"))
+            break
+
+
+def _scan(sf: SourceFile, scope_node: ast.AST,
+          chain: tuple[dict[str, ast.FunctionDef], ...],
+          findings: list[Finding], checked: set[int],
+          cls_defs: dict[str, ast.FunctionDef] = {}) -> None:
+    """Walk one scope; `stream=<name>(...)` resolves against the
+    NEAREST enclosing scope's defs (two handlers both nesting a
+    `def gen():` each get their own checked — file-global first-wins
+    resolution would silently skip every later one), and
+    `stream=self.<m>(...)` against the nearest enclosing class's
+    methods."""
+    chain = chain + (_own_defs(scope_node),)
+    stack = list(ast.iter_child_nodes(scope_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan(sf, node, chain, findings, checked, cls_defs)
+            continue
+        if isinstance(node, ast.ClassDef):
+            methods = {n.name: n for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            _scan(sf, node, chain, findings, checked, methods)
+            continue
+        if isinstance(node, ast.Call) \
+                and dotted_name(node.func).rsplit(".", 1)[-1] == "Response":
+            for kw in node.keywords:
+                if kw.arg != "stream":
+                    continue
+                v = kw.value
+                if not isinstance(v, ast.Call):
+                    continue
+                gen = None
+                if isinstance(v.func, ast.Name):
+                    for defs in reversed(chain):
+                        gen = defs.get(v.func.id)
+                        if gen is not None:
+                            break
+                elif (isinstance(v.func, ast.Attribute)
+                        and isinstance(v.func.value, ast.Name)
+                        and v.func.value.id == "self"):
+                    gen = cls_defs.get(v.func.attr)
+                if gen is not None:
+                    _check_gen(sf, gen, findings, checked)
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def analyze(files: list[SourceFile], config: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        _scan(sf, sf.tree, (), findings, set())
+    return findings

@@ -64,6 +64,67 @@ def test_x():
 EOF
 seed_expect "$SEED/test_marker.py" "markers/unregistered"
 
+# Round-13 analyzers: lock-order cycle, blocking-under-lock,
+# metrics-contract drift, stream-close discipline.
+cat > "$SEED/order.py" <<'EOF'
+import threading
+
+class A:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.b = B(self)
+
+    def m(self):
+        with self._mu:
+            self.b.poke()
+
+    def poke2(self):
+        with self._mu:
+            pass
+
+class B:
+    def __init__(self, a: "A"):
+        self._mu = threading.Lock()
+        self.a = a
+
+    def poke(self):
+        with self._mu:
+            pass
+
+    def n(self):
+        with self._mu:
+            self.a.poke2()
+EOF
+seed_expect "$SEED/order.py" "lock-order/cycle"
+
+mkdir -p "$SEED/serve"
+cat > "$SEED/serve/block.py" <<'EOF'
+import threading, time
+
+class S:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def m(self):
+        with self._mu:
+            time.sleep(1.0)
+EOF
+seed_expect "$SEED/serve/block.py" "blocking/under-lock"
+
+cat > "$SEED/serve/metrics_drift.py" <<'EOF'
+AGGREGATION_TABLE = frozenset(("serve_ghost_total",))
+EOF
+seed_expect "$SEED/serve/metrics_drift.py" "metrics-contract/unexported"
+
+cat > "$SEED/stream.py" <<'EOF'
+def handler(req, Response):
+    def gen():
+        yield b"data"
+        yield b"more"
+    return Response(200, stream=gen())
+EOF
+seed_expect "$SEED/stream.py" "stream-close/no-finally"
+
 # 3. ci.sh itself fails on a seeded in-tree violation: an unguarded
 # write to a guarded-by attribute, appended to dht.py in a scratch
 # copy of the tree (the real tree is never touched).
@@ -91,5 +152,46 @@ EOF
 grep -q "lock-discipline/unguarded" /tmp/v/graftcheck_ci.log \
   || fail "seeded tree: wrong rule: $(cat /tmp/v/graftcheck_ci.log)"
 
-echo "PASS: graftcheck gates clean tree + flags seeded violations"
+# 4. Runtime lockcheck (GRAFTCHECK_LOCKCHECK=1): the rewritten class
+# catches a deliberately unguarded write the moment it executes.
+python - <<'EOF' >/tmp/v/lockcheck.log 2>&1 || fail "lockcheck leg: $(tail -3 /tmp/v/lockcheck.log)"
+import importlib.util, os, sys, textwrap
+sys.path.insert(0, os.getcwd())
+from tools.graftcheck import lockcheck
+
+src = textwrap.dedent("""
+    import threading
+
+    class Sched:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._shed = 0        # guarded-by: _mu
+
+        def ok(self):
+            with self._mu:
+                self._shed += 1
+
+        def seeded_violation(self):
+            self._shed += 1       # missing `with self._mu:`
+""")
+path = "/tmp/v/lockcheck_fixture.py"
+open(path, "w").write(src)
+spec = importlib.util.spec_from_file_location("lockcheck_fixture", path)
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+armed = lockcheck.instrument_module(mod, path)
+assert armed == ["Sched._shed<-_mu"], armed
+s = mod.Sched()
+s.ok()                       # locked write passes
+try:
+    s.seeded_violation()
+except lockcheck.LockcheckError:
+    pass
+else:
+    raise SystemExit("unguarded write was NOT caught")
+print("lockcheck: seeded unguarded write caught")
+EOF
+
+echo "PASS: graftcheck gates clean tree + flags seeded violations" \
+     "(incl. lock-order/blocking/metrics/stream + runtime lockcheck)"
 exit 0
