@@ -92,6 +92,16 @@ if [ "${1:-}" = "full" ]; then
   echo "== multi-tier KV: park/wake matrix (CPU)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tier.py -q || rc=1
 
+  # Live session migration (round 13): the WHOLE file including the
+  # slow-marked two-OS-process drain-as-migration matrix (real router,
+  # byte-identical post-migration resume) and the migration chaos leg
+  # — a replica drains and undrains under live loadgen churn traffic
+  # with serve.kv_tier.export=raise@0.3 armed: zero session loss, zero
+  # client-visible errors, failpoint contracts held. Excluded from the
+  # sweep below so each case executes exactly once.
+  echo "== session migration: matrix + drain-under-live-load chaos (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_migration.py -q || rc=1
+
   # Loadgen: the WHOLE file including the slow-marked 4-peer end-to-end
   # leg (directory + CPU-tiny engine + node/UI waves through
   # tools/e2e_bench.py, failpoints armed at low probability, durable
@@ -120,6 +130,7 @@ if [ "${1:-}" = "full" ]; then
     --ignore=tests/test_failpoints.py \
     --ignore=tests/test_router.py \
     --ignore=tests/test_kv_tier.py \
+    --ignore=tests/test_migration.py \
     --ignore=tests/test_loadgen.py \
     --ignore=tests/test_devcrypto.py || rc=1
 else
@@ -187,6 +198,18 @@ else
   JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tier.py -q -x \
     -m 'not slow' || rc=1
 
+  # Live session migration (round 13, tier-1 legs): session wire-format
+  # units, tier retain/adopt/forget semantics under the export
+  # failpoint, the cross-engine export->import A/B byte-identity oracle
+  # (explicit session AND anonymous head-hash wake inheritance), and
+  # import rejection (malformed / wrong geometry / fresher resident
+  # copy). The two-OS-process matrix + the drain-under-live-load chaos
+  # leg are slow-marked into full mode. Excluded from the sweep below
+  # so each case executes exactly once.
+  echo "== session migration: cross-engine byte-identity (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_migration.py -q -x \
+    -m 'not slow' || rc=1
+
   # Loadgen stub-server contracts (tier-1 legs): seeded schedule
   # determinism, scenario-mix proportions, SLO-ledger percentile math,
   # shed-vs-error-vs-truncated classification, the open-loop property,
@@ -204,6 +227,7 @@ else
     --ignore=tests/test_devcrypto.py \
     --ignore=tests/test_router.py \
     --ignore=tests/test_kv_tier.py \
+    --ignore=tests/test_migration.py \
     --ignore=tests/test_spec_draft.py \
     --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_chunked_prefill.py \

@@ -16,7 +16,7 @@ The chat plane's standard-methodology load subsystem (docs/loadtest.md):
 ``tools/e2e_bench.py`` is the operator CLI over all of it.
 """
 
-from .chaos import ChaosWindow, check_contracts
+from .chaos import ChaosWindow, ChurnWindow, check_contracts
 from .driver import Arrival, LoadDriver, TraceRecord, build_schedule
 from .report import build_ledger, error_row, percentile, write_row
 from .scenarios import (REGISTRY, SLO, Endpoints, Scenario, Step,
@@ -24,7 +24,8 @@ from .scenarios import (REGISTRY, SLO, Endpoints, Scenario, Step,
 from .stub import StubServer
 
 __all__ = [
-    "Arrival", "ChaosWindow", "Endpoints", "LoadDriver", "REGISTRY",
+    "Arrival", "ChaosWindow", "ChurnWindow", "Endpoints", "LoadDriver",
+    "REGISTRY",
     "SLO", "Scenario", "Step", "StubServer", "TraceRecord",
     "build_ledger", "build_schedule", "check_contracts", "default_mix",
     "error_row", "parse_mix", "percentile", "write_row",

@@ -101,6 +101,90 @@ class ChaosWindow:
             self._disarm()
 
 
+class ChurnWindow:
+    """Fleet churn mid-run: drain a replica at ``drain_at_s`` into the
+    run and undrain it at ``undrain_at_s`` — through the router's
+    ``/admin/drain``/``undrain``, so the drain is a LIVE MIGRATION
+    (serve/router.py round 13) and the churn scenario's sessions must
+    survive it. ``drain_fn``/``undrain_fn`` override the HTTP default
+    for harsher churn (kill/respawn a replica process, stop/start an
+    in-process server) — the contract is the same: zero session loss,
+    no client-visible errors beyond well-formed sheds.
+
+    Same lifecycle discipline as :class:`ChaosWindow`: daemon timers
+    relative to the driver's run start, ``stop()`` cancels pending
+    timers and restores (undrains) if the window is still open."""
+
+    def __init__(self, router_url: str = "", replica=0,
+                 drain_at_s: float = 0.0,
+                 undrain_at_s: Optional[float] = None,
+                 drain_fn=None, undrain_fn=None) -> None:
+        self.router_url = router_url.rstrip("/")
+        self.replica = replica
+        self.drain_at_s = drain_at_s
+        self.undrain_at_s = undrain_at_s
+        self._drain_fn = drain_fn or (lambda: self._post("drain"))
+        self._undrain_fn = undrain_fn or (lambda: self._post("undrain"))
+        self._timers: list = []
+        self._drained = threading.Event()
+        self._restored = threading.Event()
+        self._done = threading.Event()
+
+    def _post(self, verb: str) -> None:
+        import json
+        import urllib.request
+        req = urllib.request.Request(
+            f"{self.router_url}/admin/{verb}",
+            data=json.dumps({"replica": self.replica}).encode(),
+            headers={"Content-Type": "application/json"})
+        # Drain-as-migration is synchronous server-side: the timeout
+        # covers park-all + payload pulls for a loaded replica.
+        with urllib.request.urlopen(req, timeout=120.0) as r:
+            r.read()
+
+    def _drain(self) -> None:
+        try:
+            self._drain_fn()
+            self._drained.set()
+            log.info("churn: replica %s drained (migration complete)",
+                     self.replica)
+        except Exception:   # noqa: BLE001 — churn is best-effort chaos
+            log.exception("churn drain failed")
+
+    def _undrain(self) -> None:
+        try:
+            self._undrain_fn()
+            self._restored.set()
+            log.info("churn: replica %s undrained", self.replica)
+        except Exception:   # noqa: BLE001
+            log.exception("churn undrain failed")
+
+    def start(self, t0: float) -> None:   # t0 unused: offsets are relative
+        t = threading.Timer(self.drain_at_s, self._drain)
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+        if self.undrain_at_s is not None:
+            t2 = threading.Timer(self.undrain_at_s, self._undrain)
+            t2.daemon = True
+            t2.start()
+            self._timers.append(t2)
+
+    def stop(self) -> None:
+        if self._done.is_set():
+            return
+        self._done.set()
+        for t in self._timers:
+            t.cancel()
+        if self._drained.is_set() and not self._restored.is_set():
+            self._undrain()
+
+    @property
+    def churned(self) -> bool:
+        """Did the drain actually land (the run exercised churn)?"""
+        return self._drained.is_set()
+
+
 @dataclass
 class ContractReport:
     sheds: int = 0

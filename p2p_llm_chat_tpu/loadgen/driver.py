@@ -264,9 +264,28 @@ class LoadDriver:
                     elif last is not None:
                         gaps.append((now - last) * 1e3)
                     last = now
+                    if (step.abort_after_deltas
+                            and ntok >= step.abort_after_deltas):
+                        # Adversarial mid-stream disconnect: the caller's
+                        # finally closes the socket NOW — the server sees
+                        # a client gone mid-generation (the stream-close
+                        # discipline must settle its gauges). The CLIENT
+                        # got exactly what it wanted, so the record is
+                        # ok with whatever it measured before leaving.
+                        if step.measured:
+                            rec.tokens = ntok
+                            rec.itl_ms = gaps
+                            rec.ttft_ms = (first - t_send) * 1e3
+                            rec.total_ms = (now - t_send) * 1e3
+                        return True
                 if obj.get("done"):
                     done = True
                     break
+                if step.read_delay_s > 0:
+                    # Slow reader: parking between lines backs TCP up
+                    # into the server's chunk writer — the adversarial
+                    # hold the slow_reader scenario exists to apply.
+                    time.sleep(step.read_delay_s)
         except (socket.timeout, TimeoutError):
             rec.status = "error"
             rec.error_kind = "timeout"

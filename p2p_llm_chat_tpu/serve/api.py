@@ -133,6 +133,16 @@ class OllamaServer:
         self.router.add("GET", "/admin/prefix", self._prefix_list)
         self.router.add("GET", "/admin/prefix/export", self._prefix_export)
         self.router.add("POST", "/admin/prefix/import", self._prefix_import)
+        # Live session migration (serve/kv_tier.py round 13): parked
+        # sessions serialize replica-to-replica exactly like prefix
+        # entries — the router drives drain-as-migration and failure
+        # rehoming over these; KV bytes never pass through the router.
+        self.router.add("GET", "/admin/session", self._session_list)
+        self.router.add("GET", "/admin/session/export", self._session_export)
+        self.router.add("POST", "/admin/session/import", self._session_import)
+        self.router.add("POST", "/admin/session/forget", self._session_forget)
+        self.router.add("POST", "/admin/session/park_all",
+                        self._session_park_all)
         self._server: Optional[HttpServer] = None
 
     # -- helpers -------------------------------------------------------------
@@ -578,6 +588,109 @@ class OllamaServer:
                                            "prefix payload"})
         return Response(200, {"status": "ok", "len": entry.length,
                               "hash": entry.token_hash})
+
+    # -- live session migration (/admin/session, serve/kv_tier.py) -----------
+
+    def _session_backend(self):
+        """The backend's session-tier surface, or None when this replica
+        has none (FakeLLM, tiering disabled, multi-model front) — every
+        /admin/session endpoint then answers 501 so the router skips the
+        replica instead of retrying it."""
+        fn = getattr(self.backend, "session_list", None)
+        if fn is None or fn() is None:
+            return None
+        return self.backend
+
+    def _session_list(self, req: Request) -> Response:
+        """GET /admin/session: {key: {len, nbytes, parked, idle_s}} —
+        the migration control surface (small JSON, no KV bytes)."""
+        be = self._session_backend()
+        if be is None:
+            return Response(501, {"error": "no session tier"})
+        return Response(200, {"sessions": be.session_list() or {}})
+
+    def _session_export(self, req: Request) -> Response:
+        """GET /admin/session/export?key=<session key>: the serialized
+        parked payload (a resident session parks first via the
+        scheduler's park-all handshake). The session is RETAINED —
+        removal happens only on the destination's ack (forget)."""
+        be = self._session_backend()
+        if be is None:
+            return Response(501, {"error": "no session tier"})
+        key = str(req.query.get("key") or "")
+        if not key:
+            return Response(400, {"error": "missing key=<session key>"})
+        data = be.session_export(key)
+        if data is None:
+            return Response(404, {"error": f"session {key!r} not open"})
+        return Response(200, data, content_type="application/octet-stream")
+
+    def _session_import(self, req: Request) -> Response:
+        """POST /admin/session/import: install a peer's exported
+        session. Body is the raw payload, or the PULL form
+        {"from": <peer base url>, "key": <session key>} the router
+        sends — KV bytes flow replica-to-replica directly."""
+        be = self._session_backend()
+        if be is None:
+            return Response(501, {"error": "no session tier"})
+        data = req.body or b""
+        if data[:1] == b"{":
+            try:
+                spec = req.json() or {}
+            except ValueError:
+                return Response(400, {"error": "invalid json"})
+            src = str(spec.get("from") or "")
+            key = str(spec.get("key") or "")
+            if not src or not key:
+                return Response(400, {"error": "need from + key"})
+            import urllib.parse
+            import urllib.request
+            try:
+                q = urllib.parse.urlencode({"key": key})
+                with urllib.request.urlopen(
+                        f"{src.rstrip('/')}/admin/session/export?{q}",
+                        timeout=30.0) as r:
+                    data = r.read()
+            except Exception as e:   # noqa: BLE001 — peer may be gone
+                return Response(502, {"error": f"pull from {src} "
+                                               f"failed: {e}"})
+        sess = be.session_import(data)
+        if sess is None:
+            return Response(400, {"error": "malformed or incompatible "
+                                           "session payload"})
+        return Response(200, {"status": "ok", "key": sess.key,
+                              "len": sess.length})
+
+    def _session_forget(self, req: Request) -> Response:
+        """POST /admin/session/forget {"key": k}: the migration ack —
+        drop the (parked) source copy now that the destination owns the
+        session. Not an eviction: capacity dashboards must not read
+        migrations as pressure."""
+        be = self._session_backend()
+        if be is None:
+            return Response(501, {"error": "no session tier"})
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        key = str(body.get("key") or "")
+        if not key:
+            return Response(400, {"error": "missing key"})
+        if not be.session_forget(key):
+            return Response(404, {"error": f"session {key!r} not parked "
+                                           "here"})
+        return Response(200, {"status": "forgotten", "key": key})
+
+    def _session_park_all(self, req: Request) -> Response:
+        """POST /admin/session/park_all: demote every resident session
+        to its host-RAM (exportable) form — the drain-as-migration
+        pre-step."""
+        be = self._session_backend()
+        if be is None:
+            return Response(501, {"error": "no session tier"})
+        be.session_park_all()
+        return Response(200, {"status": "parked",
+                              "sessions": be.session_list() or {}})
 
     def _unsupported(self, req: Request) -> Response:
         return Response(501, {

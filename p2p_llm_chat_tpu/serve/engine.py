@@ -301,6 +301,27 @@ class TPUEngine:
         store = self.scheduler._prefix
         return None if store is None else store.import_payload(data)
 
+    # -- live session migration (serve/kv_tier.py round 13) ------------------
+    # The router composes these over /admin/session: park-all on the
+    # source, pull payloads to the destination, forget on ack — so a
+    # drain is a migration and a dead replica costs a bounded cold
+    # re-prefill, never a client error.
+
+    def session_list(self):
+        return self.scheduler.session_list()
+
+    def session_export(self, key: str):
+        return self.scheduler.session_export(key)
+
+    def session_import(self, data: bytes):
+        return self.scheduler.session_import(data)
+
+    def session_forget(self, key: str):
+        return self.scheduler.session_forget(key)
+
+    def session_park_all(self) -> None:
+        self.scheduler.park_all()
+
     def drain(self) -> None:
         """Replica drain hook (serve/router.py): finish in-flight
         streams, refuse new sessions, report not-ready on /readyz."""

@@ -47,14 +47,21 @@ session index. Host payload arrays are immutable after parking.
 
 from __future__ import annotations
 
+import io
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..utils.failpoints import failpoint
 from ..utils.log import get_logger
 
 log = get_logger("serve.kv_tier")
+
+# Wire-format version for serialize_session / deserialize_session
+# (bumped on any incompatible layout change; importers reject unknown
+# versions rather than guess — the serve/prefix.py convention).
+_WIRE_VERSION = 1
 
 # Token-head index grain: sessions of at least this many tokens are
 # findable by the hash of their first HEAD_GRAIN token ids (a follow-up
@@ -110,6 +117,98 @@ class SessionKV:
     @property
     def parked(self) -> bool:
         return self.host is not None
+
+
+# -- cross-replica session wire format ---------------------------------------
+
+def serialize_session(sess: SessionKV) -> bytes:
+    """One PARKED session -> bytes, for a peer replica (the live
+    cross-replica migration payload: raw pool words + scales exactly as
+    parked, plus the token ids and index key). The arrays ship verbatim
+    (int8 payload and head-major scales included — never a requantize),
+    so an import followed by the destination's verify-shaped wake
+    resumes the conversation byte-identically to never having moved.
+    ``kind`` records the pool family the payload came from ("paged":
+    span = page count; "dense": span = the row's bucket width) — the
+    importer validates it against its own geometry before adopting."""
+    import numpy as np
+    assert sess.parked, "only parked sessions serialize (park first)"
+    arrays, span = sess.host
+    kind = "paged" if len(arrays) == 4 else "dense"
+    present = [a is not None for a in arrays]
+    # Arrays ship as RAW BYTES + explicit dtype/shape sidecars, not as
+    # native npz arrays: npz round-trips extension dtypes (the bf16
+    # pools) as anonymous void records ("|V2"), silently losing the
+    # dtype the importer validates — and raw bytes make bit-exactness
+    # trivially true for every pool dtype.
+    payload = {}
+    for i, a in enumerate(arrays):
+        if a is None:
+            continue
+        a = np.ascontiguousarray(a)
+        payload[f"a{i}"] = np.frombuffer(a.tobytes(), np.uint8)
+        payload[f"a{i}_dtype"] = np.bytes_(str(a.dtype).encode())
+        payload[f"a{i}_shape"] = np.asarray(a.shape, np.int64)
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf, version=np.int64(_WIRE_VERSION),
+        key=np.bytes_(sess.key.encode()),
+        kind=np.bytes_(kind.encode()),
+        tokens=np.asarray(sess.tokens, np.int64),
+        length=np.int64(sess.length), span=np.int64(span),
+        present=np.asarray(present, bool), **payload)
+    return buf.getvalue()
+
+
+def _np_dtype(name: str):
+    """Resolve a dtype string, including the ml_dtypes extension types
+    (bfloat16 & friends) plain numpy cannot name."""
+    import numpy as np
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def deserialize_session(data: bytes) -> Optional[SessionKV]:
+    """Bytes -> a parked :class:`SessionKV`, or None on a malformed or
+    incompatible-version payload (peer payloads are untrusted input —
+    a bad one must never raise into the serving plane). Geometry
+    validation against the adopting pool is the scheduler's job
+    (``session_import``): this function only restores the container."""
+    import numpy as np
+    try:
+        with np.load(io.BytesIO(data)) as z:
+            if int(z["version"]) != _WIRE_VERSION:
+                return None
+            key = z["key"].tobytes().decode()
+            kind = z["kind"].tobytes().decode()
+            tokens = tuple(int(t) for t in z["tokens"])
+            length = int(z["length"])
+            span = int(z["span"])
+            present = [bool(p) for p in z["present"]]
+            arrays = []
+            for i, p in enumerate(present):
+                if not p:
+                    arrays.append(None)
+                    continue
+                dt = _np_dtype(z[f"a{i}_dtype"].tobytes().decode())
+                shape = tuple(int(s) for s in z[f"a{i}_shape"])
+                arrays.append(np.frombuffer(
+                    z[f"a{i}"].tobytes(), dt).reshape(shape))
+            arrays = tuple(arrays)
+    except Exception:   # noqa: BLE001 — peer payloads are untrusted
+        return None
+    if (not key or kind not in ("paged", "dense") or span <= 0
+            or not (0 < length <= len(tokens))
+            or not arrays or arrays[0] is None
+            or (kind == "paged" and len(arrays) != 4)
+            or (kind == "dense" and len(arrays) != 2)):
+        return None
+    nbytes = sum(a.nbytes for a in arrays if a is not None)
+    return SessionKV(key=key, tokens=tokens, length=length,
+                     host=(arrays, span), nbytes=nbytes)
 
 
 class KVTier:
@@ -242,6 +341,87 @@ class KVTier:
         with self._mu:
             self.n_evicted_total += 1
         return s.pages
+
+    # -- cross-replica migration (serve/router.py drives this over the
+    # /admin/session endpoints; payload format above) ------------------------
+
+    def sessions_meta(self) -> dict[str, dict]:
+        """{key: {len, nbytes, parked, idle_s}} — the migration control
+        surface (GET /admin/session): small JSON, no KV bytes; the
+        router decides who pulls what from whom."""
+        with self._mu:
+            now = time.monotonic()
+            return {k: {"len": s.length, "nbytes": int(s.nbytes),
+                        "parked": s.parked,
+                        "idle_s": round(now - s.last_used, 3)}
+                    for k, s in self._sessions.items()}
+
+    def export_payload(self, key: str) -> Optional[bytes]:
+        """Serialize one PARKED session for a peer replica. None when
+        the key is absent or still resident (residency means device
+        pages — the caller parks first via the scheduler's park-all
+        hook). The session is RETAINED: migration removes it only after
+        the destination acks the import (POST /admin/session/forget),
+        so a failed export/import leaves the source fully consistent —
+        the failpoint contract docs/robustness.md pins."""
+        failpoint("serve.kv_tier.export")
+        with self._mu:
+            s = self._sessions.get(key)
+            if s is None or not s.parked:
+                return None
+        # Host payload arrays are immutable after parking, and the
+        # session object's host tuple is never mutated in place — the
+        # serialize can safely run outside the lock.
+        return serialize_session(s)
+
+    def adopt(self, sess: SessionKV) -> bool:
+        """Install an imported (parked) session. False when a RESIDENT
+        session already holds the key — the local copy is live device
+        state and strictly fresher; adopting over it would leak its
+        pages (only the scheduler thread may free those). A parked
+        local copy is replaced (index + host bytes only — safe from the
+        HTTP thread that runs imports). Host-budget enforcement over
+        PARKED victims runs inline; resident-session policy stays with
+        the scheduler loop's own sweeps."""
+        with self._mu:
+            old = self._sessions.get(sess.key)
+            if old is not None and not old.parked:
+                return False
+            if old is not None:
+                # Parked replacement is index + byte accounting only —
+                # done under ONE lock hold with the insert, so a
+                # concurrent retain can never interleave between the
+                # check and the replace (its pages would leak).
+                h = self._head(old.tokens)
+                if h is not None and self._by_head.get(h) == old.key:
+                    del self._by_head[h]
+                del self._sessions[old.key]
+                self.host_bytes -= old.nbytes
+            self._sessions[sess.key] = sess
+            h = self._head(sess.tokens)
+            if h is not None:
+                self._by_head[h] = sess.key
+            self.host_bytes += sess.nbytes
+        for victim in self.host_victims():      # parked by definition
+            self.drop(victim)
+        return True
+
+    def forget(self, key: str) -> bool:
+        """Drop a PARKED session without counting an eviction (the
+        migration ack path: the session now lives on another replica —
+        capacity-eviction dashboards must not read migrations as
+        pressure). Resident sessions refuse: their pages are the
+        scheduler's to free."""
+        with self._mu:
+            s = self._sessions.get(key)
+            if s is None or not s.parked:
+                return False
+            h = self._head(s.tokens)
+            if h is not None and self._by_head.get(h) == key:
+                del self._by_head[h]
+            del self._sessions[key]
+            self.host_bytes -= s.nbytes
+        return True
 
     # -- counters (the scheduler's write API; lock taken here so the
     # guarded-by annotations hold under runtime lockcheck) -------------------

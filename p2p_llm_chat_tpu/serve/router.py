@@ -36,11 +36,30 @@ Routing policy (backpressure-aware, built on the PR-5 overload signals):
   replica, keeping its paged KV and prefix-cache hits local. A
   draining/unready home rehomes the session to the best eligible
   replica.
-- **Draining**: ``POST /admin/drain`` marks a replica draining — no new
-  sessions route there, existing streams (proxied connections) finish —
-  and forwards the drain to the replica's own ``/admin/drain`` so its
-  ``/readyz`` flips for any other balancer watching it.
-  ``POST /admin/undrain`` reverses both.
+- **Draining = migration** (round 13): ``POST /admin/drain`` marks a
+  replica draining — no new sessions route there, existing streams
+  (proxied connections) finish — forwards the drain to the replica's
+  own ``/admin/drain``, then LIVE-MIGRATES its open KV sessions: wait
+  for in-flight streams to settle, ``park_all`` on the source, have the
+  best eligible replica PULL each parked payload over
+  ``/admin/session`` (KV bytes replica-to-replica; the router moves
+  only control JSON), forget the source copy on the destination's ack,
+  and flip session affinity atomically — so a graceful drain loses
+  ZERO sessions. A failed export/import leaves the source copy intact
+  (the forget only follows an ack) and the client never sees an error:
+  worst case the next turn cold re-prefills. ``POST /admin/undrain``
+  reverses the drain flags (migrated sessions stay at their new home).
+- **Replica death**: a replica that stops answering rehomes every
+  session homed on it (the affinity entries drop, so follow-ups
+  rebalance and cold re-prefill — a log line and the
+  ``kv_sessions_lost_total`` ledger, never a client error; sessions
+  migrated before the death are already counted in
+  ``kv_sessions_migrated_total`` and keep their new home).
+- **Autoscaling** (``SERVE_ROUTER_AUTOSCALE``): a queue-driven loop on
+  the scrape thread spawns replicas when backpressure sustains (queue
+  depth per eligible replica above the up-threshold, or any replica
+  shedding) and retires them when the fleet idles — retirement goes
+  through drain-as-migration, so scaling down is invisible to clients.
 
 ``/metrics`` aggregates every replica's scrape — per-replica series get
 a ``replica="i"`` label merged with the same brace-block discipline
@@ -63,16 +82,23 @@ the replica that promoted them — serve/prefix.py round 11; default on,
 replicas without a store answer 501 once and are skipped),
 ``SERVE_ROUTER_AFFINITY`` (session affinity
 on/off), ``SERVE_ROUTER_TIMEOUT_S`` (per-proxied-request upstream
-timeout). The launcher path (``SERVE_REPLICAS=N`` in start_all.py)
-spawns N replica processes and wires this router in front of them.
+timeout), ``SERVE_ROUTER_DRAIN_WAIT_S`` (how long a drain waits for the
+replica's in-flight streams before migrating), and the autoscaler knobs
+``SERVE_ROUTER_AUTOSCALE`` / ``_MIN`` / ``_MAX`` / ``_UP_Q`` /
+``_DOWN_Q`` / ``_SUSTAIN`` / ``_PORT_BASE`` (docs/serving.md flag
+table). The launcher path (``SERVE_REPLICAS=N`` in start_all.py) spawns
+N replica processes and wires this router in front of them.
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
+import struct
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -80,9 +106,11 @@ from typing import Iterator, Optional
 
 from ..utils import backoff as _backoff
 from ..utils.env import env_bool, env_float, env_int, env_or
+from ..utils.failpoints import failpoint
 from ..utils.http import HttpServer, Request, Response, Router
 from ..utils.log import get_logger
 from ..utils.metrics import Registry
+from .kv_tier import HEAD_GRAIN
 
 log = get_logger("serve.router")
 
@@ -91,6 +119,11 @@ log = get_logger("serve.router")
 # replica, finite so a fleet that is ALL shedding still gets a
 # deterministic order.
 _SHED_PENALTY = 1000.0
+
+# Sentinel for "this scrape pass learned nothing about the replica's
+# sessions" (unreachable, or a transient list failure) — distinct from
+# None, which means "observed: no session tier".
+_KEEP_SESSIONS = object()
 
 # Gauges whose fleet-wide SUM is meaningful (capacity/occupancy/depth —
 # additive across replicas). Everything else that is not a counter stays
@@ -147,6 +180,16 @@ class _Replica:
     routed: int = 0
     retried_to: int = 0
     last_scrape_s: float = 0.0
+    # Ever answered a scrape: distinguishes a WARMING spawn (never
+    # alive yet — counts toward autoscale capacity) from a DEAD replica
+    # (was alive, stopped answering — must not block a replacement).
+    ever_alive: bool = False
+    # Last-known open-session keys from the replica's /admin/session
+    # (None = no session tier / never observed): the death ledger
+    # counts THESE — the sessions that actually existed — not the
+    # router's LRU-bounded affinity entries, which under- and
+    # over-count in different directions.
+    sessions: Optional[tuple] = None
 
     def snapshot(self) -> dict:
         return {"url": self.url, "index": self.index, "alive": self.alive,
@@ -235,9 +278,15 @@ class ReplicaRouter:
             raise ValueError("need at least one replica URL")
         self.addr_cfg = (addr if addr is not None
                          else env_or("SERVE_ADDR", "127.0.0.1:11434"))
+        # The fleet is DYNAMIC (round 13): the autoscaler appends and
+        # removes entries at runtime, so every iteration over the table
+        # outside ``_mu`` works on a snapshot taken under it, and
+        # replica indices are monotonic (never reused — metrics labels
+        # stay unambiguous across scale events).
         self.replicas = [
             _Replica(url=u.rstrip("/"), index=i)
-            for i, u in enumerate(upstreams)]
+            for i, u in enumerate(upstreams)]        # guarded-by: _mu
+        self._next_index = len(upstreams)            # guarded-by: _mu
         self._mu = threading.Lock()
         # Session-affinity table: conversation id -> home replica index,
         # LRU-bounded (an unbounded dict would grow one entry per
@@ -248,11 +297,12 @@ class ReplicaRouter:
         self.scrape_s = max(0.05, (scrape_ms if scrape_ms is not None else
                                    env_float("SERVE_ROUTER_SCRAPE_MS",
                                              500.0)) / 1000.0)
-        r = (retries if retries is not None
-             else env_int("SERVE_ROUTER_RETRIES", 0))
         # 0 = try every replica once; N bounds the distinct replicas
-        # tried per request.
-        self.max_attempts = r if r > 0 else len(self.replicas)
+        # tried per request. Resolved per request (``max_attempts``
+        # property), not at construction — the fleet size moves under
+        # autoscaling.
+        self._retries_cfg = (retries if retries is not None
+                             else env_int("SERVE_ROUTER_RETRIES", 0))
         self.affinity = (affinity if affinity is not None
                          else env_bool("SERVE_ROUTER_AFFINITY", True))
         self.timeout_s = (timeout_s if timeout_s is not None
@@ -262,6 +312,27 @@ class ReplicaRouter:
         self._m_retries = self.metrics.counter("router_retries_total")
         self._m_shed = self.metrics.counter("router_requests_shed_total")
         self._m_errors = self.metrics.counter("router_errors_total")
+        # Migration ledger (round 13): sessions moved replica-to-replica
+        # on drain/retire vs sessions whose home died un-exported (they
+        # rehome and cold re-prefill — a bounded cost, never an error).
+        # The migration histogram's 0.95 quantile is the "migration
+        # p95" acceptance number.
+        self._m_migrated = self.metrics.counter("kv_sessions_migrated_total")
+        self._m_lost = self.metrics.counter("kv_sessions_lost_total")
+        self._m_migration_failed = self.metrics.counter(
+            "router_migration_failures_total")
+        self._m_migration_ms = self.metrics.histogram("router_migration_ms")
+        self._m_scale_up = self.metrics.counter("router_autoscale_up_total")
+        self._m_scale_down = self.metrics.counter(
+            "router_autoscale_down_total")
+        # How long a drain waits for the replica's in-flight streams to
+        # settle before migrating (migration must capture sessions those
+        # streams retain at finish).
+        self.drain_wait_s = env_float("SERVE_ROUTER_DRAIN_WAIT_S", 30.0)
+        # Queue-driven autoscaler (round 13): ticked by the scrape loop;
+        # None = fixed fleet. Installed via attach_autoscaler (tests) or
+        # build_router_from_env (SERVE_ROUTER_AUTOSCALE=1).
+        self.autoscaler: Optional["Autoscaler"] = None
         # Cross-replica shared prefix tier (serve/prefix.py round 11):
         # the scrape loop lists each replica's cached prefixes by token
         # hash and tells replicas missing one to PULL it from the
@@ -276,6 +347,9 @@ class ReplicaRouter:
         self._m_prefix_sync_failures = self.metrics.counter(
             "router_prefix_sync_failures_total")
         self._prefix_unsupported: set[int] = set()  # guarded-by: _mu
+        # Replicas whose /admin/session answered 501 (no tier) — like
+        # the prefix set: permanent per replica, never re-probed.
+        self._session_unsupported: set[int] = set()  # guarded-by: _mu
         # (dst index, hash) -> last import attempt time. Scrape-thread
         # only. A replica whose store evicted an import (its cap is its
         # own policy) must not be force-fed the same hash every pass —
@@ -323,31 +397,61 @@ class ReplicaRouter:
 
     # -- replica state -------------------------------------------------------
 
+    @property
+    def max_attempts(self) -> int:
+        """Distinct replicas tried per request — resolved against the
+        LIVE fleet size (autoscaling moves it)."""
+        if self._retries_cfg > 0:
+            return self._retries_cfg
+        with self._mu:
+            return max(1, len(self.replicas))
+
+    def _replica_snapshot(self) -> list[_Replica]:
+        """The fleet table, copied under the lock — the iteration form
+        every non-``_mu`` path uses now that the list mutates at
+        runtime."""
+        with self._mu:
+            return list(self.replicas)
+
     def _scrape_all(self) -> None:
         # Parallel: a slow/blackholed replica costs its own 2 s timeout,
         # never delaying the OTHER replicas' readiness/drain/queue-depth
         # view past the scrape interval — the routing table must stay
         # fresh precisely when part of the fleet is misbehaving.
         results: dict = {}
+        reps = self._replica_snapshot()
 
         def scrape(rep: _Replica) -> None:
-            results[rep.index] = self._scrape_one(rep.url)
+            probe = self._scrape_one(rep.url)
+            sessions = _KEEP_SESSIONS
+            if probe[0] is not None:
+                # Reachable: refresh the session-key observation the
+                # death ledger counts. Unreachable keeps the LAST-KNOWN
+                # list — that snapshot is exactly the evidence a death
+                # needs.
+                sessions = self._fetch_session_keys(rep)
+            results[rep.index] = (probe, sessions)
 
         threads = [threading.Thread(target=scrape, args=(rep,))
-                   for rep in self.replicas]
+                   for rep in reps]
         for t in threads:
             t.start()
         for t in threads:
             t.join(timeout=5.0)
-        for rep in self.replicas:
+        for rep in reps:
             if rep.index not in results:
                 continue
-            ready, depth, shed = results[rep.index]
+            (ready, depth, shed), sessions = results[rep.index]
             now = time.monotonic()
             with self._mu:
+                died = rep.alive and ready is None
                 rep.alive = ready is not None
+                if rep.alive:
+                    rep.ever_alive = True
                 rep.ready = bool(ready)
                 rep.last_scrape_s = now
+                if sessions is not _KEEP_SESSIONS:
+                    rep.sessions = sessions
                 if depth is not None:
                     rep.queue_depth = depth
                 if shed is not None:
@@ -362,6 +466,34 @@ class ReplicaRouter:
                     # doesn't export it): don't penalize forever — a 503
                     # on the request path re-flags it within one try.
                     rep.shedding = False
+            if died:
+                # Alive -> unreachable transition: rehome its sessions
+                # NOW (bounded-cost cold re-prefill on the new home; the
+                # ledger counts them), not at each session's next turn.
+                self._note_replica_death(rep)
+
+    def _fetch_session_keys(self, rep: _Replica):
+        """The replica's current open-session keys, for the death
+        ledger. 501/404 = no tier (permanent; remembered like the
+        prefix set); transient failures keep the last observation."""
+        with self._mu:
+            if rep.index in self._session_unsupported:
+                return None
+        try:
+            with urllib.request.urlopen(f"{rep.url}/admin/session",
+                                        timeout=2.0) as r:
+                return tuple((json.loads(r.read()).get("sessions")
+                              or {}).keys())
+        except urllib.error.HTTPError as e:
+            code = e.code
+            e.close()
+            if code in (501, 404):
+                with self._mu:
+                    self._session_unsupported.add(rep.index)
+                return None
+            return _KEEP_SESSIONS
+        except Exception:   # noqa: BLE001 — transient; keep last known
+            return _KEEP_SESSIONS
 
     def _scrape_one(self, url: str):
         """(ready, queue_depth, shed_total) — ready None = unreachable.
@@ -412,6 +544,11 @@ class ReplicaRouter:
                 self._sync_prefixes()
             except Exception:   # noqa: BLE001
                 log.exception("prefix sync pass failed")
+            if self.autoscaler is not None:
+                try:
+                    self.autoscaler.tick(self)
+                except Exception:   # noqa: BLE001
+                    log.exception("autoscaler tick failed")
 
     # -- cross-replica shared prefix tier ------------------------------------
 
@@ -429,11 +566,13 @@ class ReplicaRouter:
         capacity-bound store that evicts an import from being force-fed
         the same hash every pass; replicas without a prefix store (501)
         are remembered and skipped."""
-        if not self.prefix_share or len(self.replicas) < 2:
+        reps = self._replica_snapshot()
+        if not self.prefix_share or len(reps) < 2:
             return
         import json as _json
+        by_idx = {rep.index: rep for rep in reps}
         views: dict[int, dict] = {}
-        for rep in self.replicas:
+        for rep in reps:
             with self._mu:
                 skip = (not rep.alive
                         or rep.index in self._prefix_unsupported)
@@ -462,7 +601,7 @@ class ReplicaRouter:
                 hits = float(meta.get("hits", 0) or 0)
                 cur = union.get(h)
                 if cur is None or hits > cur[0]:
-                    union[h] = (hits, self.replicas[idx].url)
+                    union[h] = (hits, by_idx[idx].url)
         now = time.monotonic()
         if len(self._prefix_sync_at) > 2048:
             self._prefix_sync_at = {
@@ -470,7 +609,7 @@ class ReplicaRouter:
                 if now - t < self._prefix_sync_cooldown_s}
         budget = 2                      # imports per pass — no storms
         for idx, prefixes in views.items():
-            dst = self.replicas[idx].url
+            dst = by_idx[idx].url
             for h, (hits, src) in union.items():
                 if budget <= 0:
                     return
@@ -554,7 +693,22 @@ class ReplicaRouter:
             return None
         ctx = body.get("context")
         if isinstance(ctx, (list, tuple)) and ctx:
-            head = ",".join(str(t) for t in ctx[:32])
+            ids = list(ctx[:HEAD_GRAIN])
+            if len(ids) == HEAD_GRAIN and all(
+                    type(t) is int for t in ids):
+                # EXACTLY the KV tier's anonymous session key
+                # (serve/scheduler._session_key: sha1 over the native
+                # int64 bytes of the first HEAD_GRAIN prompt ids — a
+                # follow-up's context head IS the session's token
+                # head). Sharing the derivation means a migrated
+                # session's affinity flip — keyed by the tier keys the
+                # source replica lists — rehomes bare /api/generate
+                # continuations too, so anonymous wake follows the
+                # payload to its new replica instead of cold-missing
+                # at the old home.
+                return "head:" + hashlib.sha1(struct.pack(
+                    f"={HEAD_GRAIN}q", *ids)).hexdigest()[:16]
+            head = ",".join(str(t) for t in ids)
             return hashlib.sha1(head.encode()).hexdigest()[:16]
         return None
 
@@ -670,10 +824,13 @@ class ReplicaRouter:
             except Exception as e:  # noqa: BLE001 — connection-level failure
                 on_done()
                 with self._mu:
+                    was_alive = rep.alive
                     rep.alive = False
                     rep.ready = False
                 log.warning("replica %d (%s) unreachable: %s",
                             rep.index, rep.url, e)
+                if was_alive:
+                    self._note_replica_death(rep)
                 continue
             if upstream.status == 503:
                 ra = upstream.headers.get("Retry-After")
@@ -818,14 +975,53 @@ class ReplicaRouter:
         text += "".join(lines)
         return Response(200, text, content_type="text/plain; version=0.0.4")
 
-    # -- draining ------------------------------------------------------------
+    # -- draining = migration ------------------------------------------------
 
     def _find_replica(self, body: dict) -> Optional[_Replica]:
         sel = body.get("replica")
-        for rep in self.replicas:
+        for rep in self._replica_snapshot():
             if sel == rep.index or sel == str(rep.index) or sel == rep.url:
                 return rep
         return None
+
+    def _forward_drain(self, rep: _Replica, draining: bool) -> None:
+        """Flip the replica's OWN drain hook so its /readyz answers
+        draining for any other balancer watching it. Best-effort: a
+        replica that predates the hook still drains router-side."""
+        verb = "drain" if draining else "undrain"
+        try:
+            up = urllib.request.Request(f"{rep.url}/admin/{verb}",
+                                        data=b"{}", method="POST")
+            with urllib.request.urlopen(up, timeout=2.0) as r:
+                r.read()
+        except Exception as e:  # noqa: BLE001
+            log.warning("replica %d %s forward failed: %s",
+                        rep.index, verb, e)
+
+    def _drain_replica(self, rep: _Replica, draining: bool) -> dict:
+        """Drain (with live session migration) or undrain one replica —
+        the shared body of POST /admin/drain|undrain and the
+        autoscaler's retire path."""
+        with self._mu:
+            rep.draining = draining
+        self._forward_drain(rep, draining)
+        out: dict = {"status": "drain" if draining else "undrain",
+                     "replica": rep.index}
+        if draining:
+            # Drain-as-migration: by the time this returns, every open
+            # session the replica homed lives on another replica (or is
+            # explicitly accounted as left-behind) — completing the
+            # drain AFTER the move is what makes it lossless.
+            out["migration"] = self._migrate_sessions(rep)
+        log.info("replica %d (%s) %s", rep.index, rep.url,
+                 "draining" if draining else "undrained")
+        return out
+
+    def _admin_drain(self, req: Request) -> Response:
+        return self._set_drain(req, True)
+
+    def _admin_undrain(self, req: Request) -> Response:
+        return self._set_drain(req, False)
 
     def _set_drain(self, req: Request, draining: bool) -> Response:
         try:
@@ -836,30 +1032,198 @@ class ReplicaRouter:
         if rep is None:
             return Response(404, {"error": "no such replica; pass "
                                            '{"replica": <index or url>}'})
-        with self._mu:
-            rep.draining = draining
-        # Forward to the replica's own drain hook so ITS /readyz flips
-        # too (any other balancer watching the replica sees the drain,
-        # not just this router). Best-effort: a replica that predates
-        # the hook still drains router-side.
-        verb = "drain" if draining else "undrain"
+        return Response(200, self._drain_replica(rep, draining))
+
+    # -- live session migration ----------------------------------------------
+
+    def _wait_inflight_drained(self, rep: _Replica) -> None:
+        """Wait (bounded by SERVE_ROUTER_DRAIN_WAIT_S) for the draining
+        replica's in-flight streams to finish: a stream completing
+        AFTER the migration pass would retain its session on the source
+        — parked but never exported. Polls the replica's own
+        serve_inflight_requests gauge (summed across model labels)."""
+        deadline = time.monotonic() + max(0.0, self.drain_wait_s)
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(f"{rep.url}/metrics",
+                                            timeout=2.0) as r:
+                    snap = parse_metrics_text(
+                        r.read().decode("utf-8", "replace"))
+            except Exception:   # noqa: BLE001 — replica gone: stop waiting
+                return
+            inflight = sum(v for k, v in snap.items()
+                           if k == "serve_inflight_requests"
+                           or k.startswith("serve_inflight_requests{"))
+            if inflight <= 0:
+                return
+            time.sleep(0.1)
+        log.warning("replica %d still has in-flight streams after "
+                    "%.0fs; migrating what is parked", rep.index,
+                    self.drain_wait_s)
+
+    def _session_keys(self, rep: _Replica) -> Optional[list[str]]:
+        """The replica's open-session keys, or None when it has no
+        session tier (501/404) or is unreachable."""
         try:
-            up = urllib.request.Request(f"{rep.url}/admin/{verb}",
-                                        data=b"{}", method="POST")
-            with urllib.request.urlopen(up, timeout=2.0) as r:
+            with urllib.request.urlopen(f"{rep.url}/admin/session",
+                                        timeout=5.0) as r:
+                return list((json.loads(r.read()).get("sessions")
+                             or {}).keys())
+        except urllib.error.HTTPError as e:
+            e.close()
+            return None
+        except Exception:   # noqa: BLE001 — unreachable
+            return None
+
+    def _migrate_sessions(self, rep: _Replica) -> dict:
+        """Move every open session off ``rep`` to the best eligible
+        replica: wait out in-flight streams, park-all on the source,
+        then per session — destination PULLS the payload
+        (POST /admin/session/import {"from", "key"}; KV bytes flow
+        replica-to-replica), source forgets ONLY on the ack, affinity
+        flips atomically. A failed step (the serve.kv_tier.export/import
+        and serve.router.migrate failpoints land here) leaves BOTH
+        replicas consistent: the source keeps the session, the counter
+        and a log line record it, and the client sees nothing — its
+        next turn cold re-prefills at worst."""
+        out = {"migrated": 0, "failed": 0, "dest": None, "sessions": 0}
+        if self._session_keys(rep) is None:
+            return out              # no tier on this replica: nothing owed
+        self._wait_inflight_drained(rep)
+        try:
+            up = urllib.request.Request(
+                f"{rep.url}/admin/session/park_all", data=b"{}",
+                method="POST")
+            with urllib.request.urlopen(up, timeout=60.0) as r:
                 r.read()
-        except Exception as e:  # noqa: BLE001
-            log.warning("replica %d %s forward failed: %s",
-                        rep.index, verb, e)
-        log.info("replica %d (%s) %s", rep.index, rep.url,
-                 "draining" if draining else "undrained")
-        return Response(200, {"status": verb, "replica": rep.index})
+        except Exception as e:  # noqa: BLE001 — park what it can
+            log.warning("replica %d park_all failed: %s", rep.index, e)
+        keys = self._session_keys(rep) or []
+        out["sessions"] = len(keys)
+        if not keys:
+            return out
+        dests = [d for d in self._eligible() if d.index != rep.index]
+        if not dests:
+            log.warning("no eligible replica to migrate %d session(s) "
+                        "off replica %d; they stay parked there",
+                        len(keys), rep.index)
+            return out
+        dst = dests[0]
+        out["dest"] = dst.index
+        for key in keys:
+            t0 = time.monotonic()
+            try:
+                failpoint("serve.router.migrate")
+                imp = urllib.request.Request(
+                    f"{dst.url}/admin/session/import",
+                    data=json.dumps({"from": rep.url, "key": key}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(imp, timeout=60.0) as r:
+                    r.read()
+            except Exception as e:  # noqa: BLE001 — source keeps the session
+                self._m_migration_failed.inc()
+                out["failed"] += 1
+                log.warning("session %s migration %s -> %s failed (%s); "
+                            "source retains it", key, rep.url, dst.url, e)
+                continue
+            # Destination ack'd: NOW the source may drop its copy (a
+            # failed forget merely leaves a redundant parked copy the
+            # source's cost eviction will age out — harmless).
+            try:
+                fg = urllib.request.Request(
+                    f"{rep.url}/admin/session/forget",
+                    data=json.dumps({"key": key}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(fg, timeout=5.0) as r:
+                    r.read()
+            except Exception as e:  # noqa: BLE001
+                log.warning("session %s forget on %s failed: %s", key,
+                            rep.url, e)
+            # Affinity flip: the tier keys ARE the affinity keys
+            # ("sid:<id>" strips to the raw id the router keys on;
+            # "head:<hash>" matches the shared context-head derivation
+            # in session_key) — the next turn routes straight to the
+            # session's new home.
+            akey = key[4:] if key.startswith("sid:") else key
+            with self._mu:
+                self._sessions[akey] = dst.index
+                self._sessions.move_to_end(akey)
+            self._m_migrated.inc()
+            self._m_migration_ms.observe((time.monotonic() - t0) * 1e3)
+            out["migrated"] += 1
+        if out["migrated"] or out["failed"]:
+            log.info("replica %d drain migrated %d/%d session(s) to "
+                     "replica %d (%d failed, retained at source)",
+                     rep.index, out["migrated"], out["sessions"],
+                     dst.index, out["failed"])
+        return out
 
-    def _admin_drain(self, req: Request) -> Response:
-        return self._set_drain(req, True)
+    def _note_replica_death(self, rep: _Replica) -> None:
+        """A replica stopped answering: every session homed on it
+        rehomes NOW. Their parked payloads died with the process (or
+        are unreachable behind it) — each follow-up turn lands on a
+        healthy replica and cold re-prefills from the client's own
+        context round-trip. Bounded extra compute, a log line, and the
+        lost-vs-migrated ledger; NEVER an error to the client.
 
-    def _admin_undrain(self, req: Request) -> Response:
-        return self._set_drain(req, False)
+        The ledger counts the replica's LAST-SCRAPED open-session list
+        (``_Replica.sessions``) — the KV that actually existed — not
+        the affinity entries, which miss sessions past the LRU cap (or
+        all of them with affinity off) and count conversations that
+        never had parked KV."""
+        with self._mu:
+            homed = [k for k, v in self._sessions.items()
+                     if v == rep.index]
+            for k in homed:
+                del self._sessions[k]
+            lost = len(rep.sessions or ())
+            rep.sessions = None     # counted once; a respawn starts clean
+        if lost:
+            self._m_lost.inc(lost)
+        if lost or homed:
+            log.warning(
+                "replica %d (%s) died with %d open session(s) (%d "
+                "affinity entries dropped); follow-ups rehome and cold "
+                "re-prefill (kv_sessions_lost_total ledger — no client "
+                "errors)", rep.index, rep.url, lost, len(homed))
+
+    # -- elastic fleet (autoscaler surface) ----------------------------------
+
+    def add_replica(self, url: str) -> _Replica:
+        """Grow the fleet: the new replica joins not-alive/not-ready and
+        starts taking traffic once the scrape loop sees its /readyz —
+        warmup gating composes with scaling for free."""
+        with self._mu:
+            rep = _Replica(url=url.rstrip("/"), index=self._next_index)
+            self._next_index += 1
+            self.replicas.append(rep)
+        log.info("fleet grew: replica %d (%s) joined", rep.index, rep.url)
+        return rep
+
+    def remove_replica(self, rep: _Replica) -> None:
+        """Forget a replica (after retirement drained + migrated it).
+        Affinity entries still pointing at it drop so their sessions
+        rebalance."""
+        with self._mu:
+            self.replicas = [r for r in self.replicas if r is not rep]
+            for k in [k for k, v in self._sessions.items()
+                      if v == rep.index]:
+                del self._sessions[k]
+        log.info("fleet shrank: replica %d (%s) removed", rep.index,
+                 rep.url)
+
+    def retire_replica(self, rep: _Replica, stop_fn=None) -> None:
+        """Scale-down = drain-as-migration, then removal: every session
+        the replica homed moves first, so retirement is invisible to
+        clients. ``stop_fn(url)`` tears the process down (the spawner's
+        job; None = the operator owns it — it is left drained)."""
+        self._drain_replica(rep, True)
+        if stop_fn is not None:
+            try:
+                stop_fn(rep.url)
+            except Exception:   # noqa: BLE001 — removal proceeds
+                log.exception("replica %d stop callback failed", rep.index)
+        self.remove_replica(rep)
 
     def _admin_replicas(self, req: Request) -> Response:
         with self._mu:
@@ -867,13 +1231,19 @@ class ReplicaRouter:
                 "replicas": [r.snapshot() for r in self.replicas],
                 "sessions": len(self._sessions)})
 
+    def attach_autoscaler(self, autoscaler: "Autoscaler") -> None:
+        """Install the queue-driven autoscaler (ticked by the scrape
+        loop; scrape-thread-only state lives inside it)."""
+        self.autoscaler = autoscaler
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "ReplicaRouter":
         self._server = HttpServer(self.router, self.addr_cfg).start()
+        reps = self._replica_snapshot()
         log.info("replica router on %s over %d replicas: %s",
-                 self._server.addr, len(self.replicas),
-                 ", ".join(r.url for r in self.replicas))
+                 self._server.addr, len(reps),
+                 ", ".join(r.url for r in reps))
         return self
 
     @property
@@ -887,8 +1257,231 @@ class ReplicaRouter:
 
     def stop(self) -> None:
         self._closed.set()
+        if self.autoscaler is not None:
+            self.autoscaler.close()
         if self._server:
             self._server.stop()
+
+
+class Autoscaler:
+    """Queue-driven elastic fleet: spawn replicas under sustained
+    backpressure, retire them (through drain-as-migration) when the
+    fleet idles.
+
+    The policy reads the SAME scraped signals routing weights on (PR 5
+    backpressure: per-replica ``serve_queue_depth`` + router-side
+    inflight, and the shed-counter-moved flag): pressure = total
+    depth / eligible replicas. Pressure above ``up_q`` — or ANY replica
+    actively shedding — for ``sustain`` consecutive scrape passes scales
+    up (one replica per trigger; the streak resets, so a warming replica
+    gets time to absorb load before the next spawn). Pressure below
+    ``down_q`` for ``sustain`` passes scales down by ONE replica, least
+    load first, retirement always through
+    :meth:`ReplicaRouter.retire_replica` so scaling down is invisible to
+    clients. The fleet never shrinks below ``min_replicas`` eligible
+    replicas or grows past ``max_replicas`` total.
+
+    ``spawn_fn()`` returns the new replica's base URL (or None to skip);
+    ``retire_fn(url)`` tears its process down; ``can_retire_fn(url)``
+    limits victims (the process spawner only retires replicas it
+    spawned — boot replicas belong to the operator). All state is
+    scrape-thread-only (tick runs there exclusively)."""
+
+    def __init__(self, spawn_fn, retire_fn=None, can_retire_fn=None,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 up_q: Optional[float] = None,
+                 down_q: Optional[float] = None,
+                 sustain: Optional[int] = None) -> None:
+        self.spawn_fn = spawn_fn
+        self.retire_fn = retire_fn
+        self.can_retire_fn = can_retire_fn or (lambda url: True)
+        self.min_replicas = (min_replicas if min_replicas is not None
+                             else env_int("SERVE_ROUTER_AUTOSCALE_MIN", 1))
+        self.max_replicas = (max_replicas if max_replicas is not None
+                             else env_int("SERVE_ROUTER_AUTOSCALE_MAX", 4))
+        self.up_q = (up_q if up_q is not None
+                     else env_float("SERVE_ROUTER_AUTOSCALE_UP_Q", 4.0))
+        self.down_q = (down_q if down_q is not None
+                       else env_float("SERVE_ROUTER_AUTOSCALE_DOWN_Q", 0.5))
+        self.sustain = (sustain if sustain is not None
+                        else env_int("SERVE_ROUTER_AUTOSCALE_SUSTAIN", 3))
+        self._up_streak = 0       # owned-by: tick (scrape thread)
+        self._down_streak = 0     # owned-by: tick (scrape thread)
+        # A retirement in flight (drain-as-migration runs seconds to
+        # minutes): it runs OFF the scrape thread so fleet health keeps
+        # scraping, and this event keeps a second retire (or a
+        # conflicting spawn decision) from racing it.
+        self._retiring = threading.Event()
+
+    def tick(self, router: ReplicaRouter) -> None:
+        """One policy evaluation (scrape thread, after each pass)."""
+        with router._mu:
+            # Capacity counts LIVE replicas plus still-WARMING spawns
+            # (never answered a scrape yet) — a replica that DIED must
+            # not hold a capacity slot, or a crash at max_replicas
+            # would block its own replacement forever.
+            n_capacity = sum(1 for r in router.replicas
+                             if r.alive or not r.ever_alive)
+            elig = [r for r in router.replicas
+                    if r.alive and r.ready and not r.draining]
+            depth = sum(r.queue_depth + r.inflight for r in elig)
+            shedding = any(r.shedding for r in elig)
+            loads = {r.index: r.queue_depth + r.inflight for r in elig}
+            urls = {r.index: r.url for r in elig}
+        if self._retiring.is_set():
+            return                  # let the in-flight retire settle first
+        pressure = depth / max(1, len(elig))
+        if ((pressure > self.up_q or shedding)
+                and n_capacity < self.max_replicas):
+            self._up_streak += 1
+            self._down_streak = 0
+            if self._up_streak >= self.sustain:
+                self._up_streak = 0
+                url = self.spawn_fn()
+                if url:
+                    router.add_replica(url)
+                    router._m_scale_up.inc()
+                    log.info("autoscale up: pressure %.1f (shedding=%s) "
+                             "-> spawned %s", pressure, shedding, url)
+        elif (elig and not shedding and pressure < self.down_q
+                and len(elig) > self.min_replicas):
+            self._down_streak += 1
+            self._up_streak = 0
+            if self._down_streak >= self.sustain:
+                self._down_streak = 0
+                victims = sorted(
+                    (load, idx) for idx, load in loads.items()
+                    if self.can_retire_fn(urls[idx]))
+                if victims:
+                    _, idx = victims[0]
+                    rep = next((r for r in router._replica_snapshot()
+                                if r.index == idx), None)
+                    if rep is not None:
+                        self._retire_async(router, rep, pressure)
+        else:
+            self._up_streak = 0
+            self._down_streak = 0
+
+    def _retire_async(self, router: ReplicaRouter, rep: _Replica,
+                      pressure: float) -> None:
+        """Run the retirement (drain-as-migration + process stop) on its
+        own thread: _wait_inflight_drained + park_all + per-session
+        pulls can take minutes, and the scrape loop must keep the
+        routing table fresh — ESPECIALLY while the fleet is changing."""
+        log.info("autoscale down: pressure %.2f -> retiring replica %d "
+                 "(%s)", pressure, rep.index, rep.url)
+        self._retiring.set()
+
+        def _run() -> None:
+            try:
+                router.retire_replica(rep, stop_fn=self.retire_fn)
+                router._m_scale_down.inc()
+            except Exception:   # noqa: BLE001 — next tick re-evaluates
+                log.exception("replica %d retirement failed", rep.index)
+            finally:
+                self._retiring.clear()
+
+        threading.Thread(target=_run, daemon=True,
+                         name="autoscale-retire").start()
+
+    def close(self) -> None:
+        fn = getattr(self.spawn_fn, "stop_all", None)
+        if callable(fn):
+            fn()
+
+
+class ProcessReplicaSpawner:
+    """The env-path spawner (``SERVE_ROUTER_AUTOSCALE=1``): replicas as
+    ``python -m p2p_llm_chat_tpu.serve.api`` subprocesses on successive
+    ports from ``SERVE_ROUTER_AUTOSCALE_PORT_BASE``, inheriting the
+    router's environment (minus the mode flags a replica must never
+    see) — so SERVE_BACKEND/CKPT_DIR/SERVE_KV* flow through and a
+    spawned replica is a full-stack engine. Retirement only applies to
+    replicas this spawner created; boot upstreams are the operator's."""
+
+    def __init__(self, port_base: Optional[int] = None) -> None:
+        self.port_base = (port_base if port_base is not None else
+                          env_int("SERVE_ROUTER_AUTOSCALE_PORT_BASE",
+                                  11500))
+        self._mu = threading.Lock()
+        self._n = 0                           # guarded-by: _mu
+        self._procs: dict[str, object] = {}   # guarded-by: _mu (url -> Popen)
+        # Ports whose retired process has been REAPED (exit observed):
+        # reused lowest-first, so the spawner stays inside the port
+        # range start_all.py's collision check reserved — a monotonic
+        # walk would leave it after max_replicas lifetime spawns.
+        self._free_ports: list[int] = []      # guarded-by: _mu
+
+    def __call__(self) -> Optional[str]:
+        import os
+        import subprocess
+        import sys
+        with self._mu:
+            if self._free_ports:
+                self._free_ports.sort()
+                port = self._free_ports.pop(0)
+            else:
+                port = self.port_base + self._n
+                self._n += 1
+        url = f"http://127.0.0.1:{port}"
+        env = {**os.environ,
+               "SERVE_ADDR": f"127.0.0.1:{port}",
+               "SERVE_ROUTER_UPSTREAMS": "",
+               "SERVE_COORDINATOR": ""}
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "p2p_llm_chat_tpu.serve.api"],
+                env=env)
+        except Exception:   # noqa: BLE001 — a failed spawn skips the pass
+            log.exception("autoscale replica spawn failed")
+            return None
+        with self._mu:
+            self._procs[url] = proc
+        return url
+
+    def can_retire(self, url: str) -> bool:
+        with self._mu:
+            return url in self._procs
+
+    def retire(self, url: str) -> None:
+        with self._mu:
+            p = self._procs.pop(url, None)
+        if p is None:
+            return
+        p.terminate()
+        # Reap on a side thread with a kill escalation: terminate alone
+        # leaks a zombie per scale-down (Popen never waited), and a
+        # wedged replica that ignores SIGTERM would live forever. The
+        # port returns to the pool only after the exit is OBSERVED —
+        # rebinding earlier races the dying listener.
+        threading.Thread(target=self._reap, args=(url, p), daemon=True,
+                         name="replica-reap").start()
+
+    def _reap(self, url: str, p) -> None:
+        import subprocess
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                log.warning("retired replica %s ignored SIGKILL; "
+                            "abandoning (port not reused)", url)
+                return
+        try:
+            port = int(url.rsplit(":", 1)[1])
+        except ValueError:
+            return
+        with self._mu:
+            self._free_ports.append(port)
+
+    def stop_all(self) -> None:
+        with self._mu:
+            urls = list(self._procs)
+        for url in urls:
+            self.retire(url)
 
 
 def build_router_from_env() -> ReplicaRouter:
@@ -897,7 +1490,18 @@ def build_router_from_env() -> ReplicaRouter:
     if not ups:
         raise SystemExit("SERVE_ROUTER_UPSTREAMS must list at least one "
                          "replica URL (comma-separated)")
-    return ReplicaRouter(ups)
+    router = ReplicaRouter(ups)
+    if env_bool("SERVE_ROUTER_AUTOSCALE", False):
+        spawner = ProcessReplicaSpawner()
+        router.attach_autoscaler(Autoscaler(
+            spawn_fn=spawner, retire_fn=spawner.retire,
+            can_retire_fn=spawner.can_retire))
+        log.info("autoscaler armed: %d..%d replicas, up>%.1f req/replica "
+                 "or shedding, down<%.1f, sustain %d passes",
+                 router.autoscaler.min_replicas,
+                 router.autoscaler.max_replicas, router.autoscaler.up_q,
+                 router.autoscaler.down_q, router.autoscaler.sustain)
+    return router
 
 
 def main() -> None:

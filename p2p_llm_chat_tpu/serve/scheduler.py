@@ -600,6 +600,17 @@ class BatchScheduler:
         # (and ack'd) by _loop at the top of every iteration.
         self._stall_reset_req = threading.Event()
         self._stall_reset_ack = threading.Event()
+        # park_all handshake (live session migration, serve/router.py):
+        # same event discipline as the stall reset — the request is set
+        # by an HTTP thread, serviced by _loop (which owns the device
+        # buffers the park gathers copy), ack'd when every resident
+        # session (or the one named by _park_all_key) sits in host RAM
+        # and is exportable. Single-caller discipline, like the stall
+        # reset: the key is written before the event sets, read after
+        # it clears (the Event publishes it).
+        self._park_all_req = threading.Event()
+        self._park_all_ack = threading.Event()
+        self._park_all_key: Optional[str] = None
         self._tbt_hist = Histogram("inter_token_ms")
         # Multi-tier KV (serve/kv_tier.py): host-RAM session parking.
         # All tier state transitions run on the scheduler thread (they
@@ -2185,6 +2196,7 @@ class BatchScheduler:
             self._loop_beat = it_start
             try:
                 self._drain_stall_reset()
+                self._drain_park_all()
                 # Admission inside the same recovery envelope as decode: an
                 # unexpected admission-path error must fail requests and
                 # reset, never kill the scheduler thread (which would leave
@@ -2446,6 +2458,142 @@ class BatchScheduler:
             self._decode_stall_ms = 0.0
             self._last_decode_t = None
             self._stall_reset_ack.set()
+
+    def park_all(self, timeout_s: float = 30.0,
+                 key: Optional[str] = None) -> None:
+        """Park RESIDENT sessions to host RAM (HTTP threads; the
+        migration pre-step — a parked payload is the only exportable
+        form). ``key`` limits the park to ONE session (the per-key
+        export path must not demote every other live conversation to a
+        wake it never needed); None parks everything (the drain path).
+        Resident pages are device state only the scheduler loop may
+        gather, so this is the same event handshake as
+        :meth:`reset_decode_stall`: the loop services it at the top of
+        every iteration, even mid-backlog. No-op without a tier, or in
+        dense mode (dense sessions park at finish — nothing is ever
+        resident). Returns once the loop has ack'd."""
+        if self._tier is None:
+            return
+        if self._closed.is_set():
+            raise RuntimeError("scheduler is stopped")
+        self._park_all_key = key
+        self._park_all_ack.clear()
+        self._park_all_req.set()
+        if not self._park_all_ack.wait(timeout=timeout_s):
+            raise TimeoutError("park_all: scheduler loop did not service "
+                               "the park request")
+
+    # graftcheck: runs-on _loop
+    def _drain_park_all(self) -> None:
+        """Service a pending park_all handshake (scheduler thread). The
+        ack sets in a finally so a park failure — which rides the loop's
+        recovery envelope — can never strand the HTTP caller on an
+        un-ack'd event."""
+        if not self._park_all_req.is_set():
+            return
+        self._park_all_req.clear()
+        key = self._park_all_key
+        try:
+            if self._tier is not None and self.kv_mode == "paged":
+                for sess in self._tier.park_candidates(force=True):
+                    if key is None or sess.key == key:
+                        self._park_session(sess)
+        finally:
+            self._park_all_ack.set()
+
+    # -- live session migration (serve/router.py over /admin/session) --------
+    # List/export/forget/import run on HTTP threads: they touch only the
+    # tier index and immutable parked host payloads, never device
+    # buffers (export of a resident session parks it first through the
+    # park_all handshake above).
+
+    def session_list(self) -> Optional[dict]:
+        """{key: meta} of open sessions, or None when tiering is off
+        (the front answers 501 so the router skips this replica)."""
+        if self._tier is None:
+            return None
+        return self._tier.sessions_meta()
+
+    def session_export(self, key: str) -> Optional[bytes]:
+        """Serialized session payload for a peer replica, or None when
+        unknown. A still-resident session is parked first (the loop owns
+        that copy); the session is retained either way — the router
+        forgets it on the destination's ack, never before."""
+        if self._tier is None:
+            return None
+        meta = self._tier.sessions_meta().get(key)
+        if meta is None:
+            return None
+        if not meta["parked"]:
+            self.park_all(key=key)      # only THIS session demotes
+        return self._tier.export_payload(key)
+
+    def session_import(self, data: bytes):
+        """Install a peer replica's exported session (parked tier).
+        Returns the adopted SessionKV, or None on a malformed payload,
+        a geometry/dtype mismatch with this engine's pool, or a fresher
+        resident local copy. The next prompt extending the session's
+        tokens wakes it through the ordinary verify-shaped wake
+        admission — byte-identical to never having migrated."""
+        if self._tier is None:
+            return None
+        failpoint("serve.kv_tier.import")
+        from .kv_tier import deserialize_session
+        sess = deserialize_session(data)
+        if sess is None or not self._session_payload_compatible(sess):
+            return None
+        if not self._tier.adopt(sess):
+            log.info("session %s import skipped: a resident local copy "
+                     "is fresher", sess.key)
+            return None
+        return sess
+
+    def session_forget(self, key: str) -> Optional[bool]:
+        """Migration ack: drop the (parked) source copy. None = no tier;
+        False = unknown key or still resident."""
+        if self._tier is None:
+            return None
+        return self._tier.forget(key)
+
+    def _session_payload_compatible(self, sess) -> bool:
+        """May this imported payload scatter into OUR pool? Shape/dtype
+        checks against the live cache — replicas in a fleet are
+        identical by construction (the router's assumption), but a
+        mis-aimed import from a differently-configured engine must
+        reject cleanly, not crash the wake dispatch. Reads only shape
+        metadata (valid even across the loop's donation rebinds)."""
+        try:
+            arrays, span = sess.host
+            k = arrays[0]
+            kind = "paged" if len(arrays) == 4 else "dense"
+            if kind != self.kv_mode or sess.length > self.max_seq:
+                return False
+            if any(t < 0 or t >= self.config.vocab_size
+                   for t in sess.tokens):
+                return False
+            cache_k = self._cache.k
+            if self.kv_mode == "paged":
+                if (k.shape[0] != cache_k.shape[0]
+                        or k.shape[2:] != cache_k.shape[2:]
+                        or str(k.dtype) != str(cache_k.dtype)):
+                    return False
+                if (arrays[2] is not None) != bool(self.kv_quant):
+                    return False
+                if span > k.shape[1] or span > self._cache.max_pages_per_row:
+                    return False
+                if -(-sess.length // self.page_size) > span:
+                    return False
+            else:
+                # Dense row: [L, W, Hkv, D] against cache [L, B, S, Hkv, D].
+                if (k.shape[0] != cache_k.shape[0] or k.shape[1] != span
+                        or span > self.max_seq
+                        or k.shape[2:] != cache_k.shape[3:]
+                        or str(k.dtype) != str(cache_k.dtype)
+                        or sess.length > span):
+                    return False
+            return True
+        except Exception:   # noqa: BLE001 — incompatible payloads reject
+            return False
 
     # graftcheck: lock-ok advisory gauges — torn reads of loop-owned ints are harmless for /metrics
     def metrics_snapshot(self) -> dict[str, float]:

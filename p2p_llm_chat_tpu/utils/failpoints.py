@@ -49,6 +49,10 @@ degradation contract):
 ``serve.scheduler.dispatch`` decode-tick dispatch
 ``serve.scheduler.promote``  off-thread prefix-promotion build
 ``serve.engine.readback``    decode-tick token readback (device -> host)
+``serve.kv_tier.export``     session-payload serialize for a peer replica
+``serve.kv_tier.import``     session-payload install from a peer replica
+``serve.router.migrate``     one session's drain/retire migration step
+
 ``p2p.directory.register``   directory client register RPC
 ``p2p.directory.lookup``     directory client lookup RPC
 ``p2p.dht.rpc``              one DHT UDP RPC attempt (drop = lost dgram)
@@ -76,6 +80,9 @@ KNOWN_SITES = (
     "serve.scheduler.dispatch",
     "serve.scheduler.promote",
     "serve.engine.readback",
+    "serve.kv_tier.export",
+    "serve.kv_tier.import",
+    "serve.router.migrate",
     "p2p.directory.register",
     "p2p.directory.lookup",
     "p2p.dht.rpc",

@@ -141,6 +141,14 @@ def main() -> int:
                          "plus the backpressure-aware router on "
                          "serve-port (docs/serving.md Round-10; 0/1 = "
                          "single engine, the default)")
+    ap.add_argument("--autoscale", action="store_true",
+                    default=env_int("SERVE_ROUTER_AUTOSCALE", 0) > 0,
+                    help="replica mode only: arm the router's queue-"
+                         "driven autoscaler — extra replicas spawn on "
+                         "sustained backpressure (ports above the fixed "
+                         "replica range) and retire through drain-as-"
+                         "migration when the fleet idles "
+                         "(docs/serving.md Round-13)")
     ap.add_argument("--relay-port", type=int,
                     default=env_int("RELAY_PORT", 4100))
     ap.add_argument("--boot-wave", type=int,
@@ -153,9 +161,15 @@ def main() -> int:
     args = ap.parse_args()
 
     users = [u.strip() for u in args.users.split(",") if u.strip()]
+    fixed_replicas = args.replicas if args.replicas >= 2 else 0
+    # Autoscaled replicas spawn on ports just above the fixed range —
+    # reserve up to the autoscaler's max so a scale-up can't collide
+    # with a node/UI port.
+    scale_room = (env_int("SERVE_ROUTER_AUTOSCALE_MAX", 4)
+                  if args.autoscale and fixed_replicas else 0)
     check_port_ranges(len(users), args.node_port_base, args.ui_port_base,
                       args.dir_port, args.serve_port,
-                      args.replicas if args.replicas >= 2 else 0)
+                      fixed_replicas + scale_room)
     procs: list[tuple[str, subprocess.Popen]] = []
 
     def shutdown(*_, exit_code: int = 0):
@@ -199,9 +213,22 @@ def main() -> int:
                        # mode flags from the launcher environment.
                        "SERVE_ROUTER_UPSTREAMS": "",
                        "SERVE_COORDINATOR": ""}, procs)
+            router_env = {"SERVE_ADDR": f"127.0.0.1:{args.serve_port}",
+                          "SERVE_ROUTER_UPSTREAMS": ",".join(upstreams)}
+            if args.autoscale:
+                # Autoscaled replicas are subprocesses of the ROUTER
+                # (serve/router.py ProcessReplicaSpawner): they inherit
+                # its environment, so the backend choice must ride
+                # along, and their ports sit just above the fixed
+                # replica range (reserved by check_port_ranges).
+                router_env.update({
+                    "SERVE_ROUTER_AUTOSCALE": "1",
+                    "SERVE_ROUTER_AUTOSCALE_PORT_BASE":
+                        str(args.serve_port + 1 + args.replicas),
+                    "SERVE_BACKEND": args.backend,
+                })
             spawn("serve-router", "p2p_llm_chat_tpu.serve.router",
-                  {"SERVE_ADDR": f"127.0.0.1:{args.serve_port}",
-                   "SERVE_ROUTER_UPSTREAMS": ",".join(upstreams)}, procs)
+                  router_env, procs)
         else:
             spawn("serve", "p2p_llm_chat_tpu.serve.api",
                   {"SERVE_ADDR": f"127.0.0.1:{args.serve_port}",

@@ -354,3 +354,94 @@ def test_e2e_small_stack_with_chaos(tmp_path):
     ran = [s for s in per.values() if s["n"]]
     assert ran and all(s["ttft_p50_ms"] is not None or s["ok"] == 0
                        for s in ran)
+
+
+# -- churn + adversarial clients (round 13) ----------------------------------
+
+def test_churn_window_drains_fleet_under_traffic():
+    """The churn scenario's run-level half: a ChurnWindow drains and
+    undrains a replica mid-run while churn traffic flows through the
+    router. Contract: zero session loss on the router ledger, no
+    client-visible errors (only ok / well-formed sheds), and the fleet
+    is whole again afterwards. FakeLLM replicas have no session tier —
+    this is the hookless drain path (migration no-ops gracefully)."""
+    from p2p_llm_chat_tpu.loadgen import ChurnWindow
+    from p2p_llm_chat_tpu.serve import FakeLLM, OllamaServer, ReplicaRouter
+    from p2p_llm_chat_tpu.serve.router import parse_metrics_text
+    import urllib.request
+
+    reps = [OllamaServer(FakeLLM(name="rep"), addr="127.0.0.1:0").start()
+            for _ in range(2)]
+    rt = ReplicaRouter([r.url for r in reps], addr="127.0.0.1:0",
+                       scrape_ms=50).start()
+    rt.drain_wait_s = 2.0
+    try:
+        sched = build_schedule(parse_mix("churn=1"), rate_rps=6.0,
+                               duration_s=1.6, seed=3, n_peers=4)
+        drv = LoadDriver(Endpoints(serve_url=rt.url), REGISTRY,
+                         workers=16, timeout_s=20.0)
+        churn = ChurnWindow(router_url=rt.url, replica=0,
+                            drain_at_s=0.4, undrain_at_s=1.2)
+        recs = drv.run(sched, chaos=churn)
+        assert recs
+        assert churn.churned
+        bad = [r for r in recs if r.status in ("error", "truncated")]
+        assert not bad, [(r.error_kind, r.error) for r in bad]
+        rep = check_contracts(recs)
+        assert rep.ok, rep.violations
+        with urllib.request.urlopen(f"{rt.url}/metrics", timeout=5) as r:
+            snap = parse_metrics_text(r.read().decode())
+        assert snap.get("kv_sessions_lost_total", 0) == 0.0
+        # The window restored the fleet: nobody is left draining.
+        with urllib.request.urlopen(f"{rt.url}/admin/replicas",
+                                    timeout=5) as r:
+            replicas = json.loads(r.read())["replicas"]
+        assert all(not rp["draining"] for rp in replicas), replicas
+    finally:
+        rt.stop()
+        for r in reps:
+            r.stop()
+
+
+def test_slow_reader_and_disconnect_storm_settle_inflight():
+    """The slow_reader scenario against a REAL serve front: near-zero
+    read rate holds streams open, ~half the arrivals disconnect
+    mid-stream — afterwards the front's serve_inflight_requests gauge
+    must settle to 0 (the PR 10 stream-close discipline, now
+    contract-checked under load)."""
+    from p2p_llm_chat_tpu.serve import FakeLLM, OllamaServer
+    import urllib.request
+
+    srv = OllamaServer(FakeLLM(name="rep", token_delay_s=0.02),
+                       addr="127.0.0.1:0").start()
+    try:
+        sched = build_schedule(parse_mix("slow_reader=1"), rate_rps=25.0,
+                               duration_s=0.8, seed=9, n_peers=4)
+        drv = LoadDriver(Endpoints(serve_url=srv.url), REGISTRY,
+                         workers=32, timeout_s=20.0)
+        recs = drv.run(sched)
+        assert len(recs) == len(sched) >= 10
+        assert all(r.status == "ok" for r in recs), \
+            [(r.status, r.error) for r in recs if r.status != "ok"]
+        # Both client classes actually occurred (the rng coin): kept
+        # streams read to completion, aborters hung up after delta 1.
+        aborted = [r for r in recs if r.tokens == 1]
+        kept = [r for r in recs if r.tokens > 1]
+        assert aborted and kept, (len(aborted), len(kept))
+        # The server-side contract: every stream slot released — the
+        # inflight gauge settles to 0 despite the disconnect storm.
+        deadline = time.monotonic() + 10.0
+        inflight = None
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(f"{srv.url}/metrics",
+                                        timeout=5) as r:
+                text = r.read().decode()
+            inflight = next(
+                float(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+                if line.startswith("serve_inflight_requests "))
+            if inflight == 0.0:
+                break
+            time.sleep(0.1)
+        assert inflight == 0.0, f"inflight never settled: {inflight}"
+    finally:
+        srv.stop()

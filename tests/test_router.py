@@ -922,3 +922,253 @@ def test_two_process_router_failpoint_overload():
         assert by_idx[1]["routed"] >= 6, reps
     finally:
         _shutdown(procs)
+
+
+# -- live session migration + elastic fleet (round 13) ------------------------
+
+class SessionTierLLM(FakeLLM):
+    """Backend exposing a REAL KVTier through the round-13 migration
+    hooks (the engine's surface without a model): the router's
+    drain-as-migration must move payloads between replicas' tiers."""
+
+    def __init__(self, name: str = "rep") -> None:
+        super().__init__(name=name)
+        from p2p_llm_chat_tpu.serve.kv_tier import KVTier
+        self.tier = KVTier(host_bytes=1 << 20)
+        self.park_alls = 0
+
+    def session_list(self):
+        return self.tier.sessions_meta()
+
+    def session_export(self, key):
+        return self.tier.export_payload(key)
+
+    def session_import(self, data):
+        from p2p_llm_chat_tpu.serve.kv_tier import deserialize_session
+        sess = deserialize_session(data)
+        if sess is None or not self.tier.adopt(sess):
+            return None
+        return sess
+
+    def session_forget(self, key):
+        return self.tier.forget(key)
+
+    def session_park_all(self):
+        self.park_alls += 1
+
+
+def _parked_session(key: str, nbytes: int = 64):
+    import numpy as np
+    from p2p_llm_chat_tpu.serve.kv_tier import SessionKV
+    arr = np.zeros(nbytes // 2, np.int8)
+    return SessionKV(key=key, tokens=tuple(range(40)), length=40,
+                     host=((arr, arr, None, None), 1), nbytes=2 * arr.nbytes)
+
+
+def _router_metrics(rt) -> dict:
+    with urllib.request.urlopen(f"{rt.url}/metrics", timeout=5) as r:
+        return parse_metrics_text(r.read().decode())
+
+
+def test_drain_migrates_sessions_and_flips_affinity():
+    """Drain-as-migration over real tiers: every session parked on the
+    drained replica moves to the survivor (export -> import -> forget on
+    ack), the affinity table flips — including the anonymous head:-keyed
+    entry — and the ledger counts migrations, never losses."""
+    backends: list = []
+
+    def factory(i):
+        b = SessionTierLLM()
+        backends.append(b)
+        return b
+
+    rt, reps = _fleet(2, backend_factory=factory)
+    try:
+        backends[0].tier.insert(_parked_session("sid:conv-mig"))
+        backends[0].tier.insert(_parked_session("head:cafebabe12345678"))
+        st, body = http_json("POST", f"{rt.url}/admin/drain", {"replica": 0})
+        assert st == 200
+        mig = body["migration"]
+        assert mig["migrated"] == 2 and mig["failed"] == 0, mig
+        assert mig["dest"] == 1
+        assert backends[0].park_alls == 1          # the park-all pre-step ran
+        assert set(backends[1].tier.sessions_meta()) == {
+            "sid:conv-mig", "head:cafebabe12345678"}
+        assert backends[0].tier.sessions_meta() == {}   # forgotten on ack
+        # Not an eviction on the source (capacity dashboards unmoved).
+        assert backends[0].tier.stats()["evicted_total"] == 0
+        # Affinity flipped atomically: explicit ids strip the sid:
+        # prefix; head: keys ride verbatim.
+        with rt._mu:
+            assert rt._sessions["conv-mig"] == 1
+            assert rt._sessions["head:cafebabe12345678"] == 1
+        snap = _router_metrics(rt)
+        assert snap["kv_sessions_migrated_total"] == 2.0
+        assert snap.get("kv_sessions_lost_total", 0) == 0.0
+        assert snap["router_migration_ms_count"] == 2.0
+    finally:
+        _stop(rt, reps)
+
+
+def test_failed_export_retains_source_and_client_unaffected():
+    """The serve.kv_tier.export failpoint contract under a drain: the
+    migration step fails, the SOURCE keeps the session (no forget ever
+    fires), the failure is counted — and a client request through the
+    router still completes."""
+    from p2p_llm_chat_tpu.utils import failpoints
+    backends: list = []
+
+    def factory(i):
+        b = SessionTierLLM()
+        backends.append(b)
+        return b
+
+    rt, reps = _fleet(2, backend_factory=factory)
+    try:
+        backends[0].tier.insert(_parked_session("sid:sticky"))
+        failpoints.arm("serve.kv_tier.export", "raise")
+        try:
+            st, body = http_json("POST", f"{rt.url}/admin/drain",
+                                 {"replica": 0})
+        finally:
+            failpoints.disarm_all()
+        assert st == 200
+        assert body["migration"]["migrated"] == 0
+        assert body["migration"]["failed"] == 1
+        # Both replicas consistent: source retains, destination clean.
+        assert "sid:sticky" in backends[0].tier.sessions_meta()
+        assert backends[1].tier.sessions_meta() == {}
+        snap = _router_metrics(rt)
+        assert snap["router_migration_failures_total"] == 1.0
+        assert snap["kv_sessions_migrated_total"] == 0.0
+        # The client never sees any of it.
+        out = _gen(rt.url, "still serving after failed export\n\nReply:")
+        assert out["done"] is True
+    finally:
+        _stop(rt, reps)
+
+
+def test_dead_replica_counts_lost_sessions_and_rehomes():
+    """Replica death: the ledger counts the replica's LAST-SCRAPED open
+    sessions (the KV that actually existed — not the LRU-bounded
+    affinity entries), affinity entries homed on it drop (follow-ups
+    rebalance and cold re-prefill — never an error)."""
+    backends: list = []
+
+    def factory(i):
+        b = SessionTierLLM()
+        backends.append(b)
+        return b
+
+    rt, reps = _fleet(2, backend_factory=factory)
+    try:
+        _gen(rt.url, "pin me\n\nReply:", session="doomed-1")
+        with rt._mu:
+            home = rt._sessions["doomed-1"]
+        # One real parked session on the home replica, observed by the
+        # scrape loop before the death (the ledger's evidence).
+        backends[home].tier.insert(_parked_session("sid:doomed-1"))
+        home_rep = next(r for r in rt._replica_snapshot()
+                        if r.index == home)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with rt._mu:
+                seen = home_rep.sessions or ()
+            if "sid:doomed-1" in seen:
+                break
+            time.sleep(0.05)
+        assert "sid:doomed-1" in seen, "scrape never observed the session"
+        reps[home].stop()                      # the home replica dies
+        # The follow-up turn must still complete, on the survivor.
+        out = _gen(rt.url, "follow-up\n\nReply:", session="doomed-1")
+        assert out["done"] is True
+        deadline = time.monotonic() + 5.0
+        lost = 0.0
+        while time.monotonic() < deadline:
+            lost = _router_metrics(rt).get("kv_sessions_lost_total", 0.0)
+            if lost >= 1.0:
+                break
+            time.sleep(0.05)
+        assert lost == 1.0                     # the real session, once
+        with rt._mu:
+            assert rt._sessions.get("doomed-1") != home
+    finally:
+        rt.stop()
+        for r in reps:
+            try:
+                r.stop()
+            except Exception:          # noqa: BLE001 — already stopped
+                pass
+
+
+def test_autoscaler_scales_up_then_down_via_drain():
+    """The queue-driven autoscaler: sustained backpressure spawns a
+    replica (counted, fleet grows, new replica takes traffic once
+    ready); an idle fleet retires the spawned one through
+    drain-as-migration (counted, fleet shrinks, only spawner-owned
+    replicas are victims)."""
+    from p2p_llm_chat_tpu.serve.router import Autoscaler
+
+    class DepthLLM(FakeLLM):
+        def __init__(self):
+            super().__init__(name="rep")
+            self.depth = 50.0
+
+        def metrics_snapshot(self):
+            return {"serve_queue_depth": self.depth}
+
+    base = DepthLLM()
+    spawned: list = []
+
+    def spawn():
+        srv = OllamaServer(FakeLLM(name="rep"), addr="127.0.0.1:0").start()
+        spawned.append(srv)
+        return srv.url
+
+    retired: list = []
+
+    def retire(url):
+        retired.append(url)
+        for s in spawned:
+            if s.url == url:
+                s.stop()
+
+    rt, reps = _fleet(1, backend_factory=lambda i: base, scrape_ms=50)
+    rt.attach_autoscaler(Autoscaler(
+        spawn_fn=spawn, retire_fn=retire,
+        can_retire_fn=lambda url: any(s.url == url for s in spawned),
+        min_replicas=1, max_replicas=2, up_q=4.0, down_q=0.5, sustain=2))
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            _, body = http_json("GET", f"{rt.url}/admin/replicas")
+            if len(body["replicas"]) == 2:
+                break
+            time.sleep(0.05)
+        assert len(body["replicas"]) == 2, "never scaled up"
+        assert len(spawned) == 1
+        snap = _router_metrics(rt)
+        assert snap["router_autoscale_up_total"] == 1.0
+        # Pressure collapses: the fleet idles down to min, retiring the
+        # SPAWNED replica (boot upstreams are the operator's).
+        base.depth = 0.0
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            _, body = http_json("GET", f"{rt.url}/admin/replicas")
+            if len(body["replicas"]) == 1:
+                break
+            time.sleep(0.05)
+        assert len(body["replicas"]) == 1, "never scaled down"
+        assert retired == [spawned[0].url]
+        assert body["replicas"][0]["index"] == 0   # the boot replica stays
+        snap = _router_metrics(rt)
+        assert snap["router_autoscale_down_total"] == 1.0
+        # Still serving throughout.
+        assert _gen(rt.url, "post scale\n\nReply:")["done"] is True
+    finally:
+        _stop(rt, reps)
+        for s in spawned:
+            try:
+                s.stop()
+            except Exception:          # noqa: BLE001 — may be stopped
+                pass
