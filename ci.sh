@@ -102,6 +102,15 @@ if [ "${1:-}" = "full" ]; then
   echo "== session migration: matrix + drain-under-live-load chaos (CPU)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_migration.py -q || rc=1
 
+  # Disaggregated prefill/decode (round 14): the WHOLE file including
+  # the slow-marked two-OS-process handoff matrix and the chaos leg —
+  # a 1-prefill + 2-decode fleet under live loadgen with
+  # serve.disagg.handoff=raise@0.3 armed (zero client errors, zero
+  # session loss, zero admission chunks on decode replicas). Excluded
+  # from the sweep below so each case executes exactly once.
+  echo "== disaggregated serving: matrix + handoff chaos under load (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_disagg.py -q || rc=1
+
   # Loadgen: the WHOLE file including the slow-marked 4-peer end-to-end
   # leg (directory + CPU-tiny engine + node/UI waves through
   # tools/e2e_bench.py, failpoints armed at low probability, durable
@@ -131,6 +140,7 @@ if [ "${1:-}" = "full" ]; then
     --ignore=tests/test_router.py \
     --ignore=tests/test_kv_tier.py \
     --ignore=tests/test_migration.py \
+    --ignore=tests/test_disagg.py \
     --ignore=tests/test_loadgen.py \
     --ignore=tests/test_devcrypto.py || rc=1
 else
@@ -210,6 +220,20 @@ else
   JAX_PLATFORMS=cpu python -m pytest tests/test_migration.py -q -x \
     -m 'not slow' || rc=1
 
+  # Disaggregated prefill/decode serving (round 14, tier-1 legs):
+  # class-flag parsing, pool routing with the mixed fallback + 501
+  # memo, the class re-resolution regression (same port, new role),
+  # per-class autoscale up/down with spawner-owned victims, and the
+  # combined 2-engine byte-identity oracle (engine-level AND through
+  # the real router; explicit sid + anonymous head-hash) with
+  # handoff-failure degradation under serve.disagg.handoff. The
+  # two-OS-process matrix + the chaos-under-load leg are slow-marked
+  # into full mode. Excluded from the sweep below so each case
+  # executes exactly once.
+  echo "== disaggregated serving: byte-identity + pool contracts (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_disagg.py -q -x \
+    -m 'not slow' || rc=1
+
   # Loadgen stub-server contracts (tier-1 legs): seeded schedule
   # determinism, scenario-mix proportions, SLO-ledger percentile math,
   # shed-vs-error-vs-truncated classification, the open-loop property,
@@ -228,6 +252,7 @@ else
     --ignore=tests/test_router.py \
     --ignore=tests/test_kv_tier.py \
     --ignore=tests/test_migration.py \
+    --ignore=tests/test_disagg.py \
     --ignore=tests/test_spec_draft.py \
     --ignore=tests/test_fused_decode.py \
     --ignore=tests/test_chunked_prefill.py \

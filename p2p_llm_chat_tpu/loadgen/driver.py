@@ -76,6 +76,11 @@ class TraceRecord:
     shed_ms: Optional[float] = None  # shed: send -> 503 answered
     error: str = ""
     error_kind: str = ""             # http | conn | timeout | stream
+    # Phase attribution (disagg_session): first-delta latency and
+    # inter-delta gaps per Step.phase tag, so the ledger can split an
+    # SLO miss by prefill vs decode (report.py phase_slos).
+    phase_ttft_ms: dict = field(default_factory=dict)
+    phase_itl_ms: dict = field(default_factory=dict)
 
     def slo_ttft_ms(self) -> Optional[float]:
         """TTFT as the SLO sees it: queue lag included, so a saturated
@@ -162,8 +167,14 @@ class LoadDriver:
 
     # -- request execution -------------------------------------------------
 
-    def _post(self, step: Step):
-        data = json.dumps(step.payload).encode()
+    def _post(self, step: Step, carry: Optional[dict] = None):
+        payload = step.payload
+        if step.use_context and carry and carry.get("context"):
+            # Ollama stateless continuation: the prior step's final
+            # record ids ride back in — the only request shape whose
+            # follow-up token ids EXTEND a parked/migrated session.
+            payload = {**payload, "context": carry["context"]}
+        data = json.dumps(payload).encode()
         headers = {"Content-Type": "application/json"}
         if step.session:
             headers["X-Session-Id"] = step.session
@@ -171,15 +182,20 @@ class LoadDriver:
                                      method="POST")
         return urllib.request.urlopen(req, timeout=self._timeout_s)
 
-    def _run_step(self, step: Step, rec: TraceRecord) -> bool:
+    def _run_step(self, step: Step, rec: TraceRecord,
+                  carry: Optional[dict] = None) -> bool:
         """Execute one step; fill ``rec`` if measured (always on
-        failure). Returns False to abort the remaining steps."""
+        failure). ``carry`` is the plan's context round-trip state
+        (Step.carry_context/use_context). Returns False to abort the
+        remaining steps."""
         if step.pause_before_s > 0:
             time.sleep(step.pause_before_s)
+        if step.fanout > 1 and step.stream:
+            return self._run_fanout(step, rec)
         t_send = time.monotonic()
         deadline = t_send + self._timeout_s
         try:
-            resp = self._post(step)
+            resp = self._post(step, carry)
         except urllib.error.HTTPError as e:
             lat_ms = (time.monotonic() - t_send) * 1e3
             body = b""
@@ -209,15 +225,65 @@ class LoadDriver:
             return False
 
         try:
-            return self._consume(step, rec, resp, t_send, deadline)
+            return self._consume(step, rec, resp, t_send, deadline,
+                                 carry=carry)
         finally:
             try:
                 resp.close()
             except Exception:   # noqa: BLE001 — teardown only
                 pass
 
+    def _run_fanout(self, step: Step, rec: TraceRecord) -> bool:
+        """The thundering-herd step (group_chat): ``fanout`` identical
+        concurrent streams, judged as ONE unit — the user who triggered
+        N co-pilot suggestions is served when the LAST one starts
+        talking, so TTFT is the worst first-delta across the fan;
+        inter-token gaps concatenate; any failed member fails the whole
+        record with its own classification (a herd that half-sheds is a
+        shed, not a success)."""
+        sub = [TraceRecord(scenario=rec.scenario, peer=rec.peer,
+                           sched_s=rec.sched_s)
+               for _ in range(step.fanout)]
+        one = Step(url=step.url, payload=step.payload, stream=True,
+                   measured=True, session=step.session,
+                   read_delay_s=step.read_delay_s)
+
+        def fan(r: TraceRecord) -> None:
+            try:
+                self._run_step(one, r)
+            except Exception as e:   # noqa: BLE001 — never lose a member
+                r.status = "error"
+                r.error_kind = "driver"
+                r.error = str(e)
+
+        threads = [threading.Thread(target=fan, args=(r,))
+                   for r in sub[1:]]
+        for th in threads:
+            th.start()
+        fan(sub[0])
+        for th in threads:
+            th.join()
+        bad = next((r for r in sub if r.status != "ok"), None)
+        if bad is not None:
+            rec.status = bad.status
+            rec.error, rec.error_kind = bad.error, bad.error_kind
+            rec.retry_after, rec.shed_ms = bad.retry_after, bad.shed_ms
+            return False
+        ttft = max((r.ttft_ms or 0.0) for r in sub)
+        gaps = [g for r in sub for g in r.itl_ms]
+        if step.measured:
+            rec.ttft_ms = ttft
+            rec.itl_ms = gaps
+            rec.tokens = sum(r.tokens for r in sub)
+            rec.total_ms = max((r.total_ms or 0.0) for r in sub)
+        if step.phase:
+            rec.phase_ttft_ms[step.phase] = ttft
+            rec.phase_itl_ms.setdefault(step.phase, []).extend(gaps)
+        return True
+
     def _consume(self, step: Step, rec: TraceRecord, resp,
-                 t_send: float, deadline: float) -> bool:
+                 t_send: float, deadline: float,
+                 carry: Optional[dict] = None) -> bool:
         if not step.stream:
             try:
                 resp.read()
@@ -229,6 +295,10 @@ class LoadDriver:
             if step.measured:
                 rec.ttft_ms = (time.monotonic() - t_send) * 1e3
                 rec.total_ms = rec.ttft_ms
+            if step.phase:
+                # Non-streamed step: the whole answer IS the first byte.
+                rec.phase_ttft_ms[step.phase] = \
+                    (time.monotonic() - t_send) * 1e3
             return True
 
         first: Optional[float] = None
@@ -279,6 +349,9 @@ class LoadDriver:
                             rec.total_ms = (now - t_send) * 1e3
                         return True
                 if obj.get("done"):
+                    if step.carry_context and carry is not None \
+                            and obj.get("context"):
+                        carry["context"] = obj["context"]
                     done = True
                     break
                 if step.read_delay_s > 0:
@@ -315,6 +388,12 @@ class LoadDriver:
             rec.error_kind = "stream"
             rec.error = "done without any delta"
             return False
+        if step.phase and first is not None:
+            # Phase attribution records for EVERY tagged step, measured
+            # or not — turn 1 of disagg_session is unmeasured but its
+            # first-delta latency is exactly the prefill-phase number.
+            rec.phase_ttft_ms[step.phase] = (first - t_send) * 1e3
+            rec.phase_itl_ms.setdefault(step.phase, []).extend(gaps)
         return True
 
     def _execute(self, a: Arrival, target_t: float) -> TraceRecord:
@@ -328,8 +407,9 @@ class LoadDriver:
             rec.error_kind = "build"
             rec.error = str(e)
             return rec
+        carry: dict = {}        # the plan's context round-trip state
         for step in steps:
-            if not self._run_step(step, rec):
+            if not self._run_step(step, rec, carry):
                 break
         return rec
 

@@ -47,8 +47,54 @@ def percentile(xs: list, p: float) -> Optional[float]:
     return xs[min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))]
 
 
+def _judge_phases(recs: list, phase_slos: dict, scale: float,
+                  violations: list) -> dict:
+    """Per-phase latency judgement (disagg_session): aggregate each
+    phase tag's first-delta latencies and inter-delta gaps across the
+    scenario's ok records, judge them against that phase's SLO, and
+    label any violation with the phase — so a miss reads
+    ``phase[prefill]`` (admission/handoff pool) vs ``phase[decode]``
+    (wake/stream pool) instead of one blended number. Latency-only:
+    shed/error fractions stay whole-scenario (a shed has no phase)."""
+    out: dict = {}
+    for phase, slo in sorted(phase_slos.items()):
+        ttfts = [r.phase_ttft_ms[phase] for r in recs
+                 if r.status == "ok" and phase in r.phase_ttft_ms]
+        itls: list = []
+        for r in recs:
+            if r.status == "ok":
+                itls.extend(r.phase_itl_ms.get(phase, ()))
+        p50 = percentile(ttfts, 50)
+        p95 = percentile(ttfts, 95)
+        itl_p95 = percentile(itls, 95)
+        t_p50 = slo.ttft_p50_ms * scale
+        t_p95 = slo.ttft_p95_ms * scale
+        t_itl = (slo.itl_p95_ms * scale
+                 if slo.itl_p95_ms is not None else None)
+        if p50 is not None and p50 > t_p50:
+            violations.append(
+                f"phase[{phase}]: ttft_p50 {p50:.0f} ms > {t_p50:.0f} ms")
+        if p95 is not None and p95 > t_p95:
+            violations.append(
+                f"phase[{phase}]: ttft_p95 {p95:.0f} ms > {t_p95:.0f} ms")
+        if t_itl is not None and itl_p95 is not None and itl_p95 > t_itl:
+            violations.append(
+                f"phase[{phase}]: itl_p95 {itl_p95:.0f} ms > "
+                f"{t_itl:.0f} ms")
+        out[phase] = {
+            "n": len(ttfts),
+            "ttft_p50_ms": round(p50, 1) if p50 is not None else None,
+            "ttft_p95_ms": round(p95, 1) if p95 is not None else None,
+            "itl_p95_ms": (round(itl_p95, 2)
+                           if itl_p95 is not None else None),
+            "slo": {"ttft_p50_ms": t_p50, "ttft_p95_ms": t_p95,
+                    "itl_p95_ms": t_itl},
+        }
+    return out
+
+
 def _judge_scenario(name: str, recs: list, slo: SLO, duration_s: float,
-                    scale: float) -> dict:
+                    scale: float, phase_slos: Optional[dict] = None) -> dict:
     n = len(recs)
     by = {s: sum(1 for r in recs if r.status == s)
           for s in ("ok", "shed", "error", "truncated")}
@@ -105,12 +151,17 @@ def _judge_scenario(name: str, recs: list, slo: SLO, duration_s: float,
             continue
         good += 1
 
+    phases = None
+    if phase_slos:
+        phases = _judge_phases(recs, phase_slos, scale, violations)
+
     bad_kinds: dict = {}
     for r in recs:
         if r.status in ("error", "truncated"):
             k = r.error_kind or r.status
             bad_kinds[k] = bad_kinds.get(k, 0) + 1
     return {
+        "phases": phases,
         "n": n, "ok": by["ok"], "shed": by["shed"], "error": by["error"],
         "truncated": by["truncated"],
         "bad_kinds": bad_kinds,
@@ -137,7 +188,10 @@ def build_ledger(records: list, registry: dict, duration_s: float,
     per: dict = {}
     for name, scen in registry.items():
         recs = [r for r in records if r.scenario == name]
-        per[name] = _judge_scenario(name, recs, scen.slo, duration_s, scale)
+        per[name] = _judge_scenario(name, recs, scen.slo, duration_s,
+                                    scale,
+                                    phase_slos=getattr(scen, "phase_slos",
+                                                       None))
 
     n = len(records)
     ok = sum(1 for r in records if r.status == "ok")

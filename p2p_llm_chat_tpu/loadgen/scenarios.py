@@ -44,6 +44,22 @@ The five registered scenarios map one-to-one onto the ROADMAP's
                 slots — the stream-close discipline) is asserted by the
                 chaos/test layer; the ledger judges only that the
                 streams the client kept were serviced.
+``group_chat``  the thundering herd: ONE inbound node message fans out
+                N concurrent co-pilot suggest streams with identical
+                content (the group-chat shape the prefill pool and the
+                prefix cache exist for). Judged as one unit — TTFT is
+                the WORST first delta across the fan; any failed member
+                fails the record. Serve-only runs fan N identical
+                ``/api/chat`` streams instead.
+``disagg_session`` a two-turn session whose turns ride the
+                prefill→decode handoff on a disaggregated fleet
+                (docs/serving.md Round-14): turn 1 is a NEW
+                conversation (chunk-prefill on the prefill pool +
+                handoff; ``phase="prefill"``), turn 2 extends it after
+                think time (a verify-shaped wake on the decode replica;
+                ``phase="decode"``, the judged step). Per-phase SLOs
+                attribute a miss to the right pool. Plain two-turn
+                session traffic on an undisaggregated fleet.
 =============== ==========================================================
 
 SLO targets default to the CPU dev-profile numbers (this is the profile
@@ -102,6 +118,22 @@ class Step:
     # contract (inflight gauges settle) is asserted elsewhere.
     read_delay_s: float = 0.0
     abort_after_deltas: int = 0
+    # Thundering herd (group_chat): issue this many IDENTICAL streams
+    # concurrently for this one step; the herd is judged as one unit
+    # (worst TTFT across the fan). 0/1 = a plain single request.
+    fanout: int = 0
+    # Phase attribution (disagg_session): tag this step's first-delta
+    # latency under a named phase so the ledger can split SLO misses
+    # by prefill vs decode (report.py judges Scenario.phase_slos).
+    phase: str = ""
+    # Ollama stateless-continuation round trip: ``carry_context``
+    # stashes this step's final-record ``context`` ids;
+    # ``use_context`` injects the stashed ids into this step's payload
+    # — the real-client turn shape, and the ONLY shape whose follow-up
+    # can WAKE a parked/migrated session (the KV tier matches on the
+    # token ids the context carries, not on re-sent text).
+    carry_context: bool = False
+    use_context: bool = False
 
 
 @dataclass(frozen=True)
@@ -123,6 +155,11 @@ class Scenario:
     weight: float
     slo: SLO
     build: Callable[[random.Random, int, Endpoints], list] = field(repr=False)
+    # Optional per-phase SLOs keyed by Step.phase (disagg_session):
+    # judged IN ADDITION to the scenario SLO, so a miss names the
+    # serving phase — prefill-pool admission vs decode-side wake —
+    # instead of one blended number.
+    phase_slos: Optional[dict] = None
 
 
 def slo_scale() -> float:
@@ -253,6 +290,80 @@ def _build_slow_reader(rng: random.Random, peer: int,
                  abort_after_deltas=abort)]
 
 
+GROUP_FANOUT = 3
+
+
+def _build_group_chat(rng: random.Random, peer: int,
+                      ep: Endpoints) -> list:
+    """One inbound message, N concurrent co-pilot suggestions: the
+    group-chat thundering herd. Every fan member carries IDENTICAL
+    content on purpose — that is the shape that stresses the prefill
+    pool (N admissions at once) and rewards the prefix cache (N
+    identical heads). Judged as one unit by the fanout merge in
+    driver.py."""
+    if ep.node_urls and ep.ui_urls:
+        n = len(ep.node_urls)
+        to = (peer + 1) % n
+        user = ep.users[to] if ep.users else f"peer{to:02d}"
+        msg = _chat_text(rng, user)
+        return [
+            Step(url=f"{ep.node_urls[peer]}/send",
+                 payload={"to_username": user, "content": msg}),
+            Step(url=f"{ep.ui_urls[to]}/api/suggest/stream",
+                 payload={"content": msg}, stream=True, measured=True,
+                 fanout=GROUP_FANOUT),
+        ]
+    # Serve-only fallback: a SHORT herd on purpose (~40 byte tokens
+    # rendered) — the group-chat shape is the concurrency, not the
+    # prompt length, and staying under any admission chunk budget keeps
+    # the disagg chaos leg's "zero chunks on decode replicas" assertion
+    # exact even when a racy fan member cold-admits there.
+    msg = (f"[{rng.getrandbits(32):08x}] lunch at "
+           f"{11 + rng.randrange(3)}?")
+    return [Step(url=f"{ep.serve_url}/api/chat",
+                 payload={"messages": [{"role": "user", "content": msg}],
+                          "options": {"num_predict": 8}, "stream": True},
+                 stream=True, measured=True, fanout=GROUP_FANOUT)]
+
+
+def _build_disagg_session(rng: random.Random, peer: int,
+                          ep: Endpoints) -> list:
+    """Two turns under one session id, phase-tagged: turn 1 is a NEW
+    conversation — on a disaggregated fleet it chunk-prefills on the
+    prefill pool and rides the handoff (its first-delta latency lands
+    under ``phase="prefill"``, charging prefill + handoff overhead to
+    the right pool); turn 2 extends the prompt after think time, a
+    verify-shaped wake on the decode replica (``phase="decode"``, the
+    judged step). On an undisaggregated fleet this is ordinary two-turn
+    session traffic — the phases still record, just both served by the
+    same pool."""
+    sid = f"disagg-{peer}-{rng.getrandbits(32):08x}"
+    # ~120 byte-level tokens: above a 64-token prefill-chunk budget
+    # (the chaos leg pins "admission chunks stay on the prefill pool"
+    # with it), while keeping the session shallow enough that the
+    # post-handoff wake fits small test engines' 256-token budget (the
+    # wake suffix rounds UP to the smallest warmed bucket, so session
+    # depth + 64 must stay inside max_seq).
+    base = (f"[{sid}] Compare the three candidate venues on cost, "
+            "capacity and transit access, then pick exactly one.")
+    return [
+        Step(url=f"{ep.serve_url}/api/generate",
+             payload={"prompt": base, "options": {"num_predict": 8},
+                      "stream": True},
+             stream=True, session=sid, phase="prefill",
+             carry_context=True),
+        # Turn 2 sends ONLY the new text plus the turn-1 context ids —
+        # the real-client continuation shape, and the one whose token
+        # ids extend the migrated session so the decode replica WAKES
+        # it instead of re-prefilling the history.
+        Step(url=f"{ep.serve_url}/api/generate",
+             payload={"prompt": " Now justify that pick briefly.",
+                      "options": {"num_predict": 8}, "stream": True},
+             stream=True, session=sid, measured=True, phase="decode",
+             pause_before_s=0.4, use_context=True),
+    ]
+
+
 # ---------------------------------------------------------------------------
 # registry
 # ---------------------------------------------------------------------------
@@ -292,6 +403,30 @@ REGISTRY: dict = {
                  slo=SLO(ttft_p50_ms=5000, ttft_p95_ms=15000,
                          itl_p95_ms=None, max_shed_frac=0.25),
                  build=_build_slow_reader),
+        # The thundering herd (round 14): TTFT is the WORST of the N
+        # concurrent fan members, so the target is wider than a single
+        # stream's; the shed budget too (a saturated herd legitimately
+        # sheds some of its fan).
+        Scenario("group_chat", weight=0.5,
+                 slo=SLO(ttft_p50_ms=6000, ttft_p95_ms=18000,
+                         itl_p95_ms=2000, max_shed_frac=0.3),
+                 build=_build_group_chat),
+        # Disaggregated session (round 14): judged on the turn-2 wake;
+        # the per-phase SLOs split misses by pool — prefill's budget is
+        # wider (it carries the chunked prefill AND the handoff), the
+        # decode phase holds the tight wake number. The prefill phase
+        # judges no itl: its stream's gaps belong to whichever pool
+        # decoded turn 1, not to admission.
+        Scenario("disagg_session", weight=0.5,
+                 slo=SLO(ttft_p50_ms=5000, ttft_p95_ms=15000,
+                         itl_p95_ms=2000, max_shed_frac=0.3),
+                 build=_build_disagg_session,
+                 phase_slos={
+                     "prefill": SLO(ttft_p50_ms=8000, ttft_p95_ms=20000,
+                                    itl_p95_ms=None, max_shed_frac=0.3),
+                     "decode": SLO(ttft_p50_ms=5000, ttft_p95_ms=15000,
+                                   itl_p95_ms=2000, max_shed_frac=0.3),
+                 }),
     )
 }
 

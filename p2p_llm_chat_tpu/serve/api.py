@@ -65,8 +65,19 @@ def render_chat_prompt(messages: list[dict], backend: Backend) -> str:
 
 class OllamaServer:
     def __init__(self, backend: Backend, addr: Optional[str] = None,
-                 registry: Optional[Registry] = None) -> None:
+                 registry: Optional[Registry] = None,
+                 replica_class: Optional[str] = None) -> None:
         self.backend = backend
+        # Disaggregated serving (serve/disagg.py round 14): this
+        # replica's declared role, advertised on /readyz and /metrics
+        # so the router's scrape loop sorts it into the right pool.
+        from .disagg import REPLICA_CLASSES, replica_class_from_env
+        self.replica_class = (replica_class if replica_class is not None
+                              else replica_class_from_env())
+        if self.replica_class not in REPLICA_CLASSES:
+            raise ValueError(f"replica_class must be one of "
+                             f"{REPLICA_CLASSES}, got "
+                             f"{self.replica_class!r}")
         # Eager FAIL_POINTS parse: a malformed chaos config must fail
         # HERE, at boot, not as a ValueError at some arbitrary deep
         # failpoint() mid-serving (where it would surface as one buried
@@ -143,6 +154,12 @@ class OllamaServer:
         self.router.add("POST", "/admin/session/forget", self._session_forget)
         self.router.add("POST", "/admin/session/park_all",
                         self._session_park_all)
+        # Disaggregated prefill (serve/disagg.py round 14): the router
+        # sends a NEW conversation's request here on a prefill-class
+        # replica; the backend chunk-prefills it to a parked session a
+        # decode replica then pulls over /admin/session.
+        self.router.add("POST", "/admin/disagg/prefill",
+                        self._disagg_prefill)
         self._server: Optional[HttpServer] = None
 
     # -- helpers -------------------------------------------------------------
@@ -153,8 +170,9 @@ class OllamaServer:
         answer; backends without it (FakeLLM) are ready when live.
         Draining (the replica-router retire path) is not-ready with its
         own status so an operator can tell it from warming."""
+        cls = self.replica_class
         if self._draining.is_set():
-            return Response(503, {"status": "draining"},
+            return Response(503, {"status": "draining", "class": cls},
                             headers={"Retry-After": "5"})
         fn = getattr(self.backend, "ready", None)
         try:
@@ -163,8 +181,8 @@ class OllamaServer:
             log.exception("readiness probe failed")
             ok = False
         if ok:
-            return Response(200, {"status": "ready"})
-        return Response(503, {"status": "warming"},
+            return Response(200, {"status": "ready", "class": cls})
+        return Response(503, {"status": "warming", "class": cls},
                         headers={"Retry-After": "2"})
 
     def _drain(self, req: Request) -> Response:
@@ -246,6 +264,12 @@ class OllamaServer:
                 for site, n in sorted(fp.items()))
         text += ("# TYPE retry_attempts_total counter\n"
                  f"retry_attempts_total {_backoff.retries_total()}\n")
+        # Replica class (serve/disagg.py): a constant 1-gauge labeled
+        # with this replica's role — the scrape-side mirror of the
+        # /readyz "class" field, so pool membership is also visible to
+        # any plain Prometheus scraper.
+        text += ("# TYPE serve_replica_class gauge\n"
+                 f'serve_replica_class{{class="{self.replica_class}"}} 1\n')
         return Response(200, text, content_type="text/plain; version=0.0.4")
 
     def _finalize_record(self, model: str, stats: RequestStats,
@@ -691,6 +715,93 @@ class OllamaServer:
         be.session_park_all()
         return Response(200, {"status": "parked",
                               "sessions": be.session_list() or {}})
+
+    # -- disaggregated prefill (serve/disagg.py round 14) --------------------
+
+    def _disagg_prefill(self, req: Request) -> Response:
+        """POST /admin/disagg/prefill {"path", "body"}: run the wrapped
+        generate/chat request's chunked prefill to completion and
+        retain its KV as an exportable session (the prefill side of the
+        prefill→decode handoff). The prompt is rendered EXACTLY as the
+        real endpoint would render it — same chat template, same
+        context rules — so the decode replica's normalization of the
+        original request matches the parked token ids. Answers:
+
+        - 200 ``{"key", "len", "parked"}`` — parked, ready to pull;
+        - 422 — this request cannot ride the handoff (too short to
+          index, no session retained): route it un-disaggregated;
+        - 501 — this backend has no prefill-park surface (FakeLLM,
+          tiering off): the router stops asking;
+        - 503 — draining/saturated, the ordinary shed contract."""
+        # Fast 501 for backends that can never park (FakeLLM): the
+        # router memoizes it and stops asking. Multi-model fronts pass
+        # through — their per-model ENGINES carry the surface, checked
+        # after resolution below.
+        if (getattr(self.backend, "prefill_park", None) is None
+                and getattr(self.backend, "for_model", None) is None):
+            return Response(501, {"error": "no disagg prefill surface"})
+        shed = self._shed_if_draining(count=False)
+        if shed is not None:
+            return shed
+        try:
+            outer = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        if not isinstance(outer, dict):
+            return Response(400, {"error": "request body must be an "
+                                           "object"})
+        path = str(outer.get("path") or "/api/generate")
+        body = outer.get("body")
+        if not isinstance(body, dict):
+            return Response(400, {"error": "need a body object"})
+        model = str(body.get("model") or self.backend.name)
+        backend = self._resolve(model)
+        context: tuple = ()
+        if path == "/api/chat":
+            messages = body.get("messages") or []
+            if not isinstance(messages, list):
+                return Response(400, {"error": "messages must be a list"})
+            prompt = render_chat_prompt(messages, backend)
+        else:
+            prompt = str(body.get("prompt") or "")
+            raw_ctx = body.get("context") or ()
+            if not (isinstance(raw_ctx, (list, tuple))
+                    and all(type(t) is int and 0 <= t < 2 ** 31
+                            for t in raw_ctx)):
+                return Response(400, {"error": "context must be a list "
+                                               "of non-negative token "
+                                               "ids"})
+            context = tuple(raw_ctx)
+        session = str(body.get("session") or "")
+        if not session:
+            session = str(req.headers.get("x-session-id") or "")
+        greq = GenerateRequest(
+            prompt=prompt, model=model,
+            options=GenerateOptions.from_ollama(body.get("options")),
+            context=context, session=session)
+        fn = getattr(backend, "prefill_park", None)
+        sl = getattr(backend, "session_list", None)
+        if fn is None or sl is None or sl() is None:
+            # No surface or no KV tier on the resolved engine: a
+            # PERMANENT answer — 501 lets the router memoize instead of
+            # re-asking per conversation (422 below is per-request).
+            return Response(501, {"error": "no disagg prefill surface"})
+        try:
+            meta = fn(greq)
+        except OverloadError as e:
+            return Response(
+                503, {"error": str(e)},
+                headers={"Retry-After": str(max(1,
+                                                round(e.retry_after_s)))})
+        except Exception as e:  # noqa: BLE001 — a failed prefill is a 500
+            self._m_errors.inc()
+            log.exception("disagg prefill failed")
+            return Response(500, {"error": str(e)})
+        if meta is None:
+            return Response(422, {"error": "request cannot ride the "
+                                           "handoff (unindexable or "
+                                           "prefill not retained)"})
+        return Response(200, {"status": "parked", **meta})
 
     def _unsupported(self, req: Request) -> Response:
         return Response(501, {

@@ -322,6 +322,14 @@ class TPUEngine:
     def session_park_all(self) -> None:
         self.scheduler.park_all()
 
+    def prefill_park(self, req: GenerateRequest):
+        """Disaggregated serving (serve/disagg.py round 14): run this
+        request's chunked prefill to completion and retain the KV as an
+        exportable session — the decode replica pulls it and samples
+        the first token there. None = not parkable (the router routes
+        the request un-disaggregated)."""
+        return self.scheduler.prefill_park(req)
+
     def drain(self) -> None:
         """Replica drain hook (serve/router.py): finish in-flight
         streams, refuse new sessions, report not-ready on /readyz."""

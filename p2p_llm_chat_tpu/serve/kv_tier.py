@@ -72,6 +72,22 @@ _WIRE_VERSION = 1
 HEAD_GRAIN = 32
 
 
+def head_key(ids) -> Optional[str]:
+    """The anonymous session index key: ``head:`` + sha1 over the
+    NATIVE int64 bytes of the first HEAD_GRAIN token ids, or None when
+    too short to index. THE single derivation — the scheduler's
+    retention key, the router's affinity key, and the disagg prefill
+    key all call this, so a migrated/handed-off session's key can never
+    drift from the one a follow-up turn derives."""
+    if len(ids) < HEAD_GRAIN:
+        return None
+    import hashlib
+
+    import numpy as np
+    return "head:" + hashlib.sha1(np.asarray(
+        ids[:HEAD_GRAIN], np.int64).tobytes()).hexdigest()[:16]
+
+
 def cost_evict(items: list[tuple], over_bytes: float,
                now: Optional[float] = None) -> list:
     """Pick victims until at least ``over_bytes`` bytes are freed.

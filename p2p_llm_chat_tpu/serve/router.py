@@ -60,6 +60,22 @@ Routing policy (backpressure-aware, built on the PR-5 overload signals):
   depth per eligible replica above the up-threshold, or any replica
   shedding) and retires them when the fleet idles — retirement goes
   through drain-as-migration, so scaling down is invisible to clients.
+- **Disaggregated prefill/decode** (round 14, serve/disagg.py):
+  replicas advertise a class (``SERVE_REPLICA_CLASS``) on ``/readyz``;
+  the scrape loop re-resolves it on EVERY pass (a replica restarted on
+  the same port with a new role is a different pool member — pinning
+  the first-seen class was the round-14 pool-membership bug). With
+  both a prefill and a decode pool eligible, a NEW conversation first
+  rides the handoff: the least-loaded prefill replica chunk-prefills
+  it to a parked session (``/admin/disagg/prefill``), the least-loaded
+  decode replica pulls the payload over the PR 11 ``/admin/session``
+  path, affinity flips with the ack, and the original request then
+  streams from the decode replica — its verify-shaped wake samples the
+  first token, byte-identical to a never-disaggregated run. Any failed
+  handoff step degrades to finishing the request on the prefill
+  replica (which wakes its own parked copy) — counted on
+  ``disagg_handoff_failures_total``, never a client-visible error; an
+  empty pool falls back to classic mixed routing.
 
 ``/metrics`` aggregates every replica's scrape — per-replica series get
 a ``replica="i"`` label merged with the same brace-block discipline
@@ -94,7 +110,6 @@ from __future__ import annotations
 
 import hashlib
 import json
-import struct
 import threading
 import time
 import urllib.error
@@ -110,7 +125,9 @@ from ..utils.failpoints import failpoint
 from ..utils.http import HttpServer, Request, Response, Router
 from ..utils.log import get_logger
 from ..utils.metrics import Registry
+from . import disagg as _disagg
 from .kv_tier import HEAD_GRAIN
+from .kv_tier import head_key as _head_key
 
 log = get_logger("serve.router")
 
@@ -180,6 +197,14 @@ class _Replica:
     routed: int = 0
     retried_to: int = 0
     last_scrape_s: float = 0.0
+    # Disaggregated serving (serve/disagg.py): the replica's declared
+    # class, re-resolved from /readyz on EVERY scrape pass — a replica
+    # restarted on the same port with a new role must change pools.
+    cls: str = "mixed"
+    # Decode-pool pressure inputs (ClassAutoscaler): in-flight streams
+    # and decode-slot occupancy, scraped alongside queue depth.
+    inflight_streams: float = 0.0
+    occupancy: float = 0.0
     # Ever answered a scrape: distinguishes a WARMING spawn (never
     # alive yet — counts toward autoscale capacity) from a DEAD replica
     # (was alive, stopped answering — must not block a replacement).
@@ -197,7 +222,7 @@ class _Replica:
                 "queue_depth": self.queue_depth,
                 "inflight": self.inflight, "routed": self.routed,
                 "retried_to": self.retried_to,
-                "shedding": self.shedding}
+                "shedding": self.shedding, "class": self.cls}
 
 
 class _Upstream:
@@ -325,6 +350,25 @@ class ReplicaRouter:
         self._m_scale_up = self.metrics.counter("router_autoscale_up_total")
         self._m_scale_down = self.metrics.counter(
             "router_autoscale_down_total")
+        # Disaggregated prefill/decode (round 14, serve/disagg.py): the
+        # handoff ledger — completed prefill→decode handoffs, their
+        # wall (prefill dispatch + pull + ack), and failed handoffs
+        # (degraded to the prefill replica, never a client error).
+        self._m_handoffs = self.metrics.counter("disagg_handoffs_total")
+        self._m_handoff_failures = self.metrics.counter(
+            "disagg_handoff_failures_total")
+        self._m_handoff_ms = self.metrics.histogram("disagg_handoff_ms")
+        # Prefill replicas whose /admin/disagg/prefill answered 501 (no
+        # tier / no surface). NOT permanent, unlike the prefix/session
+        # sets: the memo clears when the replica dies or changes class
+        # — a restart on the same port may have gained a tier, exactly
+        # the symmetry the per-scrape class re-resolution restores.
+        self._disagg_unsupported: set[int] = set()  # guarded-by: _mu
+        # Sessions with a handoff IN FLIGHT: a concurrent identical new
+        # conversation (the group_chat fan shape) must not drive a
+        # second full prefill + pull of the same session — and its
+        # forget must not race the first handoff's export.
+        self._handoff_inflight: set[str] = set()    # guarded-by: _mu
         # How long a drain waits for the replica's in-flight streams to
         # settle before migrating (migration must capture sessions those
         # streams retain at finish).
@@ -441,7 +485,8 @@ class ReplicaRouter:
         for rep in reps:
             if rep.index not in results:
                 continue
-            (ready, depth, shed), sessions = results[rep.index]
+            (ready, depth, shed, cls, instreams, occ), sessions = \
+                results[rep.index]
             now = time.monotonic()
             with self._mu:
                 died = rep.alive and ready is None
@@ -450,8 +495,29 @@ class ReplicaRouter:
                     rep.ever_alive = True
                 rep.ready = bool(ready)
                 rep.last_scrape_s = now
+                if died:
+                    # A restart on the same port may return with a
+                    # different posture — the 501 memo must be re-earned
+                    # (same symmetry as the class re-resolution below).
+                    self._disagg_unsupported.discard(rep.index)
+                if cls is not None and cls != rep.cls:
+                    # Re-resolve the class on EVERY scrape, not just the
+                    # first sighting: a replica restarted on the same
+                    # port with a new role (prefill yesterday, decode
+                    # today) is a DIFFERENT pool member — pinning the
+                    # first-seen class kept routing new conversations at
+                    # a replica that no longer runs admission work
+                    # (regression test in tests/test_disagg.py).
+                    log.info("replica %d (%s) class %s -> %s", rep.index,
+                             rep.url, rep.cls, cls)
+                    rep.cls = cls
+                    self._disagg_unsupported.discard(rep.index)
                 if sessions is not _KEEP_SESSIONS:
                     rep.sessions = sessions
+                if instreams is not None:
+                    rep.inflight_streams = instreams
+                if occ is not None:
+                    rep.occupancy = occ
                 if depth is not None:
                     rep.queue_depth = depth
                 if shed is not None:
@@ -496,27 +562,39 @@ class ReplicaRouter:
             return _KEEP_SESSIONS
 
     def _scrape_one(self, url: str):
-        """(ready, queue_depth, shed_total) — ready None = unreachable.
-        The readiness probe and the metrics fetch fail INDEPENDENTLY: a
-        replica whose /readyz just answered 200 stays routable when only
-        its /metrics times out (stale depth/shed values persist) —
-        collapsing that into "unreachable" once idled a healthy replica
-        behind a transient exposition stall."""
+        """(ready, queue_depth, shed_total, cls, inflight_streams,
+        occupancy) — ready None = unreachable. The readiness probe and
+        the metrics fetch fail INDEPENDENTLY: a replica whose /readyz
+        just answered 200 stays routable when only its /metrics times
+        out (stale depth/shed values persist) — collapsing that into
+        "unreachable" once idled a healthy replica behind a transient
+        exposition stall. ``cls`` comes from the /readyz body (both the
+        200 and 503 forms carry it) — None when the replica predates
+        the class field (treated as an unchanged class upstream)."""
+        cls = None
         try:
             req = urllib.request.Request(f"{url}/readyz")
             try:
                 with urllib.request.urlopen(req, timeout=2.0) as r:
                     ready = r.status == 200
+                    body = r.read()
             except urllib.error.HTTPError as e:
+                body = e.read()     # 503 warming/draining: alive, not ready
                 e.close()
-                ready = False       # 503 warming/draining: alive, not ready
+                ready = False
+            try:
+                got = json.loads(body).get("class")
+                if got in _disagg.REPLICA_CLASSES:
+                    cls = got
+            except Exception:   # noqa: BLE001 — classless replica
+                pass
         except Exception:   # noqa: BLE001 — probe failure = unreachable
-            return None, None, None
+            return None, None, None, None, None, None
         try:
             with urllib.request.urlopen(f"{url}/metrics", timeout=2.0) as r:
                 snap = parse_metrics_text(r.read().decode("utf-8", "replace"))
         except Exception:   # noqa: BLE001 — keep stale depth/shed
-            return ready, None, None
+            return ready, None, None, cls, None, None
 
         def total(base: str):
             """Sum the base series across label sets: a multi-model
@@ -528,8 +606,10 @@ class ReplicaRouter:
                     if k == base or k.startswith(base + "{")]
             return sum(vals) if vals else None
 
-        return ready, total("serve_queue_depth"), \
-            total("requests_shed_total")
+        return (ready, total("serve_queue_depth"),
+                total("requests_shed_total"), cls,
+                total("serve_inflight_requests"),
+                total("serve_batch_occupancy"))
 
     def _scrape_loop(self) -> None:
         # Per-replica scrape failures back off implicitly via the fixed
@@ -640,17 +720,26 @@ class ReplicaRouter:
                     self._m_prefix_sync_failures.inc()
                 budget -= 1
 
-    def _eligible(self) -> list[_Replica]:
+    def _eligible(self, cls: Optional[str] = None,
+                  rotate: bool = True) -> list[_Replica]:
         """Replicas that may take NEW work, best-first: ready, not
         draining, ordered by load score (queue depth + router inflight +
         shed penalty). Equal scores tiebreak on a rotating index so a
         burst of instant requests (depth never visibly moves) still
-        spreads across the fleet instead of piling on replica 0."""
+        spreads across the fleet instead of piling on replica 0.
+        ``cls`` filters to one replica class (the disagg pools).
+        ``rotate=False`` for PEEKS (the disagg pool probe, the metrics
+        census): a peek that advanced the rotation alongside the real
+        candidate pick would step it twice per request — with an even
+        fleet size that keeps the parity constant and un-spreads the
+        tiebreak entirely."""
         with self._mu:
-            self._rr += 1
+            if rotate:
+                self._rr += 1
             rot = self._rr
             n = len(self.replicas)
-            cands = [r for r in self.replicas if r.ready and not r.draining]
+            cands = [r for r in self.replicas if r.ready and not r.draining
+                     and (cls is None or r.cls == cls)]
             scored = sorted(
                 cands,
                 key=lambda r: (r.queue_depth + r.inflight
@@ -696,18 +785,15 @@ class ReplicaRouter:
             ids = list(ctx[:HEAD_GRAIN])
             if len(ids) == HEAD_GRAIN and all(
                     type(t) is int for t in ids):
-                # EXACTLY the KV tier's anonymous session key
-                # (serve/scheduler._session_key: sha1 over the native
-                # int64 bytes of the first HEAD_GRAIN prompt ids — a
-                # follow-up's context head IS the session's token
-                # head). Sharing the derivation means a migrated
-                # session's affinity flip — keyed by the tier keys the
-                # source replica lists — rehomes bare /api/generate
-                # continuations too, so anonymous wake follows the
-                # payload to its new replica instead of cold-missing
-                # at the old home.
-                return "head:" + hashlib.sha1(struct.pack(
-                    f"={HEAD_GRAIN}q", *ids)).hexdigest()[:16]
+                # EXACTLY the KV tier's anonymous session key (the
+                # shared kv_tier.head_key derivation — a follow-up's
+                # context head IS the session's token head). Sharing it
+                # means a migrated/handed-off session's affinity flip —
+                # keyed by the tier keys the source replica lists —
+                # rehomes bare /api/generate continuations too, so
+                # anonymous wake follows the payload to its new replica
+                # instead of cold-missing at the old home.
+                return _head_key(ids)
             head = ",".join(str(t) for t in ids)
             return hashlib.sha1(head.encode()).hexdigest()[:16]
         return None
@@ -783,16 +869,28 @@ class ReplicaRouter:
         return Response(upstream.status, stream=passthrough(),
                         content_type=ctype)
 
-    def _try_replicas(self, req: Request,
-                      session: Optional[str]) -> Response:
+    def _try_replicas(self, req: Request, session: Optional[str],
+                      prefer: Optional[_Replica] = None,
+                      avoid_decode: bool = False) -> Response:
         """Route with retry: walk the candidate list (home replica
         first), moving on at a 503 shed or a connection failure. No
         sleeping anywhere on this path — a fully-saturated fleet must
         answer 503 + Retry-After in milliseconds, not after a backoff
         ladder (the CLIENT owns the retry delay; Retry-After tells it
-        how long)."""
+        how long). ``prefer`` jumps one replica to the front (the
+        disagg handoff's destination — or, after a failed handoff, the
+        prefill replica that holds the parked work); ``avoid_decode``
+        stably demotes decode-class replicas for a NEW conversation
+        that could not ride the handoff — admission prefill belongs on
+        the prefill/mixed pools, a decode replica is the last resort."""
         self._m_requests.inc()
-        cands = self._candidates(session)[: self.max_attempts]
+        cands = self._candidates(session)
+        if avoid_decode:
+            cands.sort(key=lambda r: r.cls == "decode")     # stable
+        if prefer is not None:
+            cands = [prefer] + [c for c in cands
+                                if c.index != prefer.index]
+        cands = cands[: self.max_attempts]
         if not cands:
             self._m_shed.inc()
             return Response(
@@ -885,10 +983,122 @@ class ReplicaRouter:
         if not isinstance(body, dict):
             return Response(400, {"error": "request body must be an object"})
         session = self.session_key(req.path, body, req.headers)
-        return self._try_replicas(req, session)
+        with self._mu:
+            is_new = session is None or session not in self._sessions
+        prefer = None
+        disagg_pools = False
+        if is_new:
+            prefer, disagg_pools = self._disagg_route(req, body, session)
+        return self._try_replicas(req, session, prefer=prefer,
+                                  avoid_decode=(is_new and disagg_pools
+                                                and prefer is None))
 
     def _route_any(self, req: Request) -> Response:
         return self._try_replicas(req, None)
+
+    # -- disaggregated prefill/decode (round 14, serve/disagg.py) ------------
+
+    def _disagg_route(self, req: Request, body: dict,
+                      session: Optional[str]):
+        """Hand a NEW conversation across the class pools. Returns
+        ``(prefer, pools)``: ``prefer`` is the replica to try first —
+        the decode destination after a successful handoff (its adopted
+        session wakes there, first token sampled decode-side), or the
+        prefill replica after a FAILED one (it retains the parked work;
+        finishing there is the degradation contract — never a client
+        error); None = classic routing. ``pools`` reports whether both
+        class pools were eligible (the caller demotes decode replicas
+        for un-handed-off new work only when a prefill pool exists).
+        All HTTP runs OFF the router lock."""
+        order = self._eligible(rotate=False)
+        with self._mu:
+            unsupported = set(self._disagg_unsupported)
+        prefills = [r for r in order if r.cls == "prefill"
+                    and r.index not in unsupported]
+        decodes = [r for r in order if r.cls == "decode"]
+        pools = bool(prefills) and bool(decodes)
+        if not pools:
+            return None, bool(prefills) or bool(decodes)
+        P, D = prefills[0], decodes[0]
+        sid = str(req.headers.get("x-session-id")
+                  or body.get("session") or "")
+        # Single-flight per session: the group_chat fan shape lands N
+        # IDENTICAL new conversations concurrently — all sharing one
+        # session key, all seeing is_new before the first affinity flip.
+        # Only the first drives the handoff; the rest route classically
+        # (avoid_decode steers them at the prefill/mixed pools) instead
+        # of racing N prefills and N forgets against each other's
+        # exports. Anonymous /api/generate openers (no key) skip the
+        # guard — they cannot collide on a key either.
+        if session is not None:
+            with self._mu:
+                # Re-check the affinity table UNDER THE SAME LOCK the
+                # guard takes: the caller's is_new snapshot predates
+                # this point, and a concurrent handoff may have flipped
+                # affinity and RELEASED its guard in between — without
+                # the re-check that fan member re-drives a full
+                # prefill + pull for a session that already lives on
+                # its decode home.
+                if session in self._sessions:
+                    # pools=False on purpose: the session has a home
+                    # now, so the caller must follow affinity — the
+                    # avoid_decode demotion would push the (decode)
+                    # home to the back of the candidate list.
+                    return None, False
+                if session in self._handoff_inflight:
+                    return None, pools
+                self._handoff_inflight.add(session)
+        t0 = time.monotonic()
+        with self._mu:
+            P.inflight += 1     # the prefill dispatch is real load
+        try:
+            try:
+                meta = _disagg.drive_handoff(P.url, D.url, req.path,
+                                             body, session=sid,
+                                             timeout_s=self.timeout_s)
+            except _disagg.HandoffUnsupported:
+                with self._mu:
+                    self._disagg_unsupported.add(P.index)
+                log.info("replica %d (%s) has no disagg prefill "
+                         "surface; not asking again", P.index, P.url)
+                return None, pools
+            except Exception as e:  # noqa: BLE001 — HandoffError + rest
+                self._m_handoff_failures.inc()
+                log.warning("disagg handoff %s -> %s failed (%s); "
+                            "finishing on the prefill replica", P.url,
+                            D.url, e)
+                return P, pools
+            if meta is None:
+                return None, pools  # structured can't: classic routing
+            key = str(meta.get("key") or "")
+            # Affinity flips with the ack, under BOTH the tier-derived
+            # key (sid: strips to the raw id; head: matches
+            # session_key's context-head derivation, so the next bare
+            # /api/generate turn follows the payload) and the
+            # router-side session key when it differs (the /api/chat
+            # messages-hash names no tier key). The single-flight
+            # guard releases only AFTER this flip — a fan member
+            # arriving then sees the session as known and follows the
+            # affinity instead of starting a second handoff.
+            akey = key[4:] if key.startswith("sid:") else key
+            with self._mu:
+                for k in {akey, session} - {None, ""}:
+                    self._sessions[k] = D.index
+                    self._sessions.move_to_end(k)
+                while len(self._sessions) > self._session_cap:
+                    self._sessions.popitem(last=False)
+            self._m_handoffs.inc()
+            ms = (time.monotonic() - t0) * 1e3
+            self._m_handoff_ms.observe(ms)
+            log.info("disagg handoff: %s prefilled on replica %d, "
+                     "decoding on replica %d (%.0f ms)", key, P.index,
+                     D.index, ms)
+            return D, pools
+        finally:
+            with self._mu:
+                P.inflight -= 1
+                if session is not None:
+                    self._handoff_inflight.discard(session)
 
     def _readyz(self, req: Request) -> Response:
         """Fleet readiness: ready when ANY replica can take new work."""
@@ -923,6 +1133,21 @@ class ReplicaRouter:
             typeline("router_replica_draining")
             lines.append(f'router_replica_draining{{replica="{idx}"}} '
                          f"{int(draining)}\n")
+        # Disagg pool census: ELIGIBLE members per replica class (the
+        # routing view — a draining or unready replica is not pool
+        # capacity). Always emitted, so a dashboard can alarm on an
+        # empty pool rather than a missing series.
+        pools = {c: 0 for c in _disagg.REPLICA_CLASSES}
+        for r in self._eligible(rotate=False):
+            pools[r.cls] = pools.get(r.cls, 0) + 1
+        # Literal TYPE line (not typeline's f-string): the metrics-
+        # contract analyzer registers the export site from it — the
+        # name sits outside the code-literal suffix grammar.
+        typed.add("router_pool_replicas")
+        lines.append("# TYPE router_pool_replicas gauge\n")
+        for c in _disagg.REPLICA_CLASSES:
+            lines.append(f'router_pool_replicas{{class="{c}"}} '
+                         f"{pools[c]}\n")
         totals: "OrderedDict[str, float]" = OrderedDict()
         with self._mu:
             alive = {r.index: r.alive for r in self.replicas}
@@ -1400,10 +1625,24 @@ class ProcessReplicaSpawner:
     spawned replica is a full-stack engine. Retirement only applies to
     replicas this spawner created; boot upstreams are the operator's."""
 
-    def __init__(self, port_base: Optional[int] = None) -> None:
+    def __init__(self, port_base: Optional[int] = None,
+                 env_extra: Optional[dict] = None,
+                 max_ports: int = 0) -> None:
         self.port_base = (port_base if port_base is not None else
                           env_int("SERVE_ROUTER_AUTOSCALE_PORT_BASE",
                                   11500))
+        # Extra child env (the disagg ClassAutoscaler tags spawns with
+        # SERVE_REPLICA_CLASS through this).
+        self.env_extra = dict(env_extra or {})
+        # Hard bound on the port range this spawner may bind (0 =
+        # unbounded, the single-pool legacy). Crash-killed spawns leak
+        # their port slot (only retire() reaps), so an UNbounded
+        # monotonic walk would eventually cross into a sibling
+        # spawner's range — with per-class spawners on adjacent ranges
+        # that is an Address-already-in-use loop. Bounded, a leaked
+        # range means a skipped spawn (logged; the pressure persists
+        # and the next tick retries), never a cross-range bind.
+        self.max_ports = max_ports
         self._mu = threading.Lock()
         self._n = 0                           # guarded-by: _mu
         self._procs: dict[str, object] = {}   # guarded-by: _mu (url -> Popen)
@@ -1421,14 +1660,23 @@ class ProcessReplicaSpawner:
             if self._free_ports:
                 self._free_ports.sort()
                 port = self._free_ports.pop(0)
+            elif self.max_ports and self._n >= self.max_ports:
+                port = None     # range exhausted by crash-leaked slots
             else:
                 port = self.port_base + self._n
                 self._n += 1
+        if port is None:
+            log.warning("spawner port range [%d, %d) exhausted (crash-"
+                        "killed spawns leak their slot until reaped); "
+                        "skipping this spawn", self.port_base,
+                        self.port_base + self.max_ports)
+            return None
         url = f"http://127.0.0.1:{port}"
         env = {**os.environ,
                "SERVE_ADDR": f"127.0.0.1:{port}",
                "SERVE_ROUTER_UPSTREAMS": "",
-               "SERVE_COORDINATOR": ""}
+               "SERVE_COORDINATOR": "",
+               **self.env_extra}
         try:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "p2p_llm_chat_tpu.serve.api"],
@@ -1492,15 +1740,31 @@ def build_router_from_env() -> ReplicaRouter:
                          "replica URL (comma-separated)")
     router = ReplicaRouter(ups)
     if env_bool("SERVE_ROUTER_AUTOSCALE", False):
-        spawner = ProcessReplicaSpawner()
-        router.attach_autoscaler(Autoscaler(
-            spawn_fn=spawner, retire_fn=spawner.retire,
-            can_retire_fn=spawner.can_retire))
-        log.info("autoscaler armed: %d..%d replicas, up>%.1f req/replica "
-                 "or shedding, down<%.1f, sustain %d passes",
-                 router.autoscaler.min_replicas,
-                 router.autoscaler.max_replicas, router.autoscaler.up_q,
-                 router.autoscaler.down_q, router.autoscaler.sustain)
+        if (env_int("SERVE_PREFILL_REPLICAS", 0)
+                or env_int("SERVE_DECODE_REPLICAS", 0)):
+            # Class-tagged fleet (start_all.py --prefill/--decode): the
+            # pools scale INDEPENDENTLY — prefill on admission-queue
+            # pressure, decode on stream/slot occupancy
+            # (serve/disagg.py policy table in docs/serving.md).
+            router.attach_autoscaler(_disagg.build_class_autoscaler())
+            log.info("per-class autoscaler armed: %d..%d replicas PER "
+                     "CLASS, up>%.1f, down<%.1f, sustain %d passes",
+                     router.autoscaler.min_replicas,
+                     router.autoscaler.max_replicas,
+                     router.autoscaler.up_q, router.autoscaler.down_q,
+                     router.autoscaler.sustain)
+        else:
+            spawner = ProcessReplicaSpawner()
+            router.attach_autoscaler(Autoscaler(
+                spawn_fn=spawner, retire_fn=spawner.retire,
+                can_retire_fn=spawner.can_retire))
+            log.info("autoscaler armed: %d..%d replicas, up>%.1f "
+                     "req/replica or shedding, down<%.1f, sustain %d "
+                     "passes",
+                     router.autoscaler.min_replicas,
+                     router.autoscaler.max_replicas,
+                     router.autoscaler.up_q, router.autoscaler.down_q,
+                     router.autoscaler.sustain)
     return router
 
 

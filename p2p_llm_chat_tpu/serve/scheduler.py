@@ -61,8 +61,8 @@ from ..tokenizer import Tokenizer
 from ..utils.env import env_float
 from ..utils.failpoints import failpoint
 from ..utils.log import get_logger
-from .backend import (GenerateRequest, OverloadError, RequestStats,
-                      normalize_request)
+from .backend import (GenerateOptions, GenerateRequest, OverloadError,
+                      RequestStats, normalize_request)
 from .prefix import PrefixEntry, PrefixStore
 
 log = get_logger("serve.scheduler")
@@ -2555,6 +2555,90 @@ class BatchScheduler:
             return None
         return self._tier.forget(key)
 
+    # -- disaggregated prefill (serve/disagg.py round 14) --------------------
+
+    def prefill_park(self, req: GenerateRequest,
+                     timeout_s: float = 10.0) -> Optional[dict]:
+        """Run this request's prefill WITHOUT sampling its first real
+        token, retaining the KV as an exportable session — the prefill
+        side of the prefill→decode handoff (serve/disagg.py).
+
+        The prompt is normalized EXACTLY like the real admission
+        (context prepend, BOS rule, num_ctx clamp, tail truncation —
+        the decode replica normalizes the same request to the same
+        ids), then a one-token throwaway generation runs over
+        ``ids[:-1]``: the retained session is "prompt + all generated
+        but the last" = ``ids[:-1]`` precisely, so the destination's
+        wake admission forwards the final prompt token and samples the
+        conversation's FIRST real token there, as the first draw of its
+        own per-request seeded RNG — byte-identical to a
+        never-disaggregated run. The throwaway token is discarded here
+        and its sample never touches the real request's RNG.
+
+        Returns ``{"key", "len", "parked"}``, or None when this request
+        cannot ride the handoff (no tier, prompt too short to leave a
+        suffix token, anonymous below the HEAD_GRAIN index grain, or
+        the prefill itself failed — the caller routes the request
+        un-disaggregated). OverloadError propagates: a saturated
+        prefill replica sheds exactly like any admission."""
+        if self._tier is None:
+            return None
+        from .kv_tier import HEAD_GRAIN, head_key
+        try:
+            ids, _, _ = normalize_request(
+                self.tokenizer, self.config.vocab_size, self.max_seq,
+                req, min_bucket=_MIN_BUCKET)
+        except ValueError:
+            return None
+        if len(ids) < 2:
+            return None             # no suffix token would remain
+        if req.session:
+            key = f"sid:{req.session}"
+        elif len(ids) - 1 >= HEAD_GRAIN:
+            # The shared anonymous index derivation — the throwaway's
+            # prompt ids share the head (ids[:-1][:HEAD_GRAIN] ==
+            # ids[:HEAD_GRAIN] because len(ids)-1 >= HEAD_GRAIN here),
+            # so the retained session gets exactly this key.
+            key = head_key(ids)
+        else:
+            return None             # anonymous and unindexable
+        throwaway = GenerateRequest(
+            prompt="", model=req.model,
+            options=GenerateOptions(max_tokens=1, temperature=0.0,
+                                    seed=1, num_ctx=req.options.num_ctx),
+            context=tuple(ids[:-1]), session=req.session)
+        try:
+            for _ in self.submit(throwaway):
+                pass
+        except OverloadError:
+            raise
+        except RuntimeError as e:
+            log.warning("disagg prefill failed (%s); the request runs "
+                        "un-disaggregated", e)
+            return None
+        # Retention runs on the scheduler loop as the slot finishes —
+        # AFTER the stream above closes. Bounded wait, not an event
+        # handshake: the tier index is the single source of truth and
+        # the loop is already obligated to finish the slot. The wait is
+        # satisfied only by the FRESH retention (length exactly
+        # len(ids)-1): a pre-existing session under the same key (a
+        # prior turn whose affinity entry aged out of the router's LRU)
+        # must not be exported as if it were this prefill — the
+        # follow-up would ride a stale payload and re-prefill the delta
+        # as admission work on the decode side.
+        want_len = len(ids) - 1
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            meta = self._tier.sessions_meta().get(key)
+            if meta is not None and meta["len"] == want_len:
+                return {"key": key, "len": meta["len"],
+                        "parked": meta["parked"]}
+            time.sleep(0.01)
+        log.warning("disagg prefill for %s finished but the fresh "
+                    "session (len %d) never appeared in the tier index",
+                    key, want_len)
+        return None
+
     def _session_payload_compatible(self, sess) -> bool:
         """May this imported payload scatter into OUR pool? Shape/dtype
         checks against the live cache — replicas in a fleet are
@@ -3764,15 +3848,9 @@ class BatchScheduler:
         sid = getattr(slot.req, "session", "")
         if sid:
             return f"sid:{sid}"
-        from .kv_tier import HEAD_GRAIN
-        toks = slot.prompt_ids
-        if len(toks) < HEAD_GRAIN:
-            return None
-        import hashlib
+        from .kv_tier import head_key
         # graftcheck: sync-ok host token ids -> bytes for hashing, no device readback
-        h = hashlib.sha1(np.asarray(toks[:HEAD_GRAIN],
-                                    np.int64).tobytes()).hexdigest()[:16]
-        return f"head:{h}"
+        return head_key(slot.prompt_ids)
 
     # graftcheck: runs-on _loop
     def _retain_session(self, slot: _Slot, row: int) -> bool:

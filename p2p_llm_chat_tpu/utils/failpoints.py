@@ -52,6 +52,7 @@ degradation contract):
 ``serve.kv_tier.export``     session-payload serialize for a peer replica
 ``serve.kv_tier.import``     session-payload install from a peer replica
 ``serve.router.migrate``     one session's drain/retire migration step
+``serve.disagg.handoff``     one prefill→decode handoff (router side)
 
 ``p2p.directory.register``   directory client register RPC
 ``p2p.directory.lookup``     directory client lookup RPC
@@ -83,6 +84,7 @@ KNOWN_SITES = (
     "serve.kv_tier.export",
     "serve.kv_tier.import",
     "serve.router.migrate",
+    "serve.disagg.handoff",
     "p2p.directory.register",
     "p2p.directory.lookup",
     "p2p.dht.rpc",

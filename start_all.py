@@ -141,6 +141,19 @@ def main() -> int:
                          "plus the backpressure-aware router on "
                          "serve-port (docs/serving.md Round-10; 0/1 = "
                          "single engine, the default)")
+    ap.add_argument("--prefill", type=int,
+                    default=env_int("SERVE_PREFILL_REPLICAS", 0),
+                    help="disaggregated serving (docs/serving.md "
+                         "Round-14): spawn N prefill-class replicas — "
+                         "new conversations chunk-prefill there, then "
+                         "hand their KV to a decode replica over the "
+                         "migration wire; combine with --decode")
+    ap.add_argument("--decode", type=int,
+                    default=env_int("SERVE_DECODE_REPLICAS", 0),
+                    help="disaggregated serving: spawn M decode-class "
+                         "replicas — they sample every token and never "
+                         "run admission prefill work (their "
+                         "decode_stall_ms stays ~0)")
     ap.add_argument("--autoscale", action="store_true",
                     default=env_int("SERVE_ROUTER_AUTOSCALE", 0) > 0,
                     help="replica mode only: arm the router's queue-"
@@ -161,11 +174,25 @@ def main() -> int:
     args = ap.parse_args()
 
     users = [u.strip() for u in args.users.split(",") if u.strip()]
-    fixed_replicas = args.replicas if args.replicas >= 2 else 0
+    # Class-tagged fleet (--prefill/--decode, docs/serving.md Round-14):
+    # every class replica is an ordinary full-stack serve process whose
+    # env carries SERVE_REPLICA_CLASS; the router discovers the pools
+    # from the /readyz class field. Composes with --replicas (those
+    # spawn as mixed — the compatibility pool).
+    n_class = max(0, args.prefill) + max(0, args.decode)
+    mixed = args.replicas if args.replicas >= 2 or n_class else 0
+    fixed_replicas = mixed + n_class
+    if fixed_replicas == 1:
+        raise SystemExit("a routed fleet needs >= 2 replicas; use "
+                         "--prefill/--decode/--replicas so the class "
+                         "pools plus mixed total at least 2")
     # Autoscaled replicas spawn on ports just above the fixed range —
     # reserve up to the autoscaler's max so a scale-up can't collide
-    # with a node/UI port.
-    scale_room = (env_int("SERVE_ROUTER_AUTOSCALE_MAX", 4)
+    # with a node/UI port. A class fleet scales PER CLASS: two pools,
+    # each with a hard-bounded 4x-ceiling port range (the slack absorbs
+    # crash-leaked slots — serve/disagg.build_class_autoscaler).
+    scale_room = ((env_int("SERVE_ROUTER_AUTOSCALE_MAX", 4)
+                   * (8 if n_class else 1))
                   if args.autoscale and fixed_replicas else 0)
     check_port_ranges(len(users), args.node_port_base, args.ui_port_base,
                       args.dir_port, args.serve_port,
@@ -193,7 +220,7 @@ def main() -> int:
         serve_url = f"http://127.0.0.1:{args.serve_port}"
         spawn("directory", "p2p_llm_chat_tpu.directory",
               {"ADDR": f"127.0.0.1:{args.dir_port}"}, procs)
-        if args.replicas >= 2:
+        if fixed_replicas >= 2:
             # Replica-router serving (docs/serving.md Round-10): N
             # independent full-stack engines on successive ports, the
             # backpressure-aware router on the main serve port — the
@@ -201,31 +228,46 @@ def main() -> int:
             # machine this is the dev/demo profile (fake backend, or
             # tiny configs on CPU); production runs one replica per
             # accelerator host and points SERVE_ROUTER_UPSTREAMS at
-            # them.
+            # them. With --prefill/--decode the fleet is class-tagged
+            # (Round-14 disaggregation): prefill replicas take new
+            # conversations' admission work, decode replicas take the
+            # streams after the KV handoff, mixed ones (--replicas)
+            # remain the compatibility pool.
+            roles = (["prefill"] * max(0, args.prefill)
+                     + ["decode"] * max(0, args.decode)
+                     + ["mixed"] * mixed)
             upstreams = []
-            for i in range(args.replicas):
+            for i, role in enumerate(roles):
                 rport = args.serve_port + 1 + i
                 upstreams.append(f"http://127.0.0.1:{rport}")
-                spawn(f"serve-replica-{i}", "p2p_llm_chat_tpu.serve.api",
+                spawn(f"serve-{role}-{i}", "p2p_llm_chat_tpu.serve.api",
                       {"SERVE_ADDR": f"127.0.0.1:{rport}",
                        "SERVE_BACKEND": args.backend,
-                       # A replica must never inherit router/lockstep
-                       # mode flags from the launcher environment.
+                       # Explicit per-replica role: a mixed replica
+                       # must not inherit a class from the launcher
+                       # environment any more than a replica may
+                       # inherit router/lockstep mode flags.
+                       "SERVE_REPLICA_CLASS": role,
                        "SERVE_ROUTER_UPSTREAMS": "",
                        "SERVE_COORDINATOR": ""}, procs)
             router_env = {"SERVE_ADDR": f"127.0.0.1:{args.serve_port}",
-                          "SERVE_ROUTER_UPSTREAMS": ",".join(upstreams)}
+                          "SERVE_ROUTER_UPSTREAMS": ",".join(upstreams),
+                          "SERVE_REPLICA_CLASS": ""}
             if args.autoscale:
                 # Autoscaled replicas are subprocesses of the ROUTER
                 # (serve/router.py ProcessReplicaSpawner): they inherit
                 # its environment, so the backend choice must ride
                 # along, and their ports sit just above the fixed
-                # replica range (reserved by check_port_ranges).
+                # replica range (reserved by check_port_ranges). The
+                # class counts switch the router to the per-class
+                # autoscaler (serve/disagg.py).
                 router_env.update({
                     "SERVE_ROUTER_AUTOSCALE": "1",
                     "SERVE_ROUTER_AUTOSCALE_PORT_BASE":
-                        str(args.serve_port + 1 + args.replicas),
+                        str(args.serve_port + 1 + fixed_replicas),
                     "SERVE_BACKEND": args.backend,
+                    "SERVE_PREFILL_REPLICAS": str(max(0, args.prefill)),
+                    "SERVE_DECODE_REPLICAS": str(max(0, args.decode)),
                 })
             spawn("serve-router", "p2p_llm_chat_tpu.serve.router",
                   router_env, procs)

@@ -198,6 +198,19 @@ def main() -> int:
     ap.add_argument("--queue-max", type=int, default=-1,
                     help="SERVE_QUEUE_MAX override (sizes the shed "
                          "edge; -1 = server auto)")
+    ap.add_argument("--replicas", type=int,
+                    default=env_int("SERVE_REPLICAS", 0),
+                    help="mixed-replica fleet: N >= 2 serve processes "
+                         "behind the router (start_all.py --replicas)")
+    ap.add_argument("--prefill", type=int,
+                    default=env_int("SERVE_PREFILL_REPLICAS", 0),
+                    help="disaggregated fleet: N prefill-class replicas "
+                         "(start_all.py --prefill; docs/serving.md "
+                         "Round-14)")
+    ap.add_argument("--decode", type=int,
+                    default=env_int("SERVE_DECODE_REPLICAS", 0),
+                    help="disaggregated fleet: M decode-class replicas "
+                         "(start_all.py --decode)")
     ap.add_argument("--suggest-predict", type=int, default=24,
                     help="UI_SUGGEST_PREDICT for the launched UIs: token "
                          "bound on co-pilot suggestions (0 = reference "
@@ -225,6 +238,14 @@ def main() -> int:
             "backend": args.backend, "rate_rps": args.rate,
             "seed": args.seed, "mix": args.mix or "default",
             "chaos_spec": args.chaos or None,
+            # Class topology: disagg rows must be distinguishable from
+            # mixed rows at a glance (docs/serving.md Round-14) — a
+            # decode_stall_ms ~0 claim means nothing without the fleet
+            # shape that produced it.
+            "topology": ({"prefill": args.prefill, "decode": args.decode,
+                          "mixed": args.replicas}
+                         if (args.prefill or args.decode)
+                         else {"mixed": args.replicas or 1}),
             "path": "UI HTTP -> serve front -> scheduler -> chip; "
                     "node /send -> encrypted stream -> peer inbox"}
 
@@ -305,15 +326,22 @@ def main() -> int:
     if args.workload == "quote" and args.backend == "tpu":
         build_quote_checkpoint(args.config, env)
 
+    launch_cmd = [sys.executable, os.path.join(REPO, "start_all.py"),
+                  "--backend", args.backend, "--users", ",".join(users),
+                  "--node-port-base", str(args.node_base),
+                  "--ui-port-base", str(args.ui_base),
+                  "--dir-port", str(args.dir_port),
+                  "--serve-port", str(args.serve_port),
+                  "--boot-wave", str(args.boot_wave)]
+    if args.replicas:
+        launch_cmd += ["--replicas", str(args.replicas)]
+    if args.prefill:
+        launch_cmd += ["--prefill", str(args.prefill)]
+    if args.decode:
+        launch_cmd += ["--decode", str(args.decode)]
     launcher = subprocess.Popen(
-        [sys.executable, os.path.join(REPO, "start_all.py"),
-         "--backend", args.backend, "--users", ",".join(users),
-         "--node-port-base", str(args.node_base),
-         "--ui-port-base", str(args.ui_base),
-         "--dir-port", str(args.dir_port),
-         "--serve-port", str(args.serve_port),
-         "--boot-wave", str(args.boot_wave)],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        launch_cmd, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
     # Drain launcher output (an undrained PIPE fills and BLOCKS the
     # launcher mid-boot); keep a tail for diagnostics.
     tail: list[bytes] = []
