@@ -111,6 +111,14 @@ if [ "${1:-}" = "full" ]; then
   echo "== disaggregated serving: matrix + handoff chaos under load (CPU)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_disagg.py -q || rc=1
 
+  # grafttrace (round 15): the WHOLE file including the slow-marked
+  # two-replica fleet propagation leg (router-merged timeline across a
+  # disagg handoff) and the dump-on-stall leg under the armed
+  # serve.scheduler.dispatch=delay failpoint. Excluded from the sweep
+  # below so each case executes exactly once.
+  echo "== grafttrace: fleet propagation + flight recorder (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q || rc=1
+
   # Loadgen: the WHOLE file including the slow-marked 4-peer end-to-end
   # leg (directory + CPU-tiny engine + node/UI waves through
   # tools/e2e_bench.py, failpoints armed at low probability, durable
@@ -141,6 +149,7 @@ if [ "${1:-}" = "full" ]; then
     --ignore=tests/test_kv_tier.py \
     --ignore=tests/test_migration.py \
     --ignore=tests/test_disagg.py \
+    --ignore=tests/test_trace.py \
     --ignore=tests/test_loadgen.py \
     --ignore=tests/test_devcrypto.py || rc=1
 else
@@ -234,6 +243,16 @@ else
   JAX_PLATFORMS=cpu python -m pytest tests/test_disagg.py -q -x \
     -m 'not slow' || rc=1
 
+  # grafttrace (round 15, tier-1 legs): header parse/mint + sampling
+  # determinism units, bounded-store FIFO eviction, flight-ring wrap +
+  # dump atomicity, and breach attribution over dict timelines — no
+  # engine, no sockets. The fleet-propagation and dump-on-stall legs
+  # are slow-marked into full mode (the 870 s tier-1 budget is thin).
+  # Excluded from the sweep below so each case executes exactly once.
+  echo "== grafttrace: wire contract + ring units (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -x \
+    -m 'not slow' || rc=1
+
   # Loadgen stub-server contracts (tier-1 legs): seeded schedule
   # determinism, scenario-mix proportions, SLO-ledger percentile math,
   # shed-vs-error-vs-truncated classification, the open-loop property,
@@ -247,6 +266,7 @@ else
 
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
+    --ignore=tests/test_trace.py \
     --ignore=tests/test_loadgen.py \
     --ignore=tests/test_devcrypto.py \
     --ignore=tests/test_router.py \

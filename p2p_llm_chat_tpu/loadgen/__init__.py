@@ -18,7 +18,8 @@ The chat plane's standard-methodology load subsystem (docs/loadtest.md):
 
 from .chaos import ChaosWindow, ChurnWindow, check_contracts
 from .driver import Arrival, LoadDriver, TraceRecord, build_schedule
-from .report import build_ledger, error_row, percentile, write_row
+from .report import (build_ledger, error_row, fetch_timelines, percentile,
+                     write_row)
 from .scenarios import (REGISTRY, SLO, Endpoints, Scenario, Step,
                         default_mix, parse_mix)
 from .stub import StubServer
@@ -28,5 +29,5 @@ __all__ = [
     "REGISTRY",
     "SLO", "Scenario", "Step", "StubServer", "TraceRecord",
     "build_ledger", "build_schedule", "check_contracts", "default_mix",
-    "error_row", "parse_mix", "percentile", "write_row",
+    "error_row", "fetch_timelines", "parse_mix", "percentile", "write_row",
 ]

@@ -26,6 +26,7 @@ per-request payload seeds), across runs and processes.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import queue
 import random
@@ -81,6 +82,11 @@ class TraceRecord:
     # SLO miss by prefill vs decode (report.py phase_slos).
     phase_ttft_ms: dict = field(default_factory=dict)
     phase_itl_ms: dict = field(default_factory=dict)
+    # grafttrace (obs/trace.py): the id stamped on every step's
+    # X-Graft-Trace header — schedule-derived (deterministic per
+    # arrival), so the ledger can fetch this request's server-side
+    # timeline and attribute an SLO breach to its dominant phase.
+    trace_id: str = ""
 
     def slo_ttft_ms(self) -> Optional[float]:
         """TTFT as the SLO sees it: queue lag included, so a saturated
@@ -167,7 +173,8 @@ class LoadDriver:
 
     # -- request execution -------------------------------------------------
 
-    def _post(self, step: Step, carry: Optional[dict] = None):
+    def _post(self, step: Step, carry: Optional[dict] = None,
+              trace: str = ""):
         payload = step.payload
         if step.use_context and carry and carry.get("context"):
             # Ollama stateless continuation: the prior step's final
@@ -178,6 +185,11 @@ class LoadDriver:
         headers = {"Content-Type": "application/json"}
         if step.session:
             headers["X-Session-Id"] = step.session
+        if trace:
+            # s=1 pins the origin's verdict: every server this arrival
+            # touches records spans regardless of ITS sample rate, so a
+            # breached request always has a timeline to attribute.
+            headers["X-Graft-Trace"] = f"{trace};s=1"
         req = urllib.request.Request(step.url, data=data, headers=headers,
                                      method="POST")
         return urllib.request.urlopen(req, timeout=self._timeout_s)
@@ -195,7 +207,7 @@ class LoadDriver:
         t_send = time.monotonic()
         deadline = t_send + self._timeout_s
         try:
-            resp = self._post(step, carry)
+            resp = self._post(step, carry, trace=rec.trace_id)
         except urllib.error.HTTPError as e:
             lat_ms = (time.monotonic() - t_send) * 1e3
             body = b""
@@ -242,7 +254,7 @@ class LoadDriver:
         record with its own classification (a herd that half-sheds is a
         shed, not a success)."""
         sub = [TraceRecord(scenario=rec.scenario, peer=rec.peer,
-                           sched_s=rec.sched_s)
+                           sched_s=rec.sched_s, trace_id=rec.trace_id)
                for _ in range(step.fanout)]
         one = Step(url=step.url, payload=step.payload, stream=True,
                    measured=True, session=step.session,
@@ -399,6 +411,12 @@ class LoadDriver:
     def _execute(self, a: Arrival, target_t: float) -> TraceRecord:
         rec = TraceRecord(scenario=a.scenario, peer=a.peer, sched_s=a.t)
         rec.lag_ms = max(0.0, (time.monotonic() - target_t) * 1e3)
+        # Deterministic per-arrival trace id, derived OUTSIDE the
+        # builder rng (build_schedule's draw sequence is byte-pinned by
+        # the determinism tests — nothing here may consume from it).
+        rec.trace_id = hashlib.sha1(
+            f"{a.seed}:{a.scenario}:{a.peer}:{a.t}".encode()
+        ).hexdigest()[:32]
         rng = random.Random(a.seed)
         try:
             steps = self._registry[a.scenario].build(rng, a.peer, self._ep)

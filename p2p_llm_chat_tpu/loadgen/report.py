@@ -47,6 +47,91 @@ def percentile(xs: list, p: float) -> Optional[float]:
     return xs[min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))]
 
 
+# -- SLO-breach phase attribution (grafttrace, obs/trace.py) ----------------
+#
+# Span-name prefix -> attribution phase. Ordered: first prefix match
+# wins. ``api.request`` is deliberately ABSENT — it is the envelope
+# covering queue + prefill + the whole decode stream, so counting it in
+# the dominance sum would attribute every breach to "the request".
+_PHASE_PREFIXES = (
+    ("sched.queue_wait", "queue_wait"),
+    ("sched.prefill", "prefill"),
+    ("sched.wake", "wake"),
+    ("sched.decode", "decode"),
+    ("disagg.", "handoff"),
+    ("router.route", "route"),
+    ("node.", "p2p"),
+)
+
+
+def _span_phase(name: str) -> Optional[str]:
+    for pfx, phase in _PHASE_PREFIXES:
+        if name.startswith(pfx):
+            return phase
+    return None
+
+
+def _dominant_phase(spans) -> Optional[str]:
+    """The phase that ate the most wall across a merged timeline, or
+    None when the timeline holds nothing attributable (evicted store,
+    untraced hop). Ties break alphabetically — deterministic rows."""
+    if not spans:
+        return None
+    sums: dict = {}
+    for s in spans:
+        if not isinstance(s, dict):
+            continue
+        phase = _span_phase(str(s.get("name") or ""))
+        if phase is None:
+            continue
+        sums[phase] = sums.get(phase, 0.0) + float(s.get("dur_ms") or 0.0)
+    if not sums:
+        return None
+    return min(sums.items(), key=lambda kv: (-kv[1], kv[0]))[0]
+
+
+def fetch_timelines(base_url: str, timeout_s: float = 3.0):
+    """A lazy, memoized ``trace_id -> spans | None`` lookup against a
+    trace-listing endpoint (serve front or router ``/admin/trace`` —
+    the router merges cross-replica). Lazy on purpose: the ledger only
+    resolves timelines for BREACHED requests, so a clean run costs zero
+    fetches; pass the returned callable as ``build_ledger``'s
+    ``timelines``."""
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    cache: dict = {}
+
+    def lookup(trace_id: str):
+        if not trace_id:
+            return None
+        if trace_id in cache:
+            return cache[trace_id]
+        spans = None
+        try:
+            q = urllib.parse.urlencode({"id": trace_id})
+            with urllib.request.urlopen(
+                    f"{base_url.rstrip('/')}/admin/trace?{q}",
+                    timeout=timeout_s) as r:
+                doc = json.loads(r.read().decode("utf-8"))
+            spans = doc.get("spans") or None
+        except Exception:   # noqa: BLE001 — 404/evicted/down: no timeline
+            spans = None
+        cache[trace_id] = spans
+        return spans
+
+    return lookup
+
+
+def _resolve_timeline(timelines, trace_id: str):
+    if timelines is None or not trace_id:
+        return None
+    if callable(timelines):
+        return timelines(trace_id)
+    return timelines.get(trace_id)
+
+
 def _judge_phases(recs: list, phase_slos: dict, scale: float,
                   violations: list) -> dict:
     """Per-phase latency judgement (disagg_session): aggregate each
@@ -94,7 +179,8 @@ def _judge_phases(recs: list, phase_slos: dict, scale: float,
 
 
 def _judge_scenario(name: str, recs: list, slo: SLO, duration_s: float,
-                    scale: float, phase_slos: Optional[dict] = None) -> dict:
+                    scale: float, phase_slos: Optional[dict] = None,
+                    timelines=None) -> dict:
     n = len(recs)
     by = {s: sum(1 for r in recs if r.status == s)
           for s in ("ok", "shed", "error", "truncated")}
@@ -138,18 +224,43 @@ def _judge_scenario(name: str, recs: list, slo: SLO, duration_s: float,
                           f"{MAX_BAD_FRAC:.2f}")
 
     # Goodput: completions that individually met the SLO, per second of
-    # scheduled run time.
+    # scheduled run time. Completions that MISSED it are the breached
+    # set the phase-attribution pass below explains.
     good = 0
+    breached = []   # (record, bad_ttft, bad_itl)
     for r in recs:
         if r.status != "ok":
             continue
         t = r.slo_ttft_ms()
-        if t is None or t > t_p95:
-            continue
+        bad_ttft = t is None or t > t_p95
         own_itl = percentile(r.itl_ms, 95)
-        if t_itl is not None and own_itl is not None and own_itl > t_itl:
+        bad_itl = (t_itl is not None and own_itl is not None
+                   and own_itl > t_itl)
+        if bad_ttft or bad_itl:
+            breached.append((r, bad_ttft, bad_itl))
             continue
         good += 1
+
+    # Breach attribution (grafttrace): for every ok-but-SLO-missing
+    # request, pull its merged server-side timeline and name the phase
+    # that dominated. A request whose timeline is gone (store evicted,
+    # replica dead, tracing off) still carries attribution — the
+    # client-side fallback names WHICH budget it blew, just not where.
+    attribution = None
+    if breached:
+        by_phase: dict = {}
+        for r, bad_ttft, bad_itl in breached:
+            spans = _resolve_timeline(timelines,
+                                      getattr(r, "trace_id", ""))
+            phase = _dominant_phase(spans)
+            if phase is None:
+                phase = "client_ttft" if bad_ttft else "client_itl"
+            by_phase[phase] = by_phase.get(phase, 0) + 1
+        attribution = {
+            "n_breached": len(breached),
+            "by_phase": dict(sorted(by_phase.items(),
+                                    key=lambda kv: (-kv[1], kv[0]))),
+        }
 
     phases = None
     if phase_slos:
@@ -173,6 +284,7 @@ def _judge_scenario(name: str, recs: list, slo: SLO, duration_s: float,
         "tokens": sum(r.tokens for r in recs),
         "shed_frac": round(shed_frac, 4),
         "goodput_rps": round(good / duration_s, 3) if duration_s else None,
+        "breach_attribution": attribution,
         "slo": {"ttft_p50_ms": t_p50, "ttft_p95_ms": t_p95,
                 "itl_p95_ms": t_itl, "max_shed_frac": slo.max_shed_frac},
         "pass": not violations,
@@ -182,8 +294,14 @@ def _judge_scenario(name: str, recs: list, slo: SLO, duration_s: float,
 
 def build_ledger(records: list, registry: dict, duration_s: float,
                  meta: Optional[dict] = None,
-                 contract: Optional[ContractReport] = None) -> dict:
-    """All trace records -> the run's ledger row (JSON-serialisable)."""
+                 contract: Optional[ContractReport] = None,
+                 timelines=None) -> dict:
+    """All trace records -> the run's ledger row (JSON-serialisable).
+
+    ``timelines``: optional ``trace_id -> spans`` lookup — a plain dict
+    (tests) or the lazy callable from :func:`fetch_timelines` — used to
+    attribute each SLO-breached request to its dominant server phase.
+    """
     scale = slo_scale()
     per: dict = {}
     for name, scen in registry.items():
@@ -191,7 +309,8 @@ def build_ledger(records: list, registry: dict, duration_s: float,
         per[name] = _judge_scenario(name, recs, scen.slo, duration_s,
                                     scale,
                                     phase_slos=getattr(scen, "phase_slos",
-                                                       None))
+                                                       None),
+                                    timelines=timelines)
 
     n = len(records)
     ok = sum(1 for r in records if r.status == "ok")

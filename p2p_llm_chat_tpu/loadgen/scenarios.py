@@ -6,8 +6,8 @@ paths — UI ``/api/suggest/stream``, node ``/send``, serve
 ``/api/generate|chat|embed``), and the per-scenario SLO the ledger
 (report.py) judges the run against.
 
-The five registered scenarios map one-to-one onto the ROADMAP's
-"scenario-diverse load" list:
+The registered scenarios map onto the ROADMAP's "scenario-diverse
+load" list:
 
 =============== ==========================================================
 ``short_chat``  one chat turn end-to-end: peer i's node delivers a short
@@ -51,6 +51,15 @@ The five registered scenarios map one-to-one onto the ROADMAP's
                 the WORST first delta across the fan; any failed member
                 fails the record. Serve-only runs fan N identical
                 ``/api/chat`` streams instead.
+``relay_path``  the NAT-blocked pair: one node ``/send`` between the
+                ring's most DISTANT peers, judged on the /send round
+                trip itself. On fleets that blocklist the pair's direct
+                dials the delivery rides the relay splice (the request's
+                ``node.send`` trace span records ``via=relay``); on an
+                open fleet the same send goes direct — either way the
+                P2P delivery leg gets its own SLO instead of hiding
+                inside short_chat's unmeasured first step. Serve-only
+                runs degrade to a short ``/api/chat`` turn.
 ``disagg_session`` a two-turn session whose turns ride the
                 prefill→decode handoff on a disaggregated fleet
                 (docs/serving.md Round-14): turn 1 is a NEW
@@ -326,6 +335,31 @@ def _build_group_chat(rng: random.Random, peer: int,
                  stream=True, measured=True, fanout=GROUP_FANOUT)]
 
 
+def _build_relay_path(rng: random.Random, peer: int,
+                      ep: Endpoints) -> list:
+    """One node ``/send`` between the ring's most distant peer pair,
+    measured on the /send round trip itself (non-streaming: latency =
+    full delivery). Aiming half the ring away maximises the odds the
+    pair sits across whatever NAT blocklist the run arms, so delivery
+    rides the relay splice — and the arrival's ``node.send`` span
+    (via=relay|direct) shows which leg actually carried it. Needs at
+    least two nodes; otherwise a serve-level short turn keeps the
+    arrival judgeable."""
+    if len(ep.node_urls) >= 2:
+        n = len(ep.node_urls)
+        to = (peer + max(1, n // 2)) % n
+        user = ep.users[to] if ep.users else f"peer{to:02d}"
+        return [Step(url=f"{ep.node_urls[peer % n]}/send",
+                     payload={"to_username": user,
+                              "content": _chat_text(rng, user)},
+                     measured=True)]
+    msg = _chat_text(rng, "far away")
+    return [Step(url=f"{ep.serve_url}/api/chat",
+                 payload={"messages": [{"role": "user", "content": msg}],
+                          "options": {"num_predict": 16}, "stream": True},
+                 stream=True, measured=True)]
+
+
 def _build_disagg_session(rng: random.Random, peer: int,
                           ep: Endpoints) -> list:
     """Two turns under one session id, phase-tagged: turn 1 is a NEW
@@ -411,6 +445,15 @@ REGISTRY: dict = {
                  slo=SLO(ttft_p50_ms=6000, ttft_p95_ms=18000,
                          itl_p95_ms=2000, max_shed_frac=0.3),
                  build=_build_group_chat),
+        # The relay leg (round 15): a non-streaming /send, so itl is
+        # None and TTFT is the whole delivery — relay splice included
+        # when the fleet's NAT blocklist forces it. The budget matches
+        # short_chat's: a relayed hop is one extra stream splice, not a
+        # different latency class.
+        Scenario("relay_path", weight=0.5,
+                 slo=SLO(ttft_p50_ms=4000, ttft_p95_ms=12000,
+                         itl_p95_ms=None, max_shed_frac=0.25),
+                 build=_build_relay_path),
         # Disaggregated session (round 14): judged on the turn-2 wake;
         # the per-phase SLOs split misses by pool — prefill's budget is
         # wider (it carries the chunked prefill AND the handoff), the

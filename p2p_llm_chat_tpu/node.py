@@ -54,10 +54,12 @@ overrides gateway discovery.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 from .directory import DirectoryClient
 from .inbox import Inbox
+from .obs import trace as _trace
 from .utils.backoff import Backoff
 from .p2p import Identity, Multiaddr, P2PHost
 from .p2p.dht import DHTNode, parse_seeds
@@ -137,6 +139,13 @@ class ChatNode:
         self.router.add("GET", "/inbox", self._handle_inbox)
         self.router.add("GET", "/me", self._handle_me)
         self.router.add("GET", "/healthz", lambda r: Response(200, {"status": "ok"}))
+        # grafttrace (obs/trace.py): /send is a chat-plane INGRESS — it
+        # parses or mints a trace context per message and records the
+        # node.send span (lookup ladder + delivery, with the winning
+        # leg's via=direct|relay meta). Same bounded store + listing
+        # contract as the serve fronts.
+        self.trace = _trace.TraceStore(replica=f"node:{self.username}")
+        self.router.add("GET", "/admin/trace", self._handle_trace)
 
     # -- p2p side ------------------------------------------------------------
 
@@ -168,6 +177,22 @@ class ChatNode:
         content = str(body.get("content") or "")
         if not to_username or not content:
             return Response(400, {"error": "to_username and content required"})
+
+        # node.send covers the whole send path — lookup ladder + the
+        # delivery walk — and its ``via`` meta names the winning leg
+        # (relay vs direct), so a relay-path SLO breach attributes to
+        # the p2p phase with the leg visible. The trace id echoes in
+        # the response so a client (loadgen) can fetch the timeline.
+        tctx = _trace.parse_header(req.headers.get(_trace.HEADER_LC))
+        if tctx is None:
+            tctx = _trace.mint()
+        t_send = time.monotonic()
+
+        def _span(**meta) -> None:
+            if tctx.sampled:
+                self.trace.add(tctx.trace_id, "node.send", t_send,
+                               time.monotonic() - t_send,
+                               to=to_username, **meta)
 
         from_cache = False
         via_dht = False
@@ -207,13 +232,16 @@ class ChatNode:
                           content=content, timestamp=now_rfc3339())
 
         errors: list[str] = []
-        if self._deliver(rec, msg, errors):
+        won = self._deliver(rec, msg, errors)
+        if won:
             if via_dht:
                 # Cache only after a delivery proves the record good — a
                 # dead DHT record must not poison the cache rung.
                 with self._cache_mu:
                     self._lookup_cache[to_username] = rec
-            return Response(200, {"status": "sent", "id": msg.id})  # main.go:264
+            _span(via=("relay" if "/p2p-circuit/" in won else "direct"))
+            return Response(200, {"status": "sent", "id": msg.id,
+                                  "trace": tctx.trace_id})  # main.go:264
 
         # The cached record may be stale (the peer moved while the
         # directory was down). If the DHT holds a record with different
@@ -236,20 +264,28 @@ class ChatNode:
             if fresh is not None and set(fresh.addrs) != set(rec.addrs):
                 log.warning("cached addrs for %s are dead; retrying via "
                             "DHT record", to_username)
-                if self._deliver(fresh, msg, errors):
+                won = self._deliver(fresh, msg, errors)
+                if won:
                     with self._cache_mu:
                         self._lookup_cache[to_username] = fresh
-                    return Response(200, {"status": "sent", "id": msg.id})
+                    _span(via=("relay" if "/p2p-circuit/" in won
+                               else "direct"))
+                    return Response(200, {"status": "sent", "id": msg.id,
+                                          "trace": tctx.trace_id})
         if from_cache:
             # Total failure on a cached record: drop it so the next send
             # re-resolves instead of re-dialing dead addrs forever.
             with self._cache_mu:
                 self._lookup_cache.pop(to_username, None)
+        _span(outcome="unreachable", attempts=len(errors))
         return Response(502, {"error": "could not reach peer", "attempts": errors})
 
-    def _deliver(self, rec, msg: ChatMessage, errors: list[str]) -> bool:
+    def _deliver(self, rec, msg: ChatMessage, errors: list[str]) -> str:
         """Try each advertised addr (direct first, then circuits), one stream
-        per message, write JSON, close (main.go:235-261)."""
+        per message, write JSON, close (main.go:235-261). Returns the
+        addr that delivered (truthy — callers keep their boolean
+        checks; the trace span reads the relay marker off it), or ""
+        when every addr failed."""
         addrs = sorted(rec.addrs, key=lambda a: "/p2p-circuit/" in a)
         for addr_str in addrs:
             try:
@@ -262,10 +298,25 @@ class ChatNode:
                     stream.close_write()
                 finally:
                     stream.close()
-                return True
+                return addr_str
             except Exception as e:  # noqa: BLE001 — collect and try next addr
                 errors.append(f"{addr_str}: {e}")
-        return False
+        return ""
+
+    def _handle_trace(self, req: Request) -> Response:
+        """GET /admin/trace[?id=]: the node's span store — same listing
+        contract as the serve fronts (serve/api.py _trace_list), so one
+        client-side fetch loop reads any plane's timelines."""
+        tid = str(req.query.get("id") or "")
+        if tid:
+            spans = self.trace.get(tid)
+            if not spans:
+                return Response(404, {"error": f"trace {tid!r} not held"})
+            return Response(200, {"id": tid, "spans": spans})
+        # Stats nest under their own key: the store's stats() also
+        # counts "traces" and would clobber the id list if splatted.
+        return Response(200, {"traces": self.trace.ids(),
+                              "stats": self.trace.stats()})
 
     def _handle_inbox(self, req: Request) -> Response:
         """GET /inbox?after=<id> (go/cmd/node/main.go:267-270)."""

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Iterator, Optional
 
+from ..obs import trace as _trace
 from ..proto import now_rfc3339
 from ..utils import backoff as _backoff
 from ..utils import failpoints as _failpoints
@@ -160,6 +161,17 @@ class OllamaServer:
         # decode replica then pulls over /admin/session.
         self.router.add("POST", "/admin/disagg/prefill",
                         self._disagg_prefill)
+        # grafttrace (obs/, round 15): this replica's bounded span
+        # store, injected into the backend so scheduler-side spans land
+        # under the same trace ids the wire header carries. bind_registry
+        # is THE registration site for the serve_trace_* series.
+        self.trace = _trace.TraceStore()
+        self.trace.bind_registry(self.metrics)
+        set_store = getattr(backend, "set_trace_store", None)
+        if callable(set_store):
+            set_store(self.trace)
+        self.router.add("GET", "/admin/trace", self._trace_list)
+        self.router.add("POST", "/admin/trace/dump", self._trace_dump)
         self._server: Optional[HttpServer] = None
 
     # -- helpers -------------------------------------------------------------
@@ -335,8 +347,17 @@ class OllamaServer:
         session = str(req_body.get("session") or "")
         if not session and headers is not None:
             session = str(headers.get("x-session-id") or "")
+        # grafttrace: adopt the propagated context (router / chat plane /
+        # loadgen stamped one) or mint here — this front is then the
+        # trace origin and its sample verdict rides the greq fields into
+        # the scheduler's spans.
+        tctx = _trace.parse_header((headers or {}).get(_trace.HEADER_LC))
+        if tctx is None:
+            tctx = _trace.mint()
         greq = GenerateRequest(prompt=prompt, model=model, options=opts,
-                               context=context, session=session)
+                               context=context, session=session,
+                               trace_id=tctx.trace_id,
+                               trace_sampled=tctx.sampled)
         backend = self._resolve(model)
         stats = RequestStats()
         self._m_requests.inc()
@@ -381,6 +402,10 @@ class OllamaServer:
                 return Response(500, {"error": str(e)})
             self._m_inflight.add(-1)
             self._observe(stats)
+            if tctx.sampled:
+                self.trace.add(tctx.trace_id, "api.request", started,
+                               time.monotonic() - started, endpoint=key,
+                               tokens=stats.completion_tokens)
             rec = self._finalize_record(model, stats, started)
             rec[key] = wrap(text)
             if with_context and stats.context is not None:
@@ -412,6 +437,15 @@ class OllamaServer:
                 yield (json.dumps({"error": str(e), "done": True}) + "\n").encode()
             finally:
                 self._m_inflight.add(-1)
+                # Span at stream END (error paths included): the
+                # envelope covering queue + prefill + the whole decode
+                # stream — the router's merge nests the sched.* spans
+                # under it.
+                if tctx.sampled:
+                    self.trace.add(tctx.trace_id, "api.request", started,
+                                   time.monotonic() - started,
+                                   endpoint=key,
+                                   tokens=stats.completion_tokens)
 
         return Response(200, stream=ndjson(), content_type="application/x-ndjson")
 
@@ -657,6 +691,8 @@ class OllamaServer:
         be = self._session_backend()
         if be is None:
             return Response(501, {"error": "no session tier"})
+        tctx = _trace.parse_header(req.headers.get(_trace.HEADER_LC))
+        t_imp = time.monotonic()
         data = req.body or b""
         if data[:1] == b"{":
             try:
@@ -682,6 +718,14 @@ class OllamaServer:
         if sess is None:
             return Response(400, {"error": "malformed or incompatible "
                                            "session payload"})
+        # disagg.import: the decode replica's KV pull during a handoff
+        # (covers the replica-to-replica export fetch when the PULL
+        # form was used). Traced only when the router forwarded the
+        # original request's header.
+        if tctx is not None and tctx.sampled:
+            self.trace.add(tctx.trace_id, "disagg.import", t_imp,
+                           time.monotonic() - t_imp,
+                           key=sess.key, tokens=sess.length)
         return Response(200, {"status": "ok", "key": sess.key,
                               "len": sess.length})
 
@@ -775,10 +819,20 @@ class OllamaServer:
         session = str(body.get("session") or "")
         if not session:
             session = str(req.headers.get("x-session-id") or "")
+        # The router forwards the original request's trace header on
+        # the handoff's step-1 call, so the prefill replica's chunked
+        # prefill lands under the SAME trace id the decode replica's
+        # wake span carries — the merged timeline shows the handoff
+        # end-to-end. No header => untraced (never mint here: this is
+        # an internal hop, not an ingress).
+        tctx = _trace.parse_header(req.headers.get(_trace.HEADER_LC))
         greq = GenerateRequest(
             prompt=prompt, model=model,
             options=GenerateOptions.from_ollama(body.get("options")),
-            context=context, session=session)
+            context=context, session=session,
+            trace_id=tctx.trace_id if tctx else "",
+            trace_sampled=bool(tctx and tctx.sampled))
+        t_park = time.monotonic()
         fn = getattr(backend, "prefill_park", None)
         sl = getattr(backend, "session_list", None)
         if fn is None or sl is None or sl() is None:
@@ -801,7 +855,48 @@ class OllamaServer:
             return Response(422, {"error": "request cannot ride the "
                                            "handoff (unindexable or "
                                            "prefill not retained)"})
+        if tctx is not None and tctx.sampled:
+            self.trace.add(tctx.trace_id, "disagg.prefill_park", t_park,
+                           time.monotonic() - t_park,
+                           key=str(meta.get("key") or ""),
+                           tokens=int(meta.get("len") or 0))
         return Response(200, {"status": "parked", **meta})
+
+    # -- grafttrace (obs/, round 15) -----------------------------------------
+
+    def _trace_list(self, req: Request) -> Response:
+        """GET /admin/trace: trace ids held by this replica's bounded
+        store plus store stats; ``?id=<trace id>`` returns that trace's
+        recorded spans (wall-anchored ``t0_ms`` — directly mergeable
+        with other replicas' spans for the same id). The router's own
+        /admin/trace builds the cross-replica timeline from these."""
+        tid = str(req.query.get("id") or "")
+        if tid:
+            spans = self.trace.get(tid)
+            if not spans:
+                return Response(404, {"error": f"trace {tid!r} not held "
+                                               "(evicted or never "
+                                               "sampled here)"})
+            return Response(200, {"id": tid, "spans": spans})
+        # Stats nest under their own key: the store's stats() also
+        # counts "traces" and would clobber the id list if splatted.
+        return Response(200, {"traces": self.trace.ids(),
+                              "stats": self.trace.stats()})
+
+    def _trace_dump(self, req: Request) -> Response:
+        """POST /admin/trace/dump: write the scheduler flight-recorder
+        ring to its durable JSON file on demand (same artifact the
+        watchdog writes on a stall) and return the path. 501 when the
+        backend has no flight surface (FakeLLM)."""
+        fn = getattr(self.backend, "flight_dump", None)
+        if fn is None:
+            return Response(501, {"error": "no flight recorder (backend "
+                                           "has no scheduler loop)"})
+        try:
+            path = fn("on_demand")
+        except OSError as e:
+            return Response(500, {"error": f"flight dump failed: {e}"})
+        return Response(200, {"status": "dumped", "path": path})
 
     def _unsupported(self, req: Request) -> Response:
         return Response(501, {
@@ -822,6 +917,9 @@ class OllamaServer:
 
     def start(self) -> "OllamaServer":
         self._server = HttpServer(self.router, self.addr_cfg).start()
+        # Tag this replica's spans with the bound address so the
+        # router's merged timeline names which replica each span ran on.
+        self.trace.replica = self._server.addr
         log.info("serve API (%s backend) on %s", self.backend.name, self._server.addr)
         return self
 

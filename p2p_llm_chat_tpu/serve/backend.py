@@ -83,6 +83,12 @@ class GenerateRequest:
     session: str = ""
     request_id: str = field(default_factory=lambda: uuid.uuid4().hex)
     arrival_time: float = field(default_factory=time.monotonic)
+    # grafttrace (obs/trace.py): the propagated trace id and its pinned
+    # sample verdict, parsed from ``X-Graft-Trace`` by the API layer.
+    # Empty id = untraced; the scheduler records queue-wait / prefill /
+    # decode spans only when ``trace_sampled`` is set.
+    trace_id: str = ""
+    trace_sampled: bool = False
 
 
 @dataclass

@@ -83,7 +83,8 @@ class HandoffUnsupported(Exception):
 
 def drive_handoff(prefill_url: str, decode_url: str, path: str,
                   body: dict, session: str = "",
-                  timeout_s: float = 300.0) -> Optional[dict]:
+                  timeout_s: float = 300.0,
+                  trace: str = "") -> Optional[dict]:
     """One prefill→decode handoff, HTTP choreography only (no router
     state — the caller owns pools, affinity and metrics; this runs OFF
     the router's lock because every step is network I/O):
@@ -109,6 +110,12 @@ def drive_handoff(prefill_url: str, decode_url: str, path: str,
     headers = {"Content-Type": "application/json"}
     if session:
         headers["X-Session-Id"] = session
+    # grafttrace: ``trace`` is the original request's X-Graft-Trace
+    # value — forwarded on the prefill dispatch and the decode-side
+    # import so both replicas' spans (disagg.prefill_park,
+    # disagg.import, and the scheduler's wake) share the request's id.
+    if trace:
+        headers["X-Graft-Trace"] = trace
     req = urllib.request.Request(
         f"{prefill_url}/admin/disagg/prefill",
         data=json.dumps({"path": path, "body": body}).encode(),
@@ -133,10 +140,13 @@ def drive_handoff(prefill_url: str, decode_url: str, path: str,
     key = str(meta.get("key") or "")
     if not key:
         raise HandoffError("prefill step returned no session key")
+    imp_headers = {"Content-Type": "application/json"}
+    if trace:
+        imp_headers["X-Graft-Trace"] = trace
     imp = urllib.request.Request(
         f"{decode_url}/admin/session/import",
         data=json.dumps({"from": prefill_url, "key": key}).encode(),
-        headers={"Content-Type": "application/json"})
+        headers=imp_headers)
     try:
         with urllib.request.urlopen(imp, timeout=timeout_s) as r:
             r.read()

@@ -281,6 +281,20 @@ class TPUEngine:
         merged into the API front's /metrics (serve/api.py)."""
         return self.scheduler.metrics_snapshot()
 
+    # -- grafttrace (obs/, round 15) -----------------------------------------
+
+    def set_trace_store(self, store) -> None:
+        """The API front injects its span store so the scheduler's
+        queue-wait/prefill/wake/decode spans land beside the front's
+        own api.request span under one trace id."""
+        self.scheduler.set_trace_store(store)
+
+    def flight_snapshot(self) -> list:
+        return self.scheduler.flight_snapshot()
+
+    def flight_dump(self, reason: str = "on_demand") -> str:
+        return self.scheduler.flight_dump(reason)
+
     # -- cross-replica shared prefix tier (serve/prefix.py round 11) ---------
 
     def prefix_hashes(self):

@@ -255,6 +255,21 @@ class KVTier:
         self.n_wake_tokens_total = 0  # guarded-by: _mu — prompt tokens wake did NOT re-prefill
         self.n_evicted_total = 0      # guarded-by: _mu
         self.n_pages_freed_total = 0  # guarded-by: _mu — HBM pages released by parking
+        # grafttrace (round 15): optional tier-event observer — the
+        # owning scheduler points this at its flight recorder so
+        # park/wake/adopt/forget/evict land in the loop event ring.
+        # ALWAYS invoked OUTSIDE ``_mu``: the observer appends under
+        # its own lock, and nesting it under the index lock would hand
+        # the lock-order analyzer a new edge for nothing.
+        self.observer = None
+
+    def _notify(self, kind: str, **meta) -> None:
+        cb = self.observer
+        if cb is not None:
+            try:
+                cb(kind, **meta)
+            except Exception:   # noqa: BLE001 — observability never faults the tier
+                pass
 
     # -- index ---------------------------------------------------------------
 
@@ -356,6 +371,7 @@ class KVTier:
             return None
         with self._mu:
             self.n_evicted_total += 1
+        self._notify("evict", key=sess.key)
         return s.pages
 
     # -- cross-replica migration (serve/router.py drives this over the
@@ -420,6 +436,7 @@ class KVTier:
             self.host_bytes += sess.nbytes
         for victim in self.host_victims():      # parked by definition
             self.drop(victim)
+        self._notify("adopt", key=sess.key, nbytes=int(sess.nbytes))
         return True
 
     def forget(self, key: str) -> bool:
@@ -437,6 +454,7 @@ class KVTier:
                 del self._by_head[h]
             del self._sessions[key]
             self.host_bytes -= s.nbytes
+        self._notify("forget", key=key)
         return True
 
     # -- counters (the scheduler's write API; lock taken here so the
@@ -446,11 +464,13 @@ class KVTier:
         with self._mu:
             self.n_parked_total += 1
             self.n_pages_freed_total += pages_freed
+        self._notify("park", pages_freed=pages_freed)
 
     def note_waked(self, n: int, tokens_saved: int = 0) -> None:
         with self._mu:
             self.n_waked_total += n
             self.n_wake_tokens_total += tokens_saved
+        self._notify("wake", n=n, tokens_saved=tokens_saved)
 
     def stats(self) -> dict[str, float]:
         """One consistent locked snapshot of the counters + host pool —

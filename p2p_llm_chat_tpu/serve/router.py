@@ -119,6 +119,7 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Iterator, Optional
 
+from ..obs import trace as _trace
 from ..utils import backoff as _backoff
 from ..utils.env import env_bool, env_float, env_int, env_or
 from ..utils.failpoints import failpoint
@@ -429,6 +430,14 @@ class ReplicaRouter:
         self.router.add("GET", "/admin/replicas", self._admin_replicas)
         self.router.add("POST", "/admin/drain", self._admin_drain)
         self.router.add("POST", "/admin/undrain", self._admin_undrain)
+        # grafttrace (obs/, round 15): the router records its own
+        # routing/handoff spans and merges per-replica timelines into
+        # one cross-fleet view on GET /admin/trace?id=. Same
+        # bind_registry literals as the replica fronts — the single
+        # registration site for the serve_trace_* series.
+        self.trace = _trace.TraceStore(replica="router")
+        self.trace.bind_registry(self.metrics)
+        self.router.add("GET", "/admin/trace", self._admin_trace)
 
         self._closed = threading.Event()
         self._scrape_thread = threading.Thread(
@@ -834,6 +843,12 @@ class ReplicaRouter:
         sid = req.headers.get("x-session-id")
         if sid:
             headers["X-Session-Id"] = sid
+        # Trace propagation: the replica's scheduler spans land under
+        # the id this header carries (_route_generate mints one when
+        # the client sent none, so every routed request is mergeable).
+        tid = req.headers.get(_trace.HEADER_LC)
+        if tid:
+            headers[_trace.HEADER] = tid
         up = urllib.request.Request(
             f"{rep.url}{req.path}", data=req.body or None,
             headers=headers, method=req.method)
@@ -871,7 +886,9 @@ class ReplicaRouter:
 
     def _try_replicas(self, req: Request, session: Optional[str],
                       prefer: Optional[_Replica] = None,
-                      avoid_decode: bool = False) -> Response:
+                      avoid_decode: bool = False,
+                      tctx: Optional[_trace.TraceContext] = None
+                      ) -> Response:
         """Route with retry: walk the candidate list (home replica
         first), moving on at a 503 shed or a connection failure. No
         sleeping anywhere on this path — a fully-saturated fleet must
@@ -884,6 +901,12 @@ class ReplicaRouter:
         that could not ride the handoff — admission prefill belongs on
         the prefill/mixed pools, a decode replica is the last resort."""
         self._m_requests.inc()
+        # router.route: the routing decision wall — candidate walk
+        # including every failover hop, ending when a replica ACCEPTS
+        # (stream delivery is the replica's api.request span, not
+        # routing). Recorded only for sampled generate-path requests.
+        t_route = time.monotonic()
+        traced = tctx is not None and tctx.sampled
         cands = self._candidates(session)
         if avoid_decode:
             cands.sort(key=lambda r: r.cls == "decode")     # stable
@@ -964,7 +987,21 @@ class ReplicaRouter:
                             req.path)
                 continue
             self._note_served(session, rep)
+            if traced:
+                self.trace.add(tctx.trace_id, "router.route", t_route,
+                               time.monotonic() - t_route,
+                               replica=rep.url, attempts=attempt + 1)
             return self._respond(upstream, rep, on_done)
+        if traced:
+            # Exhausted walk: the span's outcome meta says WHY the
+            # request never reached a scheduler — breach attribution
+            # reads these as route-phase failures.
+            self.trace.add(tctx.trace_id, "router.route", t_route,
+                           time.monotonic() - t_route,
+                           attempts=len(cands),
+                           outcome=("error" if retry_after is None
+                                    and last_error is not None
+                                    else "shed"))
         if retry_after is None and last_error is not None:
             status, body, ctype = last_error
             return Response(status, body, content_type=ctype)
@@ -983,6 +1020,14 @@ class ReplicaRouter:
         if not isinstance(body, dict):
             return Response(400, {"error": "request body must be an object"})
         session = self.session_key(req.path, body, req.headers)
+        # Parse-or-mint the trace context at the fleet ingress and
+        # stamp it back onto the inbound header dict, so _open (and
+        # the handoff's prefill dispatch) forward ONE id to every
+        # replica this request touches — the merge key.
+        tctx = _trace.parse_header(req.headers.get(_trace.HEADER_LC))
+        if tctx is None:
+            tctx = _trace.mint()
+        req.headers[_trace.HEADER_LC] = tctx.header_value()
         with self._mu:
             is_new = session is None or session not in self._sessions
         prefer = None
@@ -991,7 +1036,8 @@ class ReplicaRouter:
             prefer, disagg_pools = self._disagg_route(req, body, session)
         return self._try_replicas(req, session, prefer=prefer,
                                   avoid_decode=(is_new and disagg_pools
-                                                and prefer is None))
+                                                and prefer is None),
+                                  tctx=tctx)
 
     def _route_any(self, req: Request) -> Response:
         return self._try_replicas(req, None)
@@ -1049,13 +1095,26 @@ class ReplicaRouter:
                     return None, pools
                 self._handoff_inflight.add(session)
         t0 = time.monotonic()
+        # The handoff rides the request's trace (stamped by
+        # _route_generate before this call): the prefill replica's
+        # disagg.prefill_park and the decode replica's disagg.import
+        # spans land under the same id this router-side envelope does.
+        tctx = _trace.parse_header(req.headers.get(_trace.HEADER_LC))
+        traced = tctx is not None and tctx.sampled
+
+        def _span(outcome: str, **meta) -> None:
+            if traced:
+                self.trace.add(tctx.trace_id, "disagg.handoff", t0,
+                               time.monotonic() - t0, prefill=P.url,
+                               decode=D.url, outcome=outcome, **meta)
         with self._mu:
             P.inflight += 1     # the prefill dispatch is real load
         try:
             try:
-                meta = _disagg.drive_handoff(P.url, D.url, req.path,
-                                             body, session=sid,
-                                             timeout_s=self.timeout_s)
+                meta = _disagg.drive_handoff(
+                    P.url, D.url, req.path, body, session=sid,
+                    timeout_s=self.timeout_s,
+                    trace=(tctx.header_value() if tctx else ""))
             except _disagg.HandoffUnsupported:
                 with self._mu:
                     self._disagg_unsupported.add(P.index)
@@ -1064,6 +1123,7 @@ class ReplicaRouter:
                 return None, pools
             except Exception as e:  # noqa: BLE001 — HandoffError + rest
                 self._m_handoff_failures.inc()
+                _span("failed")
                 log.warning("disagg handoff %s -> %s failed (%s); "
                             "finishing on the prefill replica", P.url,
                             D.url, e)
@@ -1088,6 +1148,7 @@ class ReplicaRouter:
                 while len(self._sessions) > self._session_cap:
                     self._sessions.popitem(last=False)
             self._m_handoffs.inc()
+            _span("ok", key=key)
             ms = (time.monotonic() - t0) * 1e3
             self._m_handoff_ms.observe(ms)
             log.info("disagg handoff: %s prefilled on replica %d, "
@@ -1106,6 +1167,52 @@ class ReplicaRouter:
             return Response(200, {"status": "ready"})
         return Response(503, {"status": "no replica ready"},
                         headers={"Retry-After": "2"})
+
+    def _admin_trace(self, req: Request) -> Response:
+        """GET /admin/trace: the router store's ids + stats; ``?id=``
+        merges the CROSS-REPLICA timeline — the router's own routing/
+        handoff spans plus every live replica's spans for that id,
+        sorted on the shared wall-anchored ``t0_ms`` axis. Replicas
+        that never sampled the id (or already evicted it) simply
+        contribute nothing; a dead replica drops out after its fetch
+        timeout, same posture as the /metrics aggregate."""
+        tid = str(req.query.get("id") or "")
+        if not tid:
+            return Response(200, {"traces": self.trace.ids(),
+                                  "stats": self.trace.stats()})
+        spans = self.trace.get(tid)
+        with self._mu:
+            reps = [(r.index, r.url) for r in self.replicas if r.alive]
+        q = urllib.parse.urlencode({"id": tid})
+
+        def fetch(url: str, out: dict, idx: int) -> None:
+            try:
+                with urllib.request.urlopen(
+                        f"{url}/admin/trace?{q}", timeout=2.0) as r:
+                    out[idx] = json.loads(r.read().decode("utf-8"))
+            except Exception:  # noqa: BLE001 — 404/dead replica: no spans
+                pass
+
+        got: dict = {}
+        fetchers = [threading.Thread(target=fetch, args=(url, got, idx))
+                    for idx, url in reps]
+        for t in fetchers:
+            t.start()
+        for t in fetchers:
+            t.join(timeout=2.5)
+        for idx, _ in reps:
+            doc = got.get(idx)
+            if not isinstance(doc, dict):
+                continue
+            for s in doc.get("spans") or []:
+                if isinstance(s, dict):
+                    s.setdefault("replica", str(idx))
+                    spans.append(s)
+        if not spans:
+            return Response(404, {"error": f"trace {tid!r} unknown "
+                                           "fleet-wide"})
+        spans.sort(key=lambda s: (s.get("t0_ms") or 0.0))
+        return Response(200, {"id": tid, "spans": spans})
 
     def _metrics(self, req: Request) -> Response:
         """Aggregate /metrics: the router's own registry, each replica's

@@ -57,6 +57,7 @@ from ..models.configs import ModelConfig
 from ..models.layers import causal_mask
 from ..models.llama import KVCache
 from ..models.sampling import sample_batched, sample_step_batched
+from ..obs.flight import FlightRecorder
 from ..tokenizer import Tokenizer
 from ..utils.env import env_float
 from ..utils.failpoints import failpoint
@@ -148,6 +149,11 @@ class _Slot:
     wake_key: Optional[str] = None
     wake_dev: Optional[tuple] = None
     last_emit_t: float = 0.0                           # inter-token gap tracking
+    # grafttrace: when this slot's admission dispatch began — splits the
+    # request's pre-first-token wall into queue wait (arrival -> here)
+    # and prefill (here -> install) for the sched.* spans. 0 = never
+    # dispatched (the spans fall back to the install stamp).
+    admit_t: float = 0.0
     # Admission-queue depth accounting (overload shedding): on_depart
     # fires exactly once, at the earlier of batch-row install or any
     # terminal outcome — the depth gauge must count submitted-but-not-
@@ -459,6 +465,20 @@ class BatchScheduler:
                                if loop_budget_ms is None else loop_budget_ms)
         self._loop_stall_ms = 0.0     # owned-by: _loop
         self._loop_stalled = False    # owned-by: _loop
+        # Last COMPLETE stall episode's over-budget wall (round 15):
+        # ``loop_stall_ms`` above is a high-water max that never resets,
+        # so a dashboard can't see recovery — this one re-stamps per
+        # episode and falls back to 0-ish readings between them.
+        self._loop_stall_last_ms = 0.0  # owned-by: _loop
+        # grafttrace (obs/): loop-iteration counter for flight-recorder
+        # events, the always-on event ring itself, and the span store.
+        # The store reference is installed once at wiring time
+        # (set_trace_store, before traffic) and read by _loop; None =
+        # tracing off for this scheduler.
+        self._loop_iter = 0           # owned-by: _loop
+        self._last_fuse_k = 0         # owned-by: _loop
+        self._flight = FlightRecorder()
+        self._trace = None
         # Heartbeat: start time of the CURRENT loop iteration (written
         # by _loop each pass, read by metrics_snapshot) — lets the gauge
         # expose an in-flight stall a wedged iteration would otherwise
@@ -620,6 +640,7 @@ class BatchScheduler:
         if kv_host_gb and kv_host_gb > 0:
             from .kv_tier import KVTier
             self._tier = KVTier(kv_host_gb * 1e9, idle_s=kv_idle_s)
+            self._tier.observer = self._tier_event
             log.info("KV tiering on: %.2f GB host budget, idle park "
                      "after %.1fs", kv_host_gb, kv_idle_s)
         self._wake_hist = Histogram("kv_wake_ms")
@@ -2194,6 +2215,7 @@ class BatchScheduler:
         while not self._closed.is_set():
             it_start = time.monotonic()
             self._loop_beat = it_start
+            self._loop_iter += 1
             try:
                 self._drain_stall_reset()
                 self._drain_park_all()
@@ -2290,12 +2312,31 @@ class BatchScheduler:
         if dur_ms > budget:
             if dur_ms > self._loop_stall_ms:
                 self._loop_stall_ms = dur_ms
+            # Last-episode gauge (round 15): re-stamped every over-
+            # budget iteration, so after recovery it holds the LAST
+            # episode's wall instead of the all-time max the
+            # ``loop_stall_ms`` high-water series keeps.
+            self._loop_stall_last_ms = dur_ms
             if not self._loop_stalled:
                 self._loop_stalled = True
                 log.warning("scheduler loop iteration took %.0f ms "
                             "(budget %.0f ms)", dur_ms, budget)
+                # Flight-recorder dump at episode ENTRY: the ring still
+                # holds the events of the iteration that stalled — the
+                # stall marker shares its ``it`` with the event that
+                # caused it, which is the whole diagnosis.
+                self._flight.note("stall_enter", self._loop_iter,
+                                  over_ms=round(dur_ms, 1),
+                                  budget_ms=self.loop_budget_ms)
+                try:
+                    path = self._flight.dump("watchdog_stall")
+                    log.warning("flight recorder dumped to %s", path)
+                except OSError as e:
+                    log.warning("flight-recorder dump failed: %s", e)
         elif self._loop_stalled:
             self._loop_stalled = False
+            self._flight.note("stall_recover", self._loop_iter,
+                              last_ms=round(dur_ms, 1))
             log.info("scheduler loop recovered (last iteration %.0f ms)",
                      dur_ms)
 
@@ -2679,6 +2720,30 @@ class BatchScheduler:
         except Exception:   # noqa: BLE001 — incompatible payloads reject
             return False
 
+    # -- grafttrace (obs/): span store wiring + the flight surface -----------
+
+    def set_trace_store(self, store) -> None:
+        """Install the owning server's span store (obs/trace.py). One
+        atomic reference assignment at wiring time, before traffic —
+        the loop reads the reference per use, so None stays "off"."""
+        self._trace = store
+
+    # graftcheck: lock-ok advisory read — the loop-iteration int tags tier events best-effort; a torn int read is impossible
+    def _tier_event(self, kind: str, **meta) -> None:
+        """KVTier observer -> flight ring (park/wake/adopt/forget/evict
+        — adopt/forget arrive from HTTP threads, hence the advisory
+        iteration read)."""
+        self._flight.note(f"tier_{kind}", self._loop_iter, **meta)
+
+    def flight_snapshot(self) -> list:
+        """The event ring, oldest first (GET /admin/trace surface)."""
+        return self._flight.snapshot()
+
+    def flight_dump(self, reason: str = "on_demand") -> str:
+        """Dump the ring to its JSON file; returns the path (the
+        POST /admin/trace/dump surface)."""
+        return self._flight.dump(reason)
+
     # graftcheck: lock-ok advisory gauges — torn reads of loop-owned ints are harmless for /metrics
     def metrics_snapshot(self) -> dict[str, float]:
         """Serving-plane gauges/counters for the /metrics endpoint (read
@@ -2707,6 +2772,14 @@ class BatchScheduler:
             # the gauge while it hangs, not after it ends). 0 = never
             # stalled.
             "loop_stall_ms": round(self._live_loop_stall_ms(), 3),
+            # Last COMPLETE stall episode's over-budget wall (round 15):
+            # unlike the high-water max above, this one re-stamps per
+            # episode — after recovery it stops growing, so a dashboard
+            # can tell "stalling now" from "stalled once at boot".
+            "loop_stall_last_ms": round(self._loop_stall_last_ms, 3),
+            # Flight-recorder dumps written (watchdog stall, reset, or
+            # /admin/trace/dump) — a nonzero rate is the incident alarm.
+            "serve_flight_dumps_total": self._flight.dumps_total(),
             # Fused multi-step decode (decode_fuse_max): dispatches that
             # fused K>1 steps, total fused steps, and the realized mean K
             # over every decode dispatch — the lever that closes the
@@ -3163,6 +3236,9 @@ class BatchScheduler:
         # drives. (Warmup jobs route through here too; arming during
         # warmup fails that warmup job, surfaced by warmup()'s re-raise.)
         failpoint("serve.scheduler.admit")
+        t_admit = time.monotonic()
+        for s in chunk:
+            s.admit_t = t_admit
         prefix = chunk[0].prefix if chunk else warm_prefix
         P = prefix.length if prefix is not None else 0
         pad = R - len(chunk)
@@ -3299,10 +3375,24 @@ class BatchScheduler:
 
         now = time.monotonic()
         self._n_admitted += len(chunk)
+        if chunk:
+            self._flight.note("admit", self._loop_iter, n=len(chunk))
+        tr = self._trace
         for i, (slot, row) in enumerate(zip(chunk, rows)):
             slot.depart()                # reached a batch row: not queued
             if slot.stats is not None:
                 slot.stats.ttft_s = now - slot.req.arrival_time
+            if tr is not None and slot.req.trace_sampled:
+                # Pre-first-token wall, split at the admission dispatch:
+                # queue wait (arrival -> dispatch) vs prefill compute
+                # (dispatch -> install, chunk readback included).
+                t_admit = slot.admit_t or now
+                tr.add(slot.req.trace_id, "sched.queue_wait",
+                       slot.req.arrival_time,
+                       t_admit - slot.req.arrival_time)
+                tr.add(slot.req.trace_id, "sched.prefill", t_admit,
+                       now - t_admit, tokens=len(slot.prompt_ids),
+                       row=row)
             slot.ctx_len = len(slot.prompt_ids)
             # last_emit_t stays 0 until _append_token below sets it: the
             # first token's latency is TTFT, not an inter-token gap — a
@@ -3323,6 +3413,9 @@ class BatchScheduler:
         re-read here — the runtime toggle must not land between the
         divisibility check and this snapshot."""
         prefix = chunk[0].prefix if chunk else None
+        t_admit = time.monotonic()
+        for s in chunk:
+            s.admit_t = t_admit
         tokens, ints, floats, rings, tables = self._admit_host_arrays(
             chunk, rows, S, R, prefix)
         self._prefill_carry = _PrefillCarry(
@@ -3344,6 +3437,8 @@ class BatchScheduler:
         off = pc.off
         self._n_prefill_chunks += 1
         self._admit_since_tick = True
+        self._flight.note("prefill_chunk", self._loop_iter,
+                          off=off, C=C, S=pc.S, n=len(pc.chunk))
         kv, logits, toks_dev = self._dispatch_prefill_chunk(
             P0, pc.S, off, C, pc.tokens[:, off: off + C], pc.ints,
             pc.floats, pc.rings, pc.tables, pc.kv, pc.logits, pc.prefix)
@@ -3432,11 +3527,22 @@ class BatchScheduler:
         Returns (toks_dev [B] or [K,B], snapshot of the rows it decoded
         for, K); _process_tick consumes it, one tick later under
         pipelining."""
+        # Flight event BEFORE the failpoint/device dispatch: if this
+        # very dispatch wedges (the armed-delay stall test), the ring's
+        # last event names it at the iteration the stall marker carries.
+        self._flight.note("dispatch", self._loop_iter,
+                          inflight=inflight)
         # Failpoint: an injected dispatch fault rides the loop's recovery
         # envelope (_fail_all_and_reset) — in-flight requests fail with a
         # well-formed error, the next request serves oracle-exact.
         failpoint("serve.scheduler.dispatch")
         K = self._choose_fuse_k(inflight) if allow_fuse else 1
+        if K != self._last_fuse_k:
+            # Fuse-K decisions are sparse relative to ticks — record
+            # the FLIPS, not every tick, or K=4 steady state would
+            # evict everything else from the ring.
+            self._flight.note("fuse_k", self._loop_iter, k=K)
+            self._last_fuse_k = K
         self._n_decode_ticks += 1
         self._n_decode_steps += K
         if K > 1:
@@ -3805,6 +3911,13 @@ class BatchScheduler:
         whose KV a parked row's per-step garbage scatter would then
         corrupt. All compiled programs key on shapes, which don't change,
         so the only cost is re-allocating the buffers."""
+        self._flight.note("reset", self._loop_iter,
+                          failed=sum(s is not None for s in self._slots))
+        try:
+            path = self._flight.dump("fail_all_and_reset")
+            log.warning("flight recorder dumped to %s", path)
+        except OSError as e:
+            log.warning("flight-recorder dump failed: %s", e)
         for i, s in enumerate(self._slots):
             if s is not None:
                 s.fail("internal error: serving state was reset")
@@ -4203,11 +4316,17 @@ class BatchScheduler:
         self._tier.note_waked(
             len(live),
             tokens_saved=sum(int(ints[1, row]) for _, row in live))
+        tr = self._trace
         for slot, row in live:
             self._wake_hist.observe(wake_ms)
             slot.depart()
             if slot.stats is not None:
                 slot.stats.ttft_s = now - slot.req.arrival_time
+            if tr is not None and slot.req.trace_sampled:
+                tr.add(slot.req.trace_id, "sched.queue_wait",
+                       slot.req.arrival_time, t0 - slot.req.arrival_time)
+                tr.add(slot.req.trace_id, "sched.wake", t0, now - t0,
+                       tokens_saved=int(ints[1, row]), row=row)
             slot.ctx_len = len(slot.prompt_ids)
             self._slots[row] = slot
             if not self._append_token(slot, row, int(first_toks[row])):
@@ -4222,6 +4341,16 @@ class BatchScheduler:
         which must land in the garbage page, never a re-allocated one."""
         slot = self._slots[row]
         self._slots[row] = None
+        if (slot is not None and self._trace is not None
+                and slot.req.trace_sampled and slot.stats is not None
+                and slot.stats.ttft_s is not None):
+            # Decode phase: first token -> release (per-tick gaps are
+            # the inter_token_ms histogram's job; the span carries the
+            # request's share of the decode wall).
+            t_first = slot.req.arrival_time + slot.stats.ttft_s
+            self._trace.add(slot.req.trace_id, "sched.decode", t_first,
+                            time.monotonic() - t_first,
+                            tokens=len(slot.ids), row=row)
         for s in self._sources:
             s.release(row)
         if slot is not None and self._tier is not None:

@@ -143,9 +143,18 @@ class ChatUI:
         if self.suggest_predict > 0:
             payload["options"] = {"num_predict": self.suggest_predict}
         data = json.dumps(payload).encode("utf-8")
+        headers = {"Content-Type": "application/json"}
+        # grafttrace (obs/trace.py): a co-pilot request that arrives
+        # with an X-Graft-Trace header keeps its id on the serve leg,
+        # so the merged timeline covers browser -> UI -> serve. The UI
+        # never mints — an untraced browser stays untraced, and the
+        # serve front mints its own for ingress accounting.
+        tid = req.headers.get("x-graft-trace")
+        if tid:
+            headers["X-Graft-Trace"] = tid
         r = urllib.request.Request(
             f"{self.ollama_url}/api/generate", data=data,
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method="POST")
         try:
             resp = urllib.request.urlopen(r, timeout=self.llm_timeout_s)

@@ -44,7 +44,8 @@ sys.path.insert(0, REPO)
 
 from p2p_llm_chat_tpu.loadgen import (   # noqa: E402
     ChaosWindow, Endpoints, LoadDriver, REGISTRY, build_ledger,
-    build_schedule, check_contracts, error_row, parse_mix, write_row)
+    build_schedule, check_contracts, error_row, fetch_timelines, parse_mix,
+    write_row)
 from p2p_llm_chat_tpu.loadgen.chaos import parse_fail_points  # noqa: E402
 from p2p_llm_chat_tpu.utils.env import (   # noqa: E402
     env_float, env_int, env_or)
@@ -145,8 +146,12 @@ def drive(ep: Endpoints, args, chaos: "ChaosWindow | None") -> dict:
     contract = check_contracts(
         records,
         disarm_at_s=chaos.disarm_at_s if chaos is not None else None)
+    # Breach attribution: lazy per-trace fetch against the serve front
+    # (or router — both expose /admin/trace; the router merges). Only
+    # SLO-breached requests pay a fetch, so a clean run costs nothing.
     row = build_ledger(records, REGISTRY, duration_s=args.duration,
-                       contract=contract)
+                       contract=contract,
+                       timelines=fetch_timelines(ep.serve_url))
     row["wall_s"] = round(wall, 2)
     return row
 

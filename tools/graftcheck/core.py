@@ -53,7 +53,7 @@ class Config:
     """Knobs shared by the analyzers (defaults match this repo)."""
 
     env_prefixes: tuple[str, ...] = ("SERVE_", "BENCH_", "PAGED_", "FAIL_",
-                                     "LOADGEN_", "P2P_")
+                                     "LOADGEN_", "P2P_", "TRACE_")
     env_module: str = "utils/env.py"           # the one blessed reader
     docs_files: tuple[str, ...] = ("docs/serving.md",)
     pytest_ini: str = "pytest.ini"
@@ -63,7 +63,7 @@ class Config:
         "serve/scheduler.py", "serve/engine.py", "serve/multihost.py")
     # Directories whose locks are latency fences: a blocking call under
     # a held lock there is a plane-wide stall (blocking analyzer).
-    hot_lock_dirs: tuple[str, ...] = ("serve/", "p2p/", "loadgen/")
+    hot_lock_dirs: tuple[str, ...] = ("serve/", "p2p/", "loadgen/", "obs/")
     # Metrics contract (metrics_contract analyzer): the name grammar
     # every in-tree series follows, the docs that list series for
     # operators, and the dirs whose string literals count as consumer

@@ -299,7 +299,8 @@ def instrument_module(module, source_path: str) -> list[str]:
 # Packages whose guarded annotations get runtime teeth: the threaded
 # serving + chat planes (the ISSUE-10 surface).
 _DEFAULT_DIRS = ("p2p_llm_chat_tpu/serve", "p2p_llm_chat_tpu/p2p",
-                 "p2p_llm_chat_tpu/loadgen", "p2p_llm_chat_tpu/utils")
+                 "p2p_llm_chat_tpu/loadgen", "p2p_llm_chat_tpu/utils",
+                 "p2p_llm_chat_tpu/obs")
 
 
 def install(root: Optional[str] = None,
