@@ -31,7 +31,9 @@ prompt-lookup drafts cannot match a random-init model's continuations
 (0 accepted drafts measured even at greedy), so its verify forwards
 would be pure overhead on this bench — see BENCH_SPEC below. Set the
 env knobs to measure stripped-down variants, e.g. ``BENCH_KV=dense
-BENCH_QUANT= BENCH_PREFIX=0`` for the plain bf16 dense baseline.
+BENCH_QUANT= BENCH_PREFIX=0`` for the plain bf16 dense baseline, or
+``BENCH_QUANT=int4`` for the group-wise w4a16 weight trunk (half the
+int8 weight stream again).
 
 Env knobs (all optional):
 - ``BENCH_CONFIG``      model config (default bench-1b)
@@ -41,7 +43,10 @@ Env knobs (all optional):
 - ``BENCH_DECODE_STEPS``raw-decode timing steps (default 64)
 - ``BENCH_KV``          dense | paged (default paged)
 - ``BENCH_PAGE_SIZE``   tokens per KV page in paged mode (default 64)
-- ``BENCH_QUANT``       int8 (default) | empty = bf16 weights
+- ``BENCH_QUANT``       weight quantization: ``int8`` (default,
+                        per-channel w8a16) | ``int4`` (group-wise
+                        w4a16 packed nibbles — half the int8 weight
+                        stream again) | empty = bf16 weights
 - ``BENCH_KV_QUANT``    int8 (default) = quantized KV pool (paged only;
                         halves KV read traffic, doubles pool capacity;
                         1.5x step at 1024-token windows and the best
@@ -191,7 +196,12 @@ def main() -> None:
     config = get_config(cfg_name)
     family = family_for(config)   # llama or mixtral (bench-moe)
     dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
-    quant = env_opt("BENCH_QUANT", "int8")   # "" | int8; BENCH_QUANT= = bf16
+    # "" | int8 | int4; BENCH_QUANT= (set-empty) = bf16 weights
+    quant = env_opt("BENCH_QUANT", "int8")
+    if quant not in ("", "int8", "int4"):
+        raise SystemExit(
+            f"BENCH_QUANT must be one of '', 'int8', 'int4'; "
+            f"got {quant!r}")
     workload = env_or("BENCH_WORKLOAD", "")
     # Free-form draft-model spec phase (BENCH_SPEC_WORKLOAD=freeform):
     # the synthetic lm_head follows ONE pseudo-random 95-token cycle
@@ -211,8 +221,7 @@ def main() -> None:
                          "freeform are mutually exclusive (one synthetic "
                          "lm_head per run); pick one statistic")
     synth_mode = "freeform" if spec_workload == "freeform" else "quote"
-    stream_int8 = (quant == "int8"
-                   and hasattr(family, "init_params_quantized"))
+    stream_quant = bool(quant) and hasattr(family, "init_params_quantized")
     if workload == "quote" or spec_workload == "freeform":
         # Speculation / streaming workload (models/synth.py): random
         # transformer layers (full compute) + an embed/lm_head whose
@@ -223,31 +232,40 @@ def main() -> None:
         # true verify-tick cost vs accepted-draft win end-to-end.
         from p2p_llm_chat_tpu.models.synth import quote_params
         params = quote_params(config, jax.random.PRNGKey(0), dtype=dtype,
-                              quantized=stream_int8, mode=synth_mode)
-        if quant == "int8" and not stream_int8:
+                              quantized=stream_quant, mode=synth_mode,
+                              quant=quant or "int8")
+        if quant and not stream_quant:
             from p2p_llm_chat_tpu.models.quant import quantize_params
-            params = quantize_params(params)
-    elif stream_int8:
-        # Streamed straight to fused int8 — never materialises the bf16
-        # tree, which is what lets BENCH_CONFIG=llama3.1-8b (16 GB bf16)
-        # run on one 16 GB v5e chip (llama.init_params_quantized).
+            params = quantize_params(params, mode=quant)
+    elif stream_quant:
+        # Streamed straight to the fused quantized tree — never
+        # materialises the bf16 tree, which is what lets
+        # BENCH_CONFIG=llama3.1-8b (16 GB bf16) run on one 16 GB v5e
+        # chip (llama.init_params_quantized); int4 halves it again.
         params = family.init_params_quantized(config, jax.random.PRNGKey(0),
-                                              dtype=dtype)
+                                              dtype=dtype, quant=quant)
     else:
         params = family.init_params(config, jax.random.PRNGKey(0),
                                     dtype=dtype)
-        if quant == "int8":
+        if quant:
             from p2p_llm_chat_tpu.models.quant import quantize_params
-            params = quantize_params(params)
-    from p2p_llm_chat_tpu.models.quant import QTensor
+            params = quantize_params(params, mode=quant)
+    from p2p_llm_chat_tpu.models.quant import (QTensor, QTensor4,
+                                               param_bytes)
+    # Logical parameter count: int4 packs two weights per stored byte.
     n_params = sum(
-        (x.q.size if isinstance(x, QTensor) else x.size)
+        (x.q.size if isinstance(x, QTensor) else
+         2 * x.q.size if isinstance(x, QTensor4) else x.size)
         for x in jax.tree.leaves(
-            params, is_leaf=lambda x: isinstance(x, QTensor)))
+            params,
+            is_leaf=lambda x: isinstance(x, (QTensor, QTensor4))))
+    # Stored weight bytes — the per-step HBM weight stream.
+    weight_stream_bytes = param_bytes(params)
     jax.block_until_ready(params)
     log(f"params: {n_params/1e9:.2f}B ({dtype.__name__}"
-        f"{', int8 weights' if quant else ''}"
-        f"{', quote workload' if workload == 'quote' else ''})")
+        f"{f', {quant} weights' if quant else ''}"
+        f"{', quote workload' if workload == 'quote' else ''}); "
+        f"weight stream {weight_stream_bytes/1e9:.3f} GB/step")
 
     # Default int8 KV only where it applies: BENCH_KV=dense stripped-down
     # runs and PAGED_ATTN_IMPL=kernel|flash measurements (int8 pools are
@@ -281,6 +299,84 @@ def main() -> None:
     f1 = max(4, n1 // fuse_k)
     f2 = max(2 * f1, n2 // fuse_k)
     raw_params = family.fuse_params(params)
+
+    # -- quantized-matmul dispatch table: for every fused quantized
+    # weight shape of this config, which implementation models/quant.mm
+    # dispatches at decode rows (B=slots) and the chosen output tile —
+    # the autotune table's decision (ops/quant_mm._TILE_TABLE, the
+    # hidden=1024 retune) made durable in the bench JSON so a dispatch
+    # regression shows up as a row diff, not a silent slowdown. On TPU
+    # each kernel-covered shape also times its kernel against forced-XLA
+    # dequant at the same rows — the "no shape regime where the in-tree
+    # kernel loses to XLA" acceptance check.
+    qmm_dispatch: list = []
+    if quant:
+        from p2p_llm_chat_tpu.models.quant import dequantize, dequantize4
+        from p2p_llm_chat_tpu.ops.quant_mm import (_pick_1d_bo, pick_block,
+                                                   pick_int4_bo,
+                                                   quant_matmul,
+                                                   quant_matmul4)
+
+        def _time_ms(fn) -> float:
+            r = fn()                               # compile + warm
+            np.asarray(r).ravel()[:1]
+            t = time.monotonic()
+            for _ in range(10):
+                r = fn()
+            np.asarray(r).ravel()[:1]              # forced sync
+            return (time.monotonic() - t) / 10 * 1e3
+
+        xla8 = jax.jit(lambda x, q, s: x @ dequantize(
+            QTensor(q=q, s=s), x.dtype))
+        xla4 = jax.jit(lambda x, q, s: x @ dequantize4(
+            QTensor4(q=q, s=s), x.dtype))
+        qleaves = {n: v for n, v in raw_params["layers"].items()
+                   if isinstance(v, (QTensor, QTensor4))}
+        if isinstance(raw_params.get("lm_head"), (QTensor, QTensor4)):
+            qleaves["lm_head"] = raw_params["lm_head"]
+        seen_shapes: set = set()
+        for name, leaf in sorted(qleaves.items()):
+            if leaf.q.ndim > 3:
+                continue        # 4-D MoE expert stacks go via q_einsum
+            is4 = isinstance(leaf, QTensor4)
+            stacked = leaf.q.ndim == 3
+            K = leaf.q.shape[-2] * (2 if is4 else 1)
+            O = leaf.q.shape[-1]
+            if (is4, K, O) in seen_shapes:
+                continue
+            seen_shapes.add((is4, K, O))
+            rp = slots + ((-slots) % 8)
+            xi = jnp.dtype(dtype).itemsize
+            if is4:
+                ng = leaf.s.shape[-2]
+                bo = pick_int4_bo(slots, K, O, ng, xi)
+                impl = "kernel-1d" if bo else "xla-dequant"
+            else:
+                bo = _pick_1d_bo(rp, K, O, xi)
+                if bo:
+                    impl = "kernel-1d"
+                else:
+                    bo = (pick_block(O) if pick_block(K) else None)
+                    impl = "kernel-2d" if bo else "xla-dequant"
+            row = {"name": name, "quant": "int4" if is4 else "int8",
+                   "K": K, "O": O, "rows": slots, "impl": impl, "bo": bo}
+            if platform == "tpu" and impl.startswith("kernel"):
+                xq = jnp.ones((slots, K), dtype)
+                qw = leaf.q[0] if stacked else leaf.q
+                sw = leaf.s[0] if stacked else leaf.s
+                if is4:
+                    k_ms = _time_ms(lambda: quant_matmul4(xq, qw, sw))
+                    x_ms = _time_ms(lambda: xla4(xq, qw, sw))
+                else:
+                    k_ms = _time_ms(lambda: quant_matmul(xq, qw, sw))
+                    x_ms = _time_ms(lambda: xla8(xq, qw, sw))
+                row.update(kernel_ms=round(k_ms, 4), xla_ms=round(x_ms, 4),
+                           kernel_speedup=(round(x_ms / k_ms, 3)
+                                           if k_ms > 0 else None))
+            qmm_dispatch.append(row)
+        disp = ", ".join(f"{r['name']}[{r['K']}x{r['O']}]={r['impl']}"
+                         f"(bo={r['bo']})" for r in qmm_dispatch)
+        log(f"qmm dispatch ({quant}, rows={slots}): {disp}")
     if kv_mode == "paged":
         from p2p_llm_chat_tpu.ops.paged_kv import PagedKVCache
 
@@ -432,10 +528,10 @@ def main() -> None:
         Hkv, Dh, Lnum = (config.num_kv_heads, config.head_dim,
                          config.num_layers)
         kv_itemsize = 1 if kv_quant else jnp.dtype(dtype).itemsize
-        # Bound approximation: the full weight stream (int8 bytes ~=
-        # param count) + the KV window walk; activations are noise at
-        # these shapes.
-        weight_bytes = n_params * (1 if quant == "int8" else 2)
+        # Bound approximation: the full weight stream (actual stored
+        # bytes — int8 ~= param count, int4 half that, bf16 2x) + the
+        # KV window walk; activations are noise at these shapes.
+        weight_bytes = weight_stream_bytes
         saved_min_w = env_or("PAGED_APPEND_FLASH_MIN_W", "")
         try:
             for W in long_ws:
@@ -551,21 +647,22 @@ def main() -> None:
         if dcfg.vocab_size != config.vocab_size:
             dcfg = dcfg.with_(vocab_size=config.vocab_size)
         dfam = family_for(dcfg)
-        d_int8 = quant == "int8" and hasattr(dfam, "init_params_quantized")
+        d_quant = bool(quant) and hasattr(dfam, "init_params_quantized")
         if workload == "quote" or spec_workload == "freeform":
             from p2p_llm_chat_tpu.models.synth import quote_params as _qp
             dparams = _qp(dcfg, jax.random.PRNGKey(1), dtype=dtype,
-                          quantized=d_int8, mode=synth_mode)
-        elif d_int8:
+                          quantized=d_quant, mode=synth_mode,
+                          quant=quant or "int8")
+        elif d_quant:
             dparams = dfam.init_params_quantized(dcfg,
                                                  jax.random.PRNGKey(1),
-                                                 dtype=dtype)
+                                                 dtype=dtype, quant=quant)
         else:
             dparams = dfam.init_params(dcfg, jax.random.PRNGKey(1),
                                        dtype=dtype)
-            if quant == "int8":
+            if quant:
                 from p2p_llm_chat_tpu.models.quant import quantize_params
-                dparams = quantize_params(dparams)
+                dparams = quantize_params(dparams, mode=quant)
         drafter = ModelDrafter(dparams, dcfg, num_slots=slots,
                                max_seq=max_seq, k=spec_k)
         log(f"draft model: {draft_name} resident "
@@ -1168,6 +1265,11 @@ def main() -> None:
             "kv_mode": kv_mode,
             "kv_quant": ("int8" if kv_quant else None),
             "quant": quant or None,
+            # Per-weight-shape quantized-matmul dispatch decisions (and,
+            # on TPU, kernel-vs-forced-XLA timings) — the autotune-table
+            # acceptance row (ops/quant_mm._TILE_TABLE).
+            "qmm_dispatch": qmm_dispatch or None,
+            "weight_stream_gb": round(weight_stream_bytes / 1e9, 3),
             "tunnel_rtt_ms": round(rtt_ms, 1),
             "spec_k": spec_k or None,
             "bench_temp": bench_temp,

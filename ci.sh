@@ -141,8 +141,16 @@ if [ "${1:-}" = "full" ]; then
     tests/test_router.py tests/test_kv_tier.py tests/test_loadgen.py \
     tests/test_stress.py -q || rc=1
 
+  # Quantization (round 16): the WHOLE file including the slow-marked
+  # w4a16 interpret shape matrix (bench-relevant hidden sizes incl. the
+  # hidden=1024 tile-table retune). Excluded from the sweep below so
+  # each case executes exactly once.
+  echo "== quantization: int8 + int4 full matrix (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_quant.py -q || rc=1
+
   echo "== full test suite"
   python -m pytest tests/ -q \
+    --ignore=tests/test_quant.py \
     --ignore=tests/test_flash_append_geometry.py \
     --ignore=tests/test_failpoints.py \
     --ignore=tests/test_router.py \
@@ -264,8 +272,20 @@ else
   JAX_PLATFORMS=cpu python -m pytest tests/test_loadgen.py \
     tests/test_devcrypto.py -q -x -m 'not slow' || rc=1
 
+  # Weight quantization (round 16, tier-1 legs): int8 + int4 pack/
+  # round-trip bounds, Pallas kernel parity in interpret mode (both
+  # precisions, stacked + unstacked), the autotune-table dispatch pins
+  # (hidden=1024 bo cap), and the engine greedy oracles — pinned on CPU
+  # regardless of the host's accelerator. The slow-marked w4a16 shape
+  # matrix runs in full mode. Excluded from the sweep below so each
+  # case executes exactly once.
+  echo "== weight quantization: int8 + int4 parity (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_quant.py -q -x \
+    -m 'not slow' || rc=1
+
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
+    --ignore=tests/test_quant.py \
     --ignore=tests/test_trace.py \
     --ignore=tests/test_loadgen.py \
     --ignore=tests/test_devcrypto.py \

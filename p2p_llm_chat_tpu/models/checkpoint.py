@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding
 from ..utils.log import get_logger
 from ..parallel.sharding import LogicalRules, DEFAULT_RULES, spec_for
 from .configs import CONFIGS, ModelConfig
-from .quant import QTensor
+from .quant import QTensor, QTensor4
 
 log = get_logger("checkpoint")
 
@@ -61,8 +61,8 @@ def save_checkpoint(ckpt_dir: str, params: dict, config: ModelConfig) -> None:
     Orbax."""
     import orbax.checkpoint as ocp
 
-    if any(isinstance(x, QTensor) for x in jax.tree.leaves(
-            params, is_leaf=lambda x: isinstance(x, QTensor))):
+    if any(isinstance(x, (QTensor, QTensor4)) for x in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, (QTensor, QTensor4)))):
         raise ValueError("save the bf16 tree and re-quantize after restore "
                          "(models/checkpoint.py docstring)")
     ckpt_dir = os.path.abspath(ckpt_dir)

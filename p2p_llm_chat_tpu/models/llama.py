@@ -27,7 +27,7 @@ from jax.sharding import Mesh
 
 from ..parallel.sharding import LogicalRules, DEFAULT_RULES, constrain
 from .configs import ModelConfig
-from .quant import LayerSlice, QTensor, mm
+from .quant import LayerSlice, QTensor, QTensor4, mm
 from .layers import (
     DEFAULT_COMPUTE_DTYPE,
     apply_rope,
@@ -91,9 +91,14 @@ def init_params(config: ModelConfig, key: jax.Array,
 
 
 def init_params_quantized(config: ModelConfig, key: jax.Array,
-                          dtype=DEFAULT_COMPUTE_DTYPE) -> dict:
-    """Random init streamed straight into int8 QTensors, one layer at a
-    time — the bf16 tree is never materialised.
+                          dtype=DEFAULT_COMPUTE_DTYPE,
+                          quant: str = "int8") -> dict:
+    """Random init streamed straight into quantized tensors, one layer
+    at a time — the bf16 tree is never materialised. ``quant``:
+    ``int8`` (per-channel QTensor) or ``int4`` (group-wise QTensor4 —
+    packed nibbles, HALF the int8 footprint again; leaves whose
+    contraction dim cannot group fall back to int8 per
+    quant._quantize_leaf).
 
     Why: ``init_params`` + ``quantize_params`` peaks at the full bf16
     model (~16 GB for llama3.1-8B), which cannot fit a single v5e chip's
@@ -110,8 +115,10 @@ def init_params_quantized(config: ModelConfig, key: jax.Array,
     random-init serving only — real checkpoints stream through
     models/weights.py.
     """
-    from .quant import quantize
+    from .quant import _quantize_leaf, stream_bufs
 
+    if quant not in ("int8", "int4"):
+        raise ValueError(f"quant must be int8|int4, got {quant!r}")
     L, H, E = config.num_layers, config.hidden_size, config.intermediate_size
     std = H ** -0.5
     key, k_embed, k_head = jax.random.split(key, 3)
@@ -130,8 +137,7 @@ def init_params_quantized(config: ModelConfig, key: jax.Array,
         "mlp_norm": jnp.ones((L, H), dtype),
     }
     for name, (din, dout) in dims.items():
-        layers[name] = QTensor(q=jnp.zeros((L, din, dout), jnp.int8),
-                               s=jnp.zeros((L, 1, dout), jnp.float32))
+        layers[name] = stream_bufs(L, (din, dout), quant)
 
     import functools
 
@@ -140,9 +146,9 @@ def init_params_quantized(config: ModelConfig, key: jax.Array,
         ks = jax.random.split(k, len(dims))
         out = dict(bufs)
         for i, (name, (din, dout)) in enumerate(dims.items()):
-            qt = quantize(normal(ks[i], (din, dout)))
-            out[name] = QTensor(q=bufs[name].q.at[layer].set(qt.q),
-                                s=bufs[name].s.at[layer].set(qt.s))
+            qt = _quantize_leaf(normal(ks[i], (din, dout)), quant)
+            out[name] = type(qt)(q=bufs[name].q.at[layer].set(qt.q),
+                                 s=bufs[name].s.at[layer].set(qt.s))
         return out
 
     bufs = {name: layers[name] for name in dims}
@@ -157,7 +163,8 @@ def init_params_quantized(config: ModelConfig, key: jax.Array,
         "final_norm": jnp.ones((H,), dtype),
     }
     if not config.tie_embeddings:
-        params["lm_head"] = quantize(normal(k_head, (H, config.vocab_size)))
+        params["lm_head"] = _quantize_leaf(
+            normal(k_head, (H, config.vocab_size)), quant)
     jax.block_until_ready(params)
     return params
 
@@ -236,9 +243,12 @@ def fuse_params(params: dict, tp: int = 1, mesh: Optional[Mesh] = None,
             out = jnp.concatenate(blk, axis=-1)
             return out.reshape(*out.shape[:-2], -1)
 
-        if isinstance(ws[0], QTensor):
-            return QTensor(q=icat([w.q for w in ws]),
-                           s=icat([w.s for w in ws]))
+        if isinstance(ws[0], (QTensor, QTensor4)):
+            # Both precisions concat on the OUT axis: int8 scales ride
+            # their columns; int4's packed rows and group scales share
+            # the contraction layout, so columns concat the same way.
+            return type(ws[0])(q=icat([w.q for w in ws]),
+                               s=icat([w.s for w in ws]))
         return icat(ws)
 
     fuse_mlp = layers["w_gate"].ndim == 3   # dense [L,H,E]
@@ -265,8 +275,8 @@ def fuse_params(params: dict, tp: int = 1, mesh: Optional[Mesh] = None,
             def put_arr(a):
                 spec = [None] * (a.ndim - 1) + [tp_ax]
                 return jax.device_put(a, NamedSharding(mesh, P(*spec)))
-            if isinstance(leaf, QTensor):
-                return QTensor(q=put_arr(leaf.q), s=put_arr(leaf.s))
+            if isinstance(leaf, (QTensor, QTensor4)):
+                return type(leaf)(q=put_arr(leaf.q), s=put_arr(leaf.s))
             return put_arr(leaf)
 
         fused["wqkv"] = put(fused["wqkv"])
@@ -318,11 +328,11 @@ def _layer_view(layers: dict, layer: jax.Array) -> dict:
     """
     out = {}
     for k, v in layers.items():
-        if isinstance(v, QTensor):
+        if isinstance(v, (QTensor, QTensor4)):
             if v.q.ndim == 3:
                 out[k] = LayerSlice(v, layer)
             else:
-                out[k] = QTensor(
+                out[k] = type(v)(
                     q=jax.lax.dynamic_index_in_dim(v.q, layer, 0, False),
                     s=jax.lax.dynamic_index_in_dim(v.s, layer, 0, False))
         else:

@@ -89,22 +89,28 @@ def init_params(config: ModelConfig, key: jax.Array,
 
 
 def init_params_quantized(config: ModelConfig, key: jax.Array,
-                          dtype=DEFAULT_COMPUTE_DTYPE) -> dict:
-    """Random init streamed straight into the FUSED int8 tree — the MoE
-    twin of ``llama.init_params_quantized`` (same why: the bf16 tree
+                          dtype=DEFAULT_COMPUTE_DTYPE,
+                          quant: str = "int8") -> dict:
+    """Random init streamed straight into the FUSED quantized tree — the
+    MoE twin of ``llama.init_params_quantized`` (same why: the bf16 tree
     cannot exist on a single chip at big-model scale, the int8 one can).
 
     Per layer, a donated write loop quantizes wqkv (attention fused),
     wo, the per-expert fused ``wgu_e`` [NE,H,2F], and w_down [NE,F,H];
     the router stays bf16 (tiny, and routing math is f32 anyway — HF
-    parity). ``fuse_params`` is a no-op on the result. Synthetic-bench /
-    random-init serving only — real checkpoints stream through
+    parity). ``fuse_params`` is a no-op on the result. ``quant="int4"``
+    streams group-wise QTensor4 leaves (the expert stacks group along
+    axis -2 exactly like the dense projections; MoE compute goes through
+    q_einsum's dequant path). Synthetic-bench / random-init serving only
+    — real checkpoints stream through
     models/weights.load_checkpoint_quantized.
     """
     import functools
 
-    from .quant import QTensor, quantize
+    from .quant import _quantize_leaf, stream_bufs
 
+    if quant not in ("int8", "int4"):
+        raise ValueError(f"quant must be int8|int4, got {quant!r}")
     assert config.is_moe, "mixtral.init_params_quantized needs experts"
     L, H, E = config.num_layers, config.hidden_size, config.intermediate_size
     NE = config.num_experts
@@ -124,9 +130,7 @@ def init_params_quantized(config: ModelConfig, key: jax.Array,
         "attn_norm": jnp.ones((L, H), dtype),
         "mlp_norm": jnp.ones((L, H), dtype),
     }
-    bufs = {name: QTensor(q=jnp.zeros((L, *shape), jnp.int8),
-                          s=jnp.zeros((L, *shape[:-2], 1, shape[-1]),
-                                      jnp.float32))
+    bufs = {name: stream_bufs(L, shape, quant)
             for name, shape in dims.items()}
     router = jnp.zeros((L, H, NE), dtype)
 
@@ -136,9 +140,9 @@ def init_params_quantized(config: ModelConfig, key: jax.Array,
         ks = jax.random.split(k, len(dims) + 1)
         out = dict(bufs)
         for i, (name, shape) in enumerate(dims.items()):
-            qt = quantize(normal(ks[i], shape))
-            out[name] = QTensor(q=bufs[name].q.at[layer].set(qt.q),
-                                s=bufs[name].s.at[layer].set(qt.s))
+            qt = _quantize_leaf(normal(ks[i], shape), quant)
+            out[name] = type(qt)(q=bufs[name].q.at[layer].set(qt.q),
+                                 s=bufs[name].s.at[layer].set(qt.s))
         router2 = router.at[layer].set(normal(ks[-1], (H, NE)))
         return out, router2
 
@@ -155,7 +159,8 @@ def init_params_quantized(config: ModelConfig, key: jax.Array,
         "final_norm": jnp.ones((H,), dtype),
     }
     if not config.tie_embeddings:
-        params["lm_head"] = quantize(normal(k_head, (H, config.vocab_size)))
+        params["lm_head"] = _quantize_leaf(
+            normal(k_head, (H, config.vocab_size)), quant)
     return params
 
 

@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization (w8a16) for the serving stack.
+"""Weight-only int8 (w8a16) and int4 (w4a16) quantization for serving.
 
 Decode is HBM-bandwidth-bound: every step streams the full weight set
 through the MXU at batch sizes far too small to amortise it (SURVEY.md §6
@@ -27,6 +27,20 @@ TPU-first shape of the idea:
 Accuracy: per-channel symmetric int8 keeps |w - dequant(w)| <= s/2
 elementwise (tests/test_quant.py pins the bound and end-to-end logit
 agreement).
+
+int4 (w4a16, :class:`QTensor4`) halves the weight stream AGAIN vs int8
+— the 8B decode trunk drops ~7.6 GB -> ~3.8 GB per step. Per-channel
+scales lose too much at 4 bits, so scales go **group-wise** along the
+contraction axis (AWQ/GPTQ-style, group 128 with a 64 fallback): one f32
+scale per (group, out-channel). Two 4-bit values pack per int8 byte in a
+split-half layout — byte row ``i`` of ``q[..., K/2, O]`` holds logical
+row ``i`` in its low nibble and row ``i + K/2`` in its high nibble, each
+stored offset-by-8 in [0, 15] — chosen so a contiguous run of byte rows
+is exactly one lo-half group plus one hi-half group and the Pallas
+kernel (ops/quant_mm.quant_matmul4) unpacks group-pairs in VMEM without
+any cross-row shuffle. Symmetric clip to [-7, 7] (the -8 code is
+unused), scale = group-abs-max / 7, so |w - dequant(w)| <= s_g/2 holds
+per group exactly like int8's per-channel bound.
 """
 
 from __future__ import annotations
@@ -51,6 +65,34 @@ class QTensor(NamedTuple):
     @property
     def ndim(self) -> int:
         return self.q.ndim
+
+
+class QTensor4(NamedTuple):
+    """Packed int4 weight + f32 group-wise scales.
+
+    ``q``: int8 ``[..., K/2, O]`` — two offset-by-8 nibbles per byte in
+    the split-half layout (module docstring). ``s``: f32 ``[..., ng, O]``
+    with ``ng = K / group``. No static metadata field: both the logical
+    contraction dim (``2 * q.shape[-2]``) and the group size derive from
+    the array shapes, so the NamedTuple stays a plain two-leaf pytree
+    (scan / donation / sharding safe, exactly like :class:`QTensor`).
+    """
+
+    q: jax.Array
+    s: jax.Array
+
+    @property
+    def shape(self):
+        """LOGICAL shape [..., K, O] (not the packed storage shape)."""
+        return (*self.q.shape[:-2], 2 * self.q.shape[-2], self.q.shape[-1])
+
+    @property
+    def ndim(self) -> int:
+        return self.q.ndim
+
+    @property
+    def group(self) -> int:
+        return 2 * self.q.shape[-2] // self.s.shape[-2]
 
 
 class LayerSlice(NamedTuple):
@@ -89,6 +131,65 @@ def quantize(w: jax.Array, axis: int = -2) -> QTensor:
 
 def dequantize(w: QTensor, dtype=jnp.bfloat16) -> jax.Array:
     return (w.q.astype(jnp.float32) * w.s).astype(dtype)
+
+
+def pack4(v: jax.Array) -> jax.Array:
+    """Pack int values in [-8, 7] (shape ``[..., K, O]``, K even) into
+    the split-half int8 nibble layout ``[..., K/2, O]``: byte row ``i``
+    = logical row ``i`` (low nibble) | logical row ``i + K/2`` (high),
+    each offset by +8 into [0, 15]. The int8 reinterpretation of bytes
+    >= 128 wraps explicitly (XLA's out-of-range int8 cast is
+    implementation-defined)."""
+    K = v.shape[-2]
+    if K % 2:
+        raise ValueError(f"pack4 needs an even contraction dim, got {K}")
+    vi = v.astype(jnp.int32)
+    lo = jax.lax.slice_in_dim(vi, 0, K // 2, axis=-2) + 8
+    hi = jax.lax.slice_in_dim(vi, K // 2, K, axis=-2) + 8
+    b = lo | (hi << 4)                               # [0, 255]
+    return jnp.where(b >= 128, b - 256, b).astype(jnp.int8)
+
+
+def unpack4(p: jax.Array) -> jax.Array:
+    """Invert :func:`pack4`: int8 ``[..., K/2, O]`` -> int32 values in
+    [-8, 7] at the logical ``[..., K, O]``. Nibble extraction runs in
+    int32 where ``& 0xF`` / arithmetic ``>> 4`` are sign-robust for the
+    negative reinterpreted bytes."""
+    pi = p.astype(jnp.int32)
+    lo = (pi & 0xF) - 8
+    hi = ((pi >> 4) & 0xF) - 8
+    return jnp.concatenate([lo, hi], axis=-2)
+
+
+def quantize4(w: jax.Array, group: int | None = None) -> QTensor4:
+    """Symmetric int4 quantization with group-wise scales over the -2
+    (contraction) axis: each run of ``group`` input channels feeding one
+    output unit shares an f32 scale = group-abs-max / 7 (clip to
+    [-7, 7]; the -8 code stays unused so the bound |w - deq| <= s_g/2
+    holds without clipping loss). ``group`` defaults to 128 (the Pallas
+    kernel's lane-aligned size) with a 64 fallback for small dims."""
+    wf = w.astype(jnp.float32)
+    K = wf.shape[-2]
+    if group is None:
+        group = 128 if K % 128 == 0 else 64
+    if K % group or K % 2:
+        raise ValueError(f"group {group} must divide even K={K}")
+    ng = K // group
+    g = wf.reshape(*wf.shape[:-2], ng, group, wf.shape[-1])
+    amax = jnp.max(jnp.abs(g), axis=-2, keepdims=True)
+    s = jnp.where(amax > 0, amax / 7.0, 1.0)         # [..., ng, 1, O]
+    qv = jnp.clip(jnp.round(g / s), -7, 7).astype(jnp.int32)
+    qv = qv.reshape(*wf.shape[:-2], K, wf.shape[-1])
+    return QTensor4(q=pack4(qv), s=jnp.squeeze(s, -2))
+
+
+def dequantize4(w: QTensor4, dtype=jnp.bfloat16) -> jax.Array:
+    v = unpack4(w.q).astype(jnp.float32)             # [..., K, O]
+    ng = w.s.shape[-2]
+    K = v.shape[-2]
+    g = v.reshape(*v.shape[:-2], ng, K // ng, v.shape[-1])
+    out = g * w.s[..., :, None, :]
+    return out.reshape(v.shape).astype(dtype)
 
 
 # Row threshold for the Pallas w8a16 path: decode/verify ticks sit far
@@ -133,6 +234,14 @@ def _deq_once(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
     return jax.lax.optimization_barrier(dequantize(QTensor(q, s), dtype))
 
 
+def _deq4_once(w: QTensor4, dtype) -> jax.Array:
+    """Int4 twin of :func:`_deq_once`: materialise the group-dequantized
+    bf16 weight exactly once behind an optimization barrier so
+    prefill-shaped dots stream it at matmul speed instead of re-running
+    the unpack+scale per M-tile."""
+    return jax.lax.optimization_barrier(dequantize4(w, dtype))
+
+
 def mm(x: jax.Array, w) -> jax.Array:
     """``x @ w`` for a plain array or a :class:`QTensor`.
 
@@ -159,8 +268,39 @@ def mm(x: jax.Array, w) -> jax.Array:
                 q=jax.lax.dynamic_index_in_dim(inner.q, layer, 0, False),
                 s=jax.lax.dynamic_index_in_dim(inner.s, layer, 0, False))
             return mm(x, inner)
+        if isinstance(inner, QTensor4):
+            if (inner.q.ndim == 3 and rows <= _KERNEL_MAX_ROWS
+                    and _kernel_wanted()):
+                from ..ops.quant_mm import (pick_int4_bo,
+                                            quant_matmul_stacked4)
+                if pick_int4_bo(rows, H, inner.q.shape[-1],
+                                inner.s.shape[-2], x.dtype.itemsize):
+                    y = quant_matmul_stacked4(x.reshape(rows, H), inner.q,
+                                              inner.s, layer)
+                    return y.reshape(*lead, inner.q.shape[-1])
+            inner = QTensor4(
+                q=jax.lax.dynamic_index_in_dim(inner.q, layer, 0, False),
+                s=jax.lax.dynamic_index_in_dim(inner.s, layer, 0, False))
+            return mm(x, inner)
         raise TypeError("LayerSlice wraps stacked QTensors only; slice "
                         "plain stacked arrays eagerly (llama._layer_view)")
+    if isinstance(w, QTensor4):
+        lead, H = x.shape[:-1], x.shape[-1]
+        rows = 1
+        for d in lead:
+            rows *= d
+        O = w.q.shape[-1]
+        if w.q.ndim == 2 and rows <= _KERNEL_MAX_ROWS and _kernel_wanted():
+            from ..ops.quant_mm import pick_int4_bo, quant_matmul4
+            if pick_int4_bo(rows, H, O, w.s.shape[-2], x.dtype.itemsize):
+                y = quant_matmul4(x.reshape(rows, H), w.q, w.s)
+                return y.reshape(*lead, O)
+        if rows > _KERNEL_MAX_ROWS and w.q.ndim == 2:
+            return x @ _deq4_once(w, x.dtype)
+        # Group-wise scales vary along the contraction axis, so there is
+        # no scale-after-dot inline form like int8's; small uncovered
+        # shapes dequantize inline (one M-tile, XLA fuses it).
+        return x @ dequantize4(w, x.dtype)
     if isinstance(w, QTensor):
         lead, H = x.shape[:-1], x.shape[-1]
         rows = 1
@@ -185,6 +325,11 @@ def q_einsum(spec: str, x: jax.Array, w) -> jax.Array:
     if isinstance(w, QTensor):
         y = jnp.einsum(spec, x, w.q.astype(x.dtype))
         return y * w.s.astype(x.dtype)       # s: [..., 1, out] broadcasts
+    if isinstance(w, QTensor4):
+        # Group scales vary along the contracted axis: no post-einsum
+        # scale fold exists, so the expert einsums dequantize first
+        # (compute-bound expert batches — the convert amortises).
+        return jnp.einsum(spec, x, _deq4_once(w, x.dtype))
     return jnp.einsum(spec, x, w)
 
 
@@ -198,14 +343,46 @@ _QUANT_LEAVES = frozenset({
 })
 
 
-def quantize_params(params: dict, mesh=None) -> dict:
+def _quantize_leaf(v: jax.Array, mode: str):
+    """One matmul weight leaf at ``mode``. int4 needs a group (128, else
+    64) dividing the even contraction dim; leaves whose dims cannot group
+    (odd / sub-64 contraction — tiny test heads) fall back to per-channel
+    int8 so a mixed tree still serves."""
+    if mode == "int4":
+        K = v.shape[-2]
+        if K % 2 == 0 and (K % 128 == 0 or K % 64 == 0):
+            return quantize4(v)
+    return quantize(v)
+
+
+def stream_bufs(L: int, shape: tuple, mode: str):
+    """Zero stacked quantized buffers ``[L, *shape]`` matching
+    :func:`_quantize_leaf`'s precision choice for this shape — the
+    donated per-layer streaming loops (llama/mixtral
+    ``init_params_quantized``, weights.load_checkpoint_quantized) splice
+    layer slices into these so the bf16 tree never materialises."""
+    K, O = shape[-2], shape[-1]
+    if mode == "int4" and K % 2 == 0 and (K % 128 == 0 or K % 64 == 0):
+        group = 128 if K % 128 == 0 else 64
+        return QTensor4(
+            q=jnp.zeros((L, *shape[:-2], K // 2, O), jnp.int8),
+            s=jnp.zeros((L, *shape[:-2], K // group, O), jnp.float32))
+    return QTensor(q=jnp.zeros((L, *shape), jnp.int8),
+                   s=jnp.zeros((L, *shape[:-2], 1, O), jnp.float32))
+
+
+def quantize_params(params: dict, mesh=None, mode: str = "int8") -> dict:
     """Quantize every matmul weight leaf of a model param tree in place of
-    its bf16 array (embed/norms/router stay as-is). Works on sharded
+    its bf16 array (embed/norms/router stay as-is). ``mode``: ``int8``
+    (per-output-channel scales) or ``int4`` (group-wise — see
+    :func:`quantize4`; ungroupable leaves keep int8). Works on sharded
     params too — quantize *after* ``shard_params`` so q/s derive their
     shardings from the weight's, and pass that ``mesh`` here: the Pallas
-    decode-matmul kernel cannot consume mesh-sharded operands (no
+    decode-matmul kernels cannot consume mesh-sharded operands (no
     shard_map wrapper yet), so a mesh forces the XLA path process-wide
     rather than leaving the guard to each construction site."""
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"mode must be int8|int4, got {mode!r}")
     if mesh is not None:
         set_mm_impl("xla")
 
@@ -215,14 +392,36 @@ def quantize_params(params: dict, mesh=None) -> dict:
             if isinstance(v, dict):
                 out[k] = walk(v)
             elif k in _QUANT_LEAVES:
-                out[k] = quantize(v)
+                out[k] = _quantize_leaf(v, mode)
             else:
                 out[k] = v
         return out
     return walk(params)
 
 
+def _is_qleaf(x) -> bool:
+    return isinstance(x, (QTensor, QTensor4))
+
+
 def is_quantized(params: dict) -> bool:
-    return any(isinstance(x, QTensor)
-               for x in jax.tree.leaves(
-                   params, is_leaf=lambda x: isinstance(x, QTensor)))
+    return any(_is_qleaf(x)
+               for x in jax.tree.leaves(params, is_leaf=_is_qleaf))
+
+
+def quant_mode(params: dict) -> str:
+    """``"int4"`` if any leaf is a QTensor4, ``"int8"`` if any is a
+    QTensor, else ``""`` (bf16) — the label serving stamps on logs and
+    the ``model_weight_bytes{quant=}`` metric."""
+    leaves = jax.tree.leaves(params, is_leaf=_is_qleaf)
+    if any(isinstance(x, QTensor4) for x in leaves):
+        return "int4"
+    if any(isinstance(x, QTensor) for x in leaves):
+        return "int8"
+    return ""
+
+
+def param_bytes(params: dict) -> int:
+    """Actual stored bytes of the tree (int4 packed bytes count as
+    stored, i.e. half a byte per logical weight) — the weight-stream
+    size a decode step reads from HBM."""
+    return sum(x.nbytes for x in jax.tree.leaves(params))

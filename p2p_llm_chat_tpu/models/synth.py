@@ -75,12 +75,13 @@ def successor_map(vocab: int, mode: str = "quote") -> np.ndarray:
 
 def quote_params(config: ModelConfig, key: jax.Array,
                  dtype=jnp.bfloat16, quantized: bool = False,
-                 mode: str = "quote") -> dict:
+                 mode: str = "quote", quant: str = "int8") -> dict:
     """Full-size tree (random transformer layers of the config's FAMILY —
     llama or mixtral — full compute) with the quote-workload
-    embed/lm_head. ``quantized=True`` returns int8 matmul leaves (the
-    llama family streams straight to fused int8; other families quantize
-    after init). Requires an untied lm_head.
+    embed/lm_head. ``quantized=True`` returns quantized matmul leaves at
+    ``quant`` (``int8`` per-channel or ``int4`` group-wise; both
+    families stream straight to the fused quantized tree). Requires an
+    untied lm_head.
 
     ``mode="freeform"`` swaps the 16-token repeat cycles for one
     pseudo-random 95-token cycle (see :func:`successor_map`): greedy
@@ -99,13 +100,14 @@ def quote_params(config: ModelConfig, key: jax.Array,
         raise ValueError("quote workload needs an untied lm_head")
     family = family_for(config)
     if quantized and hasattr(family, "init_params_quantized"):
-        # Both families stream straight to fused int8 now (llama and
-        # mixtral expose init_params_quantized).
-        params = family.init_params_quantized(config, key, dtype=dtype)
+        # Both families stream straight to the fused quantized tree
+        # (llama and mixtral expose init_params_quantized).
+        params = family.init_params_quantized(config, key, dtype=dtype,
+                                              quant=quant)
     else:
         params = dict(family.init_params(config, key, dtype=dtype))
         if quantized:
-            params = quantize_params(params)
+            params = quantize_params(params, mode=quant)
 
     # Damp the residual-writing projections (wo, w_down / expert
     # w_down): the cycle construction needs the residual stream to stay
@@ -113,11 +115,12 @@ def quote_params(config: ModelConfig, key: jax.Array,
     # random layers' perturbation otherwise out-shouts the successor
     # margin (observed at the `tiny` config). Compute cost is unchanged
     # — the matmuls still run at full shape.
-    from .quant import QTensor
+    from .quant import QTensor, QTensor4
 
     def damp(leaf):
-        if isinstance(leaf, QTensor):
-            return QTensor(q=leaf.q, s=leaf.s * 0.1)
+        if isinstance(leaf, (QTensor, QTensor4)):
+            # Scales are linear in the weight for both precisions.
+            return type(leaf)(q=leaf.q, s=leaf.s * 0.1)
         return leaf * 0.1
 
     layers = dict(params["layers"])
@@ -156,13 +159,28 @@ def quote_params(config: ModelConfig, key: jax.Array,
     del old_head
     params["embed"] = jnp.asarray(emb, dtype)
     if quantized:
-        # Quantize HOST-side (exact mirror of quant.quantize, axis=-2):
-        # uploading lm as f32 to quantize on device is a 2.1 GB HBM spike
-        # at 8B dims that OOM'd the spec-enabled quote bench.
-        amax = np.abs(lm).max(axis=0, keepdims=True)
-        s = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
-        q = np.clip(np.round(lm / s), -127, 127).astype(np.int8)
-        params["lm_head"] = QTensor(q=jnp.asarray(q), s=jnp.asarray(s))
+        # Quantize HOST-side (exact mirrors of quant.quantize /
+        # quant.quantize4, axis=-2): uploading lm as f32 to quantize on
+        # device is a 2.1 GB HBM spike at 8B dims that OOM'd the
+        # spec-enabled quote bench.
+        K = lm.shape[0]
+        if (quant == "int4" and K % 2 == 0
+                and (K % 128 == 0 or K % 64 == 0)):
+            group = 128 if K % 128 == 0 else 64
+            g = lm.reshape(K // group, group, V)
+            amax = np.abs(g).max(axis=1, keepdims=True)
+            s = np.where(amax > 0, amax / 7.0, 1.0).astype(np.float32)
+            qv = np.clip(np.round(g / s), -7, 7).astype(np.int32)
+            qv = qv.reshape(K, V)
+            packed = ((qv[:K // 2] + 8) | ((qv[K // 2:] + 8) << 4))
+            packed = packed.astype(np.uint8).view(np.int8)
+            params["lm_head"] = QTensor4(q=jnp.asarray(packed),
+                                         s=jnp.asarray(np.squeeze(s, 1)))
+        else:
+            amax = np.abs(lm).max(axis=0, keepdims=True)
+            s = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+            q = np.clip(np.round(lm / s), -127, 127).astype(np.int8)
+            params["lm_head"] = QTensor(q=jnp.asarray(q), s=jnp.asarray(s))
     else:
         params["lm_head"] = jnp.asarray(lm, dtype)
     return params

@@ -375,10 +375,13 @@ def _check_config_identity(supplied: ModelConfig, stored: ModelConfig,
 
 def load_checkpoint_quantized(ckpt_dir: str,
                               config: Optional[ModelConfig] = None,
+                              quant: str = "int8",
                               ) -> tuple[dict, ModelConfig]:
     """Single-chip big-model load: stream a checkpoint (HF safetensors or
-    native Orbax) straight into the FUSED int8 stacked tree — the bf16
-    device tree never exists.
+    native Orbax) straight into the FUSED quantized stacked tree — the
+    bf16 device tree never exists. ``quant``: ``int8`` (per-channel) or
+    ``int4`` (group-wise packed nibbles — half the int8 stream again;
+    ~3.8 GB for the 8B trunk).
 
     Why: ``load_checkpoint`` + ``quantize_params`` peaks at the full bf16
     model on the chip (~16 GB for llama3.1-8B — does not fit a 16 GB
@@ -392,7 +395,9 @@ def load_checkpoint_quantized(ckpt_dir: str,
 
     Weights round through bf16 (the serving compute dtype) before
     quantization, so the result is BIT-IDENTICAL to load-at-bf16 ->
-    quantize_params -> fuse_params (pinned by tests for both formats).
+    quantize_params -> fuse_params (pinned by tests for both formats
+    and both precisions — the host numpy quantizers below mirror
+    quant.quantize / quant.quantize4's exact IEEE f32 ops).
     For f32-SAVED native checkpoints the old single-chip path would have
     quantized unrounded f32 — that path cannot fit big models anyway, and
     all in-tree saves default to bf16.
@@ -408,8 +413,10 @@ def load_checkpoint_quantized(ckpt_dir: str,
     from . import family_for, llama, mixtral
     from .checkpoint import is_native_checkpoint, peek_config
     from .checkpoint import load_checkpoint as load_native
-    from .quant import QTensor
+    from .quant import QTensor, QTensor4, stream_bufs
 
+    if quant not in ("int8", "int4"):
+        raise ValueError(f"quant must be int8|int4, got {quant!r}")
     dtype = jnp.bfloat16
 
     # Family gate FIRST — from metadata alone. Checking after the tensor
@@ -518,32 +525,59 @@ def load_checkpoint_quantized(ckpt_dir: str,
             "wgu": (H, 2 * E),
             "w_down": (E, H),
         }
-    bufs = {name: QTensor(q=jnp.zeros((L, *shape), jnp.int8),
-                          s=jnp.zeros((L, *shape[:-2], 1, shape[-1]),
-                                      jnp.float32))
+    bufs = {name: stream_bufs(L, shape, quant)
             for name, shape in dims.items()}
 
     import ml_dtypes
 
-    def host_quant(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    def _bf16_round(w: np.ndarray) -> np.ndarray:
         # Round through bf16 first: the reference path (load bf16 tree,
         # then quantize_params) sees bf16-rounded weights, and HF shards
         # are often f32 — skipping the rounding would drift the scales.
+        return np.asarray(w).astype(ml_dtypes.bfloat16).astype(np.float32)
+
+    def _host_quant8(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         # axis=-2 is the contraction axis for 2-D projections and the
         # [NE, H, F] expert stacks alike (quant.quantize's axis).
-        wf = (np.asarray(w).astype(ml_dtypes.bfloat16)
-              .astype(np.float32))
+        wf = _bf16_round(w)
         amax = np.abs(wf).max(axis=-2, keepdims=True)
         s = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
         q = np.clip(np.round(wf / s), -127, 127).astype(np.int8)
         return q, s
 
+    def _host_quant4(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        # quant.quantize4's exact math in host numpy: group-wise abs-max
+        # / 7, round-half-even, clip to [-7, 7], split-half nibble pack
+        # (quant.pack4's layout; the uint8 view IS the explicit wrap).
+        wf = _bf16_round(w)
+        K = wf.shape[-2]
+        group = 128 if K % 128 == 0 else 64
+        ng = K // group
+        g = wf.reshape(*wf.shape[:-2], ng, group, wf.shape[-1])
+        amax = np.abs(g).max(axis=-2, keepdims=True)
+        s = np.where(amax > 0, amax / 7.0, 1.0).astype(np.float32)
+        qv = np.clip(np.round(g / s), -7, 7).astype(np.int32)
+        qv = qv.reshape(*wf.shape[:-2], K, wf.shape[-1])
+        lo = qv[..., :K // 2, :] + 8
+        hi = qv[..., K // 2:, :] + 8
+        q = (lo | (hi << 4)).astype(np.uint8).view(np.int8)
+        return q, np.squeeze(s, -2)
+
+    def host_quant(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        # Per-leaf precision mirrors quant._quantize_leaf: int4 needs a
+        # group (128, else 64) dividing the even contraction dim.
+        K = w.shape[-2]
+        if (quant == "int4" and K % 2 == 0
+                and (K % 128 == 0 or K % 64 == 0)):
+            return _host_quant4(w)
+        return _host_quant8(w)
+
     @functools.partial(jax.jit, donate_argnums=(0,))
     def splice_layer(bufs, qs, layer):
         out = dict(bufs)
         for name, (q, s) in qs.items():
-            out[name] = QTensor(q=bufs[name].q.at[layer].set(q),
-                                s=bufs[name].s.at[layer].set(s))
+            out[name] = type(bufs[name])(q=bufs[name].q.at[layer].set(q),
+                                         s=bufs[name].s.at[layer].set(s))
         return out
 
     attn_norms = np.zeros((L, H), np.float32)
@@ -591,12 +625,24 @@ def load_checkpoint_quantized(ckpt_dir: str,
     if not config.tie_embeddings:
         # Host-side too: a device quantize of the 8B lm_head would spike
         # ~3 GB of bf16-upload + f32 temp on a chip already holding the
-        # int8 tree (the same spike removed from synth.py's quote head).
-        q, s = host_quant(top["lm_head"])
-        params["lm_head"] = QTensor(q=jnp.asarray(q), s=jnp.asarray(s))
+        # quantized tree (the same spike removed from synth.py's quote
+        # head). The class mirrors host_quant's per-leaf precision
+        # choice (quant._quantize_leaf's predicate).
+        head = top["lm_head"]
+        K = head.shape[-2]
+        cls = (QTensor4 if (quant == "int4" and K % 2 == 0
+                            and (K % 128 == 0 or K % 64 == 0))
+               else QTensor)
+        q, s = host_quant(head)
+        params["lm_head"] = cls(q=jnp.asarray(q), s=jnp.asarray(s))
     jax.block_until_ready(params)
     del host_params
+    from .quant import quant_mode
+    mode = quant_mode(params) or "int8"
+    n_logical = sum(
+        (2 * x.q.size if isinstance(x, QTensor4) else x.size)
+        for x in jax.tree.leaves(
+            params, is_leaf=lambda v: isinstance(v, QTensor4)))
     log.info("loaded %s quantized+fused (streaming, single-chip): "
-             "%.2fB params int8", config.name,
-             sum(x.size for x in jax.tree.leaves(params)) / 1e9)
+             "%.2fB params %s", config.name, n_logical / 1e9, mode)
     return params, config

@@ -1,4 +1,4 @@
-"""Pallas w8a16 matmul: int8 weights dequantized in VMEM, not HBM.
+"""Pallas w8a16 + w4a16 matmuls: quantized weights dequantized in VMEM.
 
 Why this kernel exists: XLA on TPU does not stream int8 dot operands —
 ``x @ q.astype(bf16)`` (and the mixed-dtype ``dot_general``) materialise
@@ -20,6 +20,20 @@ bandwidth-bound shapes); prefill keeps the XLA path, where the convert
 cost is amortised over thousands of rows and the matmul is
 compute-bound. ``interpret=True`` runs on CPU for hardware-free parity
 tests (tests/test_quant.py).
+
+The w4a16 kernels (:func:`quant_matmul4` / :func:`quant_matmul_stacked4`)
+stream the PACKED int4 bytes — HBM weight traffic is half of int8's,
+the entire point — and unpack nibbles + fold group-wise scales in VMEM.
+They run the 1D whole-contraction grid only, statically unrolled over
+lo/hi group PAIRS of the split-half packing (models/quant.pack4): packed
+byte rows ``[g*G, (g+1)*G)`` are exactly logical group ``g`` (low
+nibbles) and group ``ng/2 + g`` (high nibbles), so each iteration
+unpacks one small [G, bo] tile (a whole-stripe int32 unpack would blow
+VMEM at 8B dims), runs two [rows, G] x [G, bo] dots, and scales each
+after its dot — group scales are constant within a dot, which is what
+makes scale-after-dot legal per group. Preconditions: even group count,
+group % 128 == 0 (lane-aligned x slices); everything else takes the
+dequant XLA fallback in models/quant.mm.
 """
 
 from __future__ import annotations
@@ -53,6 +67,22 @@ _STRIPE_BUDGET_BYTES = int(_os.environ.get("QMM_STRIPE_BUDGET",
 # H — hit by 512-row prefill-admission chunks at 8B dims (rows x 14336
 # bf16 = 14.7 MB, observed as a compile-time VMEM OOM).
 _X_VMEM_BUDGET_BYTES = 6 * 1024 * 1024
+
+# Per-hidden-size output-tile autotune table for the 1D whole-stripe
+# grids, SHARED by w8a16 and w4a16 (both route block choice through
+# _pick_1d_bo, so identical logical shapes pick identical grids in both
+# precisions). Key = logical contraction dim, value = bo cap. Why it
+# exists: the stripe machinery was tuned at hidden=2048 (bench-1b),
+# where bo=1024 keeps >= 6 programs in flight per matmul; at hidden=1024
+# (draft-400m) the same bo leaves a 2048-col projection only TWO grid
+# programs — too shallow for Mosaic to overlap the next stripe's DMA
+# with the current dot, recorded as the stacked kernel losing ~5% to
+# forced XLA (ROADMAP round-8 MoE note). Capping bo at 256 restores
+# >= 8 programs and the double-buffer overlap; tests/test_quant.py pins
+# the dispatch decision, tools/check_quant_kernel.py measures it on
+# chip. Caps only apply when they divide O (else the next smaller
+# candidate divisor wins via the normal search).
+_TILE_TABLE = {1024: 256}
 
 
 def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref):
@@ -116,6 +146,48 @@ def _qmm_kernel_2d_stacked(layer_ref, x_ref, q_ref, s_ref, o_ref, acc_ref):
     def _finalise():
         s = s_ref[0, 0].astype(jnp.float32)        # [bo]
         o_ref[...] = (acc_ref[:] * s[None, :]).astype(o_ref.dtype)
+
+
+def _qmm4_body(x, pk_rows, s_rows, o_dtype):
+    """Shared w4a16 kernel body: x [rp, K]; pk_rows [K/2, bo] packed
+    int8; s_rows [ng, bo] f32. Statically unrolled over the ng/2 group
+    PAIRS of the split-half packing: packed byte rows [g*G, (g+1)*G) are
+    logical group g in the low nibbles and group ng/2 + g in the high
+    nibbles, so each iteration unpacks ONE [G, bo] tile to int32 (small —
+    a whole-stripe unpack would blow VMEM at K=14336), runs two
+    [rp, G] x [G, bo] dots and folds each group's scale after its dot
+    (legal per group: the scale is constant within the dot's
+    contraction). Nibble math stays in int32 where & 0xF and the
+    arithmetic >> 4 are sign-robust for negative reinterpreted bytes."""
+    K = x.shape[1]
+    ng = s_rows.shape[0]
+    G = K // ng
+    half = ng // 2
+    acc = jnp.zeros((x.shape[0], pk_rows.shape[1]), jnp.float32)
+    for g in range(half):
+        pk = pk_rows[g * G:(g + 1) * G, :].astype(jnp.int32)
+        w_lo = ((pk & 0xF) - 8).astype(x.dtype)
+        w_hi = (((pk >> 4) & 0xF) - 8).astype(x.dtype)
+        s_lo = s_rows[g, :].astype(jnp.float32)
+        s_hi = s_rows[half + g, :].astype(jnp.float32)
+        acc += jax.lax.dot(x[:, g * G:(g + 1) * G], w_lo,
+                           preferred_element_type=jnp.float32) * s_lo[None, :]
+        acc += jax.lax.dot(x[:, K // 2 + g * G:K // 2 + (g + 1) * G], w_hi,
+                           preferred_element_type=jnp.float32) * s_hi[None, :]
+    return acc.astype(o_dtype)
+
+
+def _qmm4_kernel_1d(x_ref, q_ref, s_ref, o_ref):
+    """w4a16 whole-contraction stripe: one program = one [K/2, bo] PACKED
+    weight tile = one output tile. HBM reads the int4-packed bytes only."""
+    o_ref[...] = _qmm4_body(x_ref[...], q_ref[...], s_ref[...], o_ref.dtype)
+
+
+def _qmm4_kernel_1d_stacked(layer_ref, x_ref, q_ref, s_ref, o_ref):
+    """w4a16 stacked twin: the [L, K/2, O] packed pool is read at the
+    scalar-prefetched layer index, no per-layer slice materialisation —
+    same motivation as _qmm_kernel_1d_stacked."""
+    o_ref[...] = _qmm4_body(x_ref[...], q_ref[0], s_ref[0], o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -187,18 +259,43 @@ def pick_block(dim: int) -> int | None:
     return None
 
 
-def _pick_1d_bo(rp: int, H: int, O: int, x_itemsize: int) -> int | None:
+def _pick_1d_bo(rp: int, H: int, O: int, x_itemsize: int,
+                stripe_rows: int | None = None) -> int | None:
     """Output-block width for the 1D whole-contraction grid, or None to
-    use the 2D grid: x [rp, H] must fit the VMEM x-budget and the [H, bo]
-    int8 stripe the stripe budget (shared by the stacked and unstacked
-    kernels so identical shapes always pick identical grids)."""
+    use the 2D grid: x [rp, H] must fit the VMEM x-budget and the
+    [stripe_rows, bo] weight stripe the stripe budget (stripe_rows
+    defaults to H — int8's byte rows; the int4 path passes H/2, its
+    PACKED byte rows). The per-hidden-size _TILE_TABLE caps bo below the
+    budget-driven choice where measurement says shallower grids lose to
+    XLA. Shared by the stacked and unstacked kernels of both precisions
+    so identical shapes always pick identical grids."""
     if rp * H * x_itemsize > _X_VMEM_BUDGET_BYTES:
         return None
+    sr = H if stripe_rows is None else stripe_rows
     bo = pick_block(O)
-    while bo is not None and H * bo > _STRIPE_BUDGET_BYTES:
+    cap = _TILE_TABLE.get(H)
+    if cap is not None and bo is not None and bo > cap and O % cap == 0:
+        bo = cap
+    while bo is not None and sr * bo > _STRIPE_BUDGET_BYTES:
         bo = next((b for b in _BLOCK_CANDIDATES
                    if b < bo and O % b == 0), None)
     return bo
+
+
+def pick_int4_bo(rows: int, H: int, O: int, ng: int,
+                 x_itemsize: int) -> int | None:
+    """Output-block width for the w4a16 1D whole-stripe kernel, or None
+    -> models/quant.mm takes the dequant XLA fallback. Preconditions on
+    top of the shared budgets: an even group count (the split-half
+    packing pairs lo/hi groups per byte row) and 128-aligned groups
+    (the kernel's x slices must be lane-aligned; G=64 shapes fall back).
+    """
+    if ng <= 0 or ng % 2 or H % ng:
+        return None
+    if (H // ng) % 128:
+        return None
+    rp = rows + ((-rows) % 8)
+    return _pick_1d_bo(rp, H, O, x_itemsize, stripe_rows=H // 2)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -253,4 +350,84 @@ def quant_matmul(x: jax.Array, q: jax.Array, s: jax.Array,
         out_shape=jax.ShapeDtypeStruct((rp, O), x.dtype),
         interpret=interpret,
     )(x, q, s)
+    return out[:rows] if pad else out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quant_matmul4(x: jax.Array, q: jax.Array, s: jax.Array,
+                  *, interpret: bool = False) -> jax.Array:
+    """``x @ dequant4(q, s)`` with int4-PACKED HBM weight traffic.
+
+    x: [rows, H]; q: [H/2, O] int8 packed nibbles (models/quant.pack4's
+    split-half layout); s: [ng, O] f32 group scales. Returns [rows, O]
+    in x.dtype. Caller guarantees :func:`pick_int4_bo` accepts the shape
+    (models/quant.mm falls back to the dequant XLA path otherwise).
+    """
+    rows, H = x.shape
+    O = q.shape[1]
+    ng = s.shape[0]
+    bo = pick_int4_bo(rows, H, O, ng, x.dtype.itemsize)
+    if bo is None:
+        raise ValueError(
+            f"w4a16 kernel does not cover H={H} O={O} ng={ng}; use the "
+            "XLA fallback (models/quant.mm gates on pick_int4_bo)")
+    pad = (-rows) % 8
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    rp = rows + pad
+    out = pl.pallas_call(
+        _qmm4_kernel_1d,
+        grid=(O // bo,),
+        in_specs=[
+            pl.BlockSpec((rp, H), lambda i: (0, 0)),
+            pl.BlockSpec((H // 2, bo), lambda i: (0, i)),
+            pl.BlockSpec((ng, bo), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((rp, bo), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((rp, O), x.dtype),
+        interpret=interpret,
+    )(x, q, s)
+    return out[:rows] if pad else out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quant_matmul_stacked4(x: jax.Array, q: jax.Array, s: jax.Array,
+                          layer: jax.Array, *,
+                          interpret: bool = False) -> jax.Array:
+    """``x @ dequant4(q[layer], s[layer])`` reading the stacked packed
+    pool directly — the int4 twin of :func:`quant_matmul_stacked`.
+
+    x: [rows, H]; q: [L, H/2, O] int8 packed nibbles; s: [L, ng, O] f32
+    group scales (the stacked models/quant.QTensor4 layout); layer:
+    scalar int32. Same coverage contract as :func:`quant_matmul4`.
+    """
+    rows, H = x.shape
+    O = q.shape[2]
+    ng = s.shape[1]
+    bo = pick_int4_bo(rows, H, O, ng, x.dtype.itemsize)
+    if bo is None:
+        raise ValueError(
+            f"w4a16 kernel does not cover H={H} O={O} ng={ng}; use the "
+            "XLA fallback (models/quant.mm gates on pick_int4_bo)")
+    pad = (-rows) % 8
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    rp = rows + pad
+    ly = jnp.asarray(layer, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(O // bo,),
+        in_specs=[
+            pl.BlockSpec((rp, H), lambda i, ly: (0, 0)),
+            pl.BlockSpec((1, H // 2, bo), lambda i, ly: (ly[0], 0, i)),
+            pl.BlockSpec((1, ng, bo), lambda i, ly: (ly[0], 0, i)),
+        ],
+        out_specs=pl.BlockSpec((rp, bo), lambda i, ly: (0, i)),
+    )
+    out = pl.pallas_call(
+        _qmm4_kernel_1d_stacked,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rp, O), x.dtype),
+        interpret=interpret,
+    )(ly, x, q, s)
     return out[:rows] if pad else out

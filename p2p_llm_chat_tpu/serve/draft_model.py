@@ -149,11 +149,8 @@ class ModelDrafter(DraftSource):
         return self._cache.k.nbytes + self._cache.v.nbytes
 
     def param_bytes(self) -> int:
-        from ..models.quant import QTensor
-        return sum(
-            (x.q.nbytes + x.s.nbytes if isinstance(x, QTensor) else x.nbytes)
-            for x in jax.tree.leaves(
-                self._params, is_leaf=lambda x: isinstance(x, QTensor)))
+        from ..models.quant import param_bytes
+        return param_bytes(self._params)
 
     # -- jitted programs ------------------------------------------------------
 

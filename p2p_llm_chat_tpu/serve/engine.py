@@ -437,8 +437,10 @@ def build_engine_from_env() -> Backend:
         mesh = local_mesh(tp=tp)
 
     quant = env_or("SERVE_QUANT", "")
-    if quant and quant != "int8":
-        raise SystemExit(f"SERVE_QUANT must be int8 or empty, got {quant!r}")
+    if quant not in ("", "int8", "int4"):
+        raise SystemExit(
+            f"SERVE_QUANT must be one of '', 'int8', 'int4'; "
+            f"got {quant!r}")
     kv_quant = env_or("SERVE_KV_QUANT", "")
     if kv_quant and kv_quant != "int8":
         raise SystemExit(
@@ -448,21 +450,22 @@ def build_engine_from_env() -> Backend:
 
     def random_init_params(config, seed: int):
         """Shared per-model build: random init -> shard -> quantize.
-        Single-chip int8 llama-family configs stream straight to fused
-        int8 (never materialising the bf16 tree) so MODEL_CONFIG=
-        llama3.1-8b serves on one 16 GB chip."""
+        Single-chip quantized llama-family configs stream straight to
+        the fused int8/int4 tree (never materialising the bf16 tree) so
+        MODEL_CONFIG=llama3.1-8b serves on one 16 GB chip."""
         family = family_for(config)
         if (quant and mesh is None
                 and hasattr(family, "init_params_quantized")):
             return family.init_params_quantized(config,
-                                                jax.random.PRNGKey(seed))
+                                                jax.random.PRNGKey(seed),
+                                                quant=quant)
         params = family.init_params(config, jax.random.PRNGKey(seed))
         if mesh is not None:
             from ..parallel.sharding import shard_params
             params = shard_params(params, family.param_axes(config), mesh)
         if quant:
             from ..models.quant import quantize_params
-            params = quantize_params(params, mesh=mesh)
+            params = quantize_params(params, mesh=mesh, mode=quant)
         return params
 
     def load_draft_for(config) -> Optional[tuple]:
@@ -493,7 +496,7 @@ def build_engine_from_env() -> Backend:
                     dparams, dconfig = load_checkpoint(draft_ref)
                 if quant:
                     from ..models.quant import quantize_params
-                    dparams = quantize_params(dparams)
+                    dparams = quantize_params(dparams, mode=quant)
             except Exception:   # noqa: BLE001 — degrade, don't fail boot
                 log.exception(
                     "SERVE_DRAFT checkpoint %r failed to load; falling "
@@ -542,16 +545,18 @@ def build_engine_from_env() -> Backend:
         from ..models.checkpoint import is_native_checkpoint
         already_quantized = False
         if quant and mesh is None:
-            # Single-chip int8: stream straight into the fused int8 tree
-            # so the bf16 model never touches the chip (what fits an 8B
-            # checkpoint on one 16 GB v5e). Llama and mixtral families;
-            # anything else falls through to the standard paths.
+            # Single-chip quantized: stream straight into the fused
+            # int8/int4 tree so the bf16 model never touches the chip
+            # (what fits an 8B checkpoint on one 16 GB v5e). Llama and
+            # mixtral families; anything else falls through to the
+            # standard paths.
             from ..models.weights import (
                 UnsupportedForQuantizedLoad,
                 load_checkpoint_quantized,
             )
             try:
-                params, config = load_checkpoint_quantized(path)
+                params, config = load_checkpoint_quantized(path,
+                                                           quant=quant)
                 already_quantized = True
             except UnsupportedForQuantizedLoad:
                 # Family out of scope (MoE etc.) — standard paths below.
@@ -576,8 +581,10 @@ def build_engine_from_env() -> Backend:
         tokenizer = load_tokenizer(path, vocab_size=config.vocab_size)
         if quant and not already_quantized:
             from ..models.quant import quantize_params
-            params = quantize_params(params, mesh=mesh)
-            log.info("weights quantized to int8 (per-channel, w8a16)")
+            params = quantize_params(params, mesh=mesh, mode=quant)
+            log.info("weights quantized to %s (%s)", quant,
+                     "per-channel, w8a16" if quant == "int8"
+                     else "group-wise, w4a16")
         return make_engine(params, config, tokenizer,
                            name=tag or env_or("LLM_MODEL", config.name))
 
@@ -657,7 +664,9 @@ def build_engine_from_env() -> Backend:
                  config.name)
         params = random_init_params(config, 0)
         if quant:
-            log.info("weights quantized to int8 (per-channel, w8a16)")
+            log.info("weights quantized to %s (%s)", quant,
+                     "per-channel, w8a16" if quant == "int8"
+                     else "group-wise, w4a16")
         tokenizer = ByteTokenizer(vocab_size=config.vocab_size)
         engine = make_engine(params, config, tokenizer,
                              name=env_or("LLM_MODEL", config.name))

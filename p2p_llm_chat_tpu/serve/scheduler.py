@@ -520,6 +520,17 @@ class BatchScheduler:
                                        tp=fuse_tp_for(config, mesh),
                                        mesh=mesh)
         self._params = params
+        # Weight-stream accounting, stamped once at build: actual stored
+        # bytes of the tree (int4 packed counts half a byte per logical
+        # weight) and the quantization mode label — the /metrics
+        # `model_weight_bytes{quant=}` gauge and the boot log's weight-GB
+        # line. Decode streams ~all of it per step, so this is the
+        # bandwidth denominator for the step-time roofline.
+        from ..models.quant import param_bytes, quant_mode
+        self._weight_bytes = param_bytes(params)
+        self._quant_mode = quant_mode(params)
+        log.info("model weights: %.3f GB (%s)",
+                 self._weight_bytes / 1e9, self._quant_mode or "bf16")
 
         self._slots: list[Optional[_Slot]] = [None] * num_slots  # owned-by: _loop
         self._waiting: list[_Slot] = []  # owned-by: _loop — paged: admitted later, no pages yet
@@ -2752,6 +2763,12 @@ class BatchScheduler:
         out = {
             "serve_batch_occupancy": sum(s is not None for s in self._slots),
             "serve_batch_slots": self.num_slots,
+            # Per-model weight stream (stamped at build): stored bytes of
+            # the fused tree, labeled with the quantization mode — the
+            # decode-step bandwidth denominator, and the operator's
+            # check that SERVE_QUANT actually halved the footprint.
+            f'model_weight_bytes{{quant="{self._quant_mode or "bf16"}"}}':
+                self._weight_bytes,
             "serve_queue_depth": (self._admit_q.qsize() + len(self._waiting)
                                   + len(self._admit_carry)),
             "serve_admitted_total": self._n_admitted,

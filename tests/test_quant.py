@@ -1,13 +1,20 @@
-"""Weight-only int8 quantization tests (models/quant.py).
+"""Weight-only int8 + int4 quantization tests (models/quant.py).
 
-Three oracles:
-- the elementwise bound |w - dequant(w)| <= s/2 that symmetric rounding
-  guarantees;
-- exact agreement between the fused quantized matmul path (mm/q_einsum)
-  and a forward over explicitly dequantized weights — same math, so the
-  tolerance is float-roundoff only;
+Three oracles, applied to both precisions:
+- the elementwise bound symmetric rounding guarantees (|w - deq| <= s/2
+  per output channel for int8, per GROUP for int4);
+- exact agreement between the fused quantized matmul path (mm/q_einsum,
+  and the Pallas kernels in interpret mode) and a forward over
+  explicitly dequantized weights — same math, so the tolerance is
+  float-roundoff only;
 - end-to-end sanity vs the unquantized model: logits stay highly
-  correlated and greedy decode still matches through the serving engine.
+  correlated (int8 cosine > 0.99; int4 > 0.96 — group-wise 4-bit is
+  honestly lossier) and greedy decode still matches through the serving
+  engine.
+
+The int4 legs additionally pin the split-half nibble packing
+(pack4/unpack4 exact round-trip) and the kernel dispatch decisions of
+the per-hidden-size autotune table (ops/quant_mm._TILE_TABLE).
 """
 
 import numpy as np
@@ -19,8 +26,10 @@ import jax.numpy as jnp
 from p2p_llm_chat_tpu.models import llama, mixtral
 from p2p_llm_chat_tpu.models.configs import get_config
 from p2p_llm_chat_tpu.models.llama import KVCache
-from p2p_llm_chat_tpu.models.quant import (QTensor, dequantize, mm,
-                                           quantize, quantize_params)
+from p2p_llm_chat_tpu.models.quant import (QTensor, QTensor4, dequantize,
+                                           dequantize4, mm, pack4,
+                                           quantize, quantize4,
+                                           quantize_params, unpack4)
 
 pytestmark = pytest.mark.model
 
@@ -32,7 +41,8 @@ def dequantize_tree(params):
     def walk(d):
         return {k: (walk(v) if isinstance(v, dict) else
                     dequantize(v, jnp.float32) if isinstance(v, QTensor)
-                    else v)
+                    else dequantize4(v, jnp.float32)
+                    if isinstance(v, QTensor4) else v)
                 for k, v in d.items()}
     return walk(params)
 
@@ -253,3 +263,288 @@ def test_init_params_quantized_streams_to_fused_int8():
     step, cache = llama.decode_step(params, cfg, toks[:, :1], cache)
     assert step.shape == (B, 1, cfg.vocab_size)
     assert bool(jnp.isfinite(step).all())
+
+
+# ---------------------------------------------------------------------------
+# int4 (w4a16, group-wise) — ISSUE 16
+# ---------------------------------------------------------------------------
+
+
+def test_pack4_unpack4_roundtrip_exact():
+    """Split-half nibble packing is lossless over the full int4 range,
+    including the high-nibble>=8 bytes whose packed value exceeds 127
+    (the explicit two's-complement wrap in pack4)."""
+    rng = np.random.default_rng(6)
+    v = jnp.asarray(rng.integers(-8, 8, size=(64, 48)), jnp.int32)
+    p = pack4(v)
+    assert p.dtype == jnp.int8 and p.shape == (32, 48)
+    np.testing.assert_array_equal(np.asarray(unpack4(p)), np.asarray(v))
+    # Byte row i must hold logical rows i (lo nibble) and i + K/2 (hi):
+    # the layout contract the Pallas kernel's group-pair walk relies on.
+    pb = np.asarray(p).astype(np.uint8)
+    np.testing.assert_array_equal((pb & 0xF).astype(np.int32) - 8,
+                                  np.asarray(v)[:32])
+    np.testing.assert_array_equal((pb >> 4).astype(np.int32) - 8,
+                                  np.asarray(v)[32:])
+
+
+def test_int4_roundtrip_error_bound():
+    rng = np.random.default_rng(7)
+    w = jnp.asarray(rng.normal(size=(256, 48)) * 0.1, jnp.float32)
+    qt = quantize4(w)                                 # group = 128
+    assert qt.q.dtype == jnp.int8 and qt.q.shape == (128, 48)
+    assert qt.s.shape == (2, 48) and qt.group == 128
+    assert qt.shape == (256, 48) and qt.ndim == 2
+    deq = np.asarray(dequantize4(qt, jnp.float32))
+    bound = np.repeat(np.asarray(qt.s), 128, axis=0) / 2 + 1e-7
+    assert np.all(np.abs(deq - np.asarray(w)) <= bound)
+
+
+def test_int4_group64_and_zero_group_stable():
+    """K=192 is not 128-divisible -> group falls back to 64; an all-zero
+    group must dequantize to exact zeros (no NaN from a zero amax)."""
+    w = jnp.zeros((192, 4), jnp.float32).at[64:128, 1].set(1.0)
+    qt = quantize4(w)
+    assert qt.group == 64 and qt.s.shape == (3, 4)
+    deq = np.asarray(dequantize4(qt, jnp.float32))
+    np.testing.assert_array_equal(deq[:64], 0)
+    np.testing.assert_allclose(deq[64:128, 1], 1.0, atol=1e-6)
+    np.testing.assert_array_equal(deq[128:], 0)
+
+
+def test_mm4_matches_explicit_dequant():
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.normal(size=(5, 256)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(256, 48)), jnp.float32)
+    qt = quantize4(w)
+    got = np.asarray(mm(x, qt))
+    ref = np.asarray(x @ dequantize4(qt, jnp.float32))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("rows", [3, 8, 32])
+def test_pallas_qmm4_matches_reference(rows):
+    """The w4a16 kernel (interpret mode — hardware-free) vs the
+    group-wise dequant reference: identical f32 math, so the tolerance
+    is roundoff only (dot-order differences), not quantization error."""
+    from p2p_llm_chat_tpu.ops.quant_mm import pick_int4_bo, quant_matmul4
+
+    rng = np.random.default_rng(9)
+    H, O = 256, 384                                   # ng=2, G=128
+    w = jnp.asarray(rng.normal(size=(H, O)), jnp.float32)
+    qt = quantize4(w)
+    assert pick_int4_bo(rows, H, O, qt.s.shape[0], 4) is not None
+    x = jnp.asarray(rng.normal(size=(rows, H)), jnp.float32)
+    got = quant_matmul4(x, qt.q, qt.s, interpret=True)
+    want = x @ dequantize4(qt, jnp.float32)
+    assert got.dtype == x.dtype and got.shape == (rows, O)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_qmm4_stacked_matches_reference():
+    """The stacked twin reads [L, K/2, O] at a scalar-prefetched layer
+    index — every layer must match the per-layer unstacked result."""
+    from p2p_llm_chat_tpu.ops.quant_mm import quant_matmul_stacked4
+
+    rng = np.random.default_rng(10)
+    L, H, O = 3, 256, 384
+    w = jnp.asarray(rng.normal(size=(L, H, O)), jnp.float32)
+    qt = quantize4(w)
+    x = jnp.asarray(rng.normal(size=(8, H)), jnp.float32)
+    for layer in range(L):
+        got = quant_matmul_stacked4(x, qt.q, qt.s, layer, interpret=True)
+        want = x @ dequantize4(QTensor4(q=qt.q[layer], s=qt.s[layer]),
+                               jnp.float32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_qmm_tile_table_dispatch():
+    """Pins the per-hidden-size autotune table decisions (ops/quant_mm
+    ._TILE_TABLE): hidden=1024 caps the 1D grid's output tile at 256
+    (the draft-400m retune — bo=1024 left a 2048-col projection only two
+    grid programs and lost ~5% to XLA), hidden=2048 keeps the full 1024
+    stripe; and the w4a16 gates (even group count, 128-aligned groups)
+    route uncovered shapes to the XLA fallback."""
+    from p2p_llm_chat_tpu.ops.quant_mm import _pick_1d_bo, pick_int4_bo
+
+    # The retune this table exists for, shared by both precisions.
+    assert _pick_1d_bo(8, 1024, 2048, 2) == 256
+    assert _pick_1d_bo(8, 2048, 2048, 2) == 1024
+    assert _pick_1d_bo(8, 1024, 2048, 2, stripe_rows=512) == 256  # int4
+
+    # w4a16 coverage gates.
+    assert pick_int4_bo(8, 1024, 2048, 8, 2) == 256   # G=128, ng even
+    assert pick_int4_bo(8, 1024, 2048, 7, 2) is None  # odd group count
+    assert pick_int4_bo(8, 192, 256, 3, 2) is None    # G=64 not lane-wide
+    assert pick_int4_bo(8, 1024, 2048, 0, 2) is None  # unquantized guard
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("rows", [8, 32])
+@pytest.mark.parametrize("shape", [(512, 512), (1024, 2048), (2048, 1024)])
+def test_pallas_qmm4_shape_matrix(rows, shape):
+    """Full-matrix interpret parity at bench-relevant hidden sizes —
+    including hidden=1024, where the tile table caps bo (the retune must
+    not change the numbers, only the grid)."""
+    from p2p_llm_chat_tpu.ops.quant_mm import quant_matmul4
+
+    H, O = shape
+    rng = np.random.default_rng(H + O + rows)
+    w = jnp.asarray(rng.normal(size=(H, O)), jnp.float32)
+    qt = quantize4(w)
+    x = jnp.asarray(rng.normal(size=(rows, H)), jnp.float32)
+    got = quant_matmul4(x, qt.q, qt.s, interpret=True)
+    want = x @ dequantize4(qt, jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_int4_forward_matches_dequantized_oracle():
+    """The fused int4 path through the whole model equals a plain
+    forward over the group-dequantized weights — quantization error
+    cancels out of this comparison, exactly like the int8 oracle."""
+    qparams = quantize_params(PARAMS, mode="int4")
+    dparams = dequantize_tree(qparams)
+    tokens = jnp.asarray(
+        np.random.default_rng(12).integers(0, CFG.vocab_size, (2, 12)),
+        jnp.int32)
+    lens = jnp.asarray([12, 9], jnp.int32)
+    cache_q = KVCache.create(CFG, 2, 32, jnp.float32)
+    cache_d = KVCache.create(CFG, 2, 32, jnp.float32)
+    lq, cache_q = llama.prefill(qparams, CFG, tokens, lens, cache_q)
+    ld, cache_d = llama.prefill(dparams, CFG, tokens, lens, cache_d)
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(ld),
+                               rtol=2e-4, atol=2e-4)
+    nxt = jnp.argmax(lq[:, -1], -1).astype(jnp.int32)[:, None]
+    for _ in range(3):
+        lq, cache_q = llama.decode_step(qparams, CFG, nxt, cache_q)
+        ld, cache_d = llama.decode_step(dparams, CFG, nxt, cache_d)
+        np.testing.assert_allclose(np.asarray(lq), np.asarray(ld),
+                                   rtol=2e-4, atol=2e-4)
+        nxt = jnp.argmax(lq[:, 0], -1).astype(jnp.int32)[:, None]
+
+
+def test_int4_close_to_full_precision():
+    """Sanity vs the ORIGINAL weights. Group-wise int4 is honestly
+    lossier than per-channel int8, so the pinned cosine floor is 0.96
+    (int8 pins 0.99; measured 0.967 on tiny, whose K=128 trunk gives
+    only ONE group per column — the worst case) — documented in
+    docs/serving.md Round-16."""
+    qparams = quantize_params(PARAMS, mode="int4")
+    tokens = jnp.asarray(
+        np.random.default_rng(13).integers(0, CFG.vocab_size, (1, 10)),
+        jnp.int32)
+    lens = jnp.asarray([10], jnp.int32)
+    lq, _ = llama.prefill(qparams, CFG, tokens, lens,
+                          KVCache.create(CFG, 1, 16, jnp.float32))
+    lf, _ = llama.prefill(PARAMS, CFG, tokens, lens,
+                          KVCache.create(CFG, 1, 16, jnp.float32))
+    a = np.asarray(lq).reshape(-1)
+    b = np.asarray(lf).reshape(-1)
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+    assert cos > 0.96, cos
+
+
+def test_moe_int4_matches_dequantized_oracle():
+    """Mixtral expert stacks quantize group-wise along axis -2 and run
+    through the q_einsum dequant path."""
+    mcfg = get_config("tiny-moe")
+    mparams = mixtral.init_params(mcfg, jax.random.PRNGKey(1),
+                                  dtype=jnp.float32)
+    qparams = quantize_params(mparams, mode="int4")
+    dparams = dequantize_tree(qparams)
+    tokens = jnp.asarray(
+        np.random.default_rng(14).integers(0, mcfg.vocab_size, (2, 8)),
+        jnp.int32)
+    lens = jnp.asarray([8, 6], jnp.int32)
+    lq, _ = mixtral.prefill(qparams, mcfg, tokens, lens,
+                            KVCache.create(mcfg, 2, 16, jnp.float32))
+    ld, _ = mixtral.prefill(dparams, mcfg, tokens, lens,
+                            KVCache.create(mcfg, 2, 16, jnp.float32))
+    np.testing.assert_allclose(np.asarray(lq), np.asarray(ld),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_int4_params_serve_through_engine():
+    """QTensor4 leaves must ride the scheduler's jitted programs (scan,
+    donation, scatter installs) end to end: greedy decode through the
+    batching engine equals the solo int4 oracle."""
+    from p2p_llm_chat_tpu.serve.backend import (GenerateOptions,
+                                                GenerateRequest,
+                                                RequestStats)
+    from p2p_llm_chat_tpu.serve.engine import TPUEngine
+    from p2p_llm_chat_tpu.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer(vocab_size=CFG.vocab_size)
+    qparams = quantize_params(PARAMS, mode="int4")
+    stop_ids = set(CFG.eos_token_ids) | {tok.eos_id}
+
+    def oracle(prompt, max_new):
+        ids = tok.encode(prompt, add_bos=True)
+        cache = KVCache.create(CFG, 1, 64, jnp.float32)
+        logits, cache = llama.prefill(qparams, CFG, jnp.asarray([ids]),
+                                      jnp.asarray([len(ids)]), cache)
+        last = np.asarray(logits[0, len(ids) - 1])
+        out = []
+        for _ in range(max_new):
+            t = int(last.argmax())
+            if t in stop_ids:
+                break
+            out.append(t)
+            lg, cache = llama.decode_step(qparams, CFG, jnp.asarray([[t]]),
+                                          cache)
+            last = np.asarray(lg[0, 0])
+        return tok.decode(out)
+
+    eng = TPUEngine(qparams, CFG, tok, num_slots=2, max_seq=64)
+    try:
+        req = GenerateRequest(prompt="int4 serving",
+                              options=GenerateOptions(max_tokens=8))
+        got = "".join(eng.generate_stream(req, RequestStats()))
+        assert got == oracle("int4 serving", 8)
+    finally:
+        eng.stop()
+
+
+def test_init_params_quantized_streams_to_fused_int4():
+    """quant='int4' streams straight to a fused QTensor4 tree (packed
+    byte rows = half the logical contraction dim) — the path that halves
+    the 8B weight trunk again without ever materialising bf16."""
+    cfg = get_config("tiny")
+    params = llama.init_params_quantized(cfg, jax.random.PRNGKey(0),
+                                         quant="int4")
+    layers = params["layers"]
+    for name in ("wqkv", "wo", "wgu", "w_down"):
+        leaf = layers[name]
+        assert isinstance(leaf, QTensor4) and leaf.q.dtype == jnp.int8
+        assert leaf.q.shape[0] == cfg.num_layers
+        assert leaf.q.shape[-2] * 2 == leaf.shape[-2]   # packed rows
+    assert isinstance(params["lm_head"], QTensor4)
+
+    B, S = 2, 8
+    cache = llama.KVCache.create(cfg, B, 32, dtype=params["embed"].dtype)
+    toks = jnp.ones((B, S), jnp.int32)
+    logits, cache = llama.prefill(params, cfg, toks,
+                                  jnp.full((B,), S, jnp.int32), cache)
+    assert logits.shape == (B, S, cfg.vocab_size)
+    step, cache = llama.decode_step(params, cfg, toks[:, :1], cache)
+    assert bool(jnp.isfinite(step).all())
+
+
+def test_quant_mode_and_param_bytes():
+    """quant_mode labels a tree by its leaves; param_bytes counts STORED
+    bytes (int4 packs two weights per byte) — the scheduler's
+    model_weight_bytes gauge reads both."""
+    from p2p_llm_chat_tpu.models.quant import param_bytes, quant_mode
+
+    assert quant_mode(PARAMS) == ""
+    q8 = quantize_params(PARAMS)
+    q4 = quantize_params(PARAMS, mode="int4")
+    assert quant_mode(q8) == "int8"
+    assert quant_mode(q4) == "int4"
+    # int4 stores half the int8 payload (+ group scales vs channel
+    # scales); with tiny's K=128..256 groups the total must land well
+    # under int8's and both under bf16-equivalent f32.
+    assert param_bytes(q4) < param_bytes(q8) < param_bytes(PARAMS)

@@ -294,6 +294,43 @@ def test_load_checkpoint_quantized_moe_matches_quantize_then_fuse(tmp_path):
     _assert_trees_equal(got, want)
 
 
+def test_load_checkpoint_quantized_int4_matches(tmp_path):
+    """Round-16: the streamed loader's w4a16 branch. Both checkpoint
+    flavors (HF safetensors and native Orbax) must produce EXACTLY
+    fuse_params(quantize_params(load_checkpoint(...), mode="int4")) —
+    group-wise quantization is deterministic and nibble packing is a
+    pure bit permutation, so the trees are bit-identical."""
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from p2p_llm_chat_tpu.models import llama
+    from p2p_llm_chat_tpu.models.checkpoint import save_checkpoint
+    from p2p_llm_chat_tpu.models.configs import get_config
+    from p2p_llm_chat_tpu.models.quant import QTensor4, quantize_params
+    from p2p_llm_chat_tpu.models.weights import load_checkpoint_quantized
+
+    # HF branch.
+    model, cfg = _tiny_llama()
+    ckpt = _write_ckpt(tmp_path, model)
+    got, got_cfg = load_checkpoint_quantized(ckpt, quant="int4")
+    assert got_cfg.hidden_size == cfg.hidden_size
+    base, _ = load_checkpoint(ckpt)         # bf16 (default dtype)
+    want = llama.fuse_params(quantize_params(base, mode="int4"))
+    assert any(isinstance(v, QTensor4) for v in want["layers"].values())
+    _assert_trees_equal(got, want)
+
+    # Native Orbax branch.
+    ncfg = get_config("tiny")
+    params = llama.init_params(ncfg, _jax.random.PRNGKey(7),
+                               dtype=_jnp.bfloat16)
+    nckpt = str(tmp_path / "native-int4")
+    save_checkpoint(nckpt, params, ncfg)
+    ngot, ngot_cfg = load_checkpoint_quantized(nckpt, quant="int4")
+    assert ngot_cfg.name == "tiny"
+    nwant = llama.fuse_params(quantize_params(params, mode="int4"))
+    _assert_trees_equal(ngot, nwant)
+
+
 def test_load_checkpoint_quantized_moe_native_matches(tmp_path):
     """Same MoE equivalence through a native Orbax checkpoint."""
     import jax as _jax

@@ -1,0 +1,131 @@
+"""TPU parity + timing check: Pallas quantized matmuls vs forced XLA.
+
+Runs the w8a16 and w4a16 kernels (ops/quant_mm.py — stacked and
+unstacked) on the real chip over random weights and asserts closeness
+to the explicit-dequant XLA path, then times both at decode rows. CPU
+tests cover the math in interpret mode; this is the Mosaic-lowering
+check, and the measurement behind the per-hidden-size tile autotune
+table (_TILE_TABLE — the hidden=1024 retune where the stacked w8a16
+kernel lost ~5% to forced XLA before the bo cap): the timing rows must
+show no shape regime where the in-tree kernel loses to XLA.
+
+The shape matrix covers the serving configs' decode projections:
+hidden 1024 (draft-400m — the retuned row), 2048 (bench-1b), and 4096
+(llama3.1-8b), each at the model's wider fused output dims.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from p2p_llm_chat_tpu.models.quant import (QTensor, QTensor4,  # noqa: E402
+                                           dequantize, dequantize4,
+                                           quantize, quantize4)
+from p2p_llm_chat_tpu.ops.quant_mm import (_pick_1d_bo,  # noqa: E402
+                                           pick_int4_bo, quant_matmul,
+                                           quant_matmul4,
+                                           quant_matmul_stacked,
+                                           quant_matmul_stacked4)
+
+ROWS = 32          # serving decode batch
+STEPS = 20
+
+
+def _time_ms(fn) -> float:
+    r = fn()                                   # compile + warm
+    np.asarray(r).ravel()[:1]
+    t = time.monotonic()
+    for _ in range(STEPS):
+        r = fn()
+    np.asarray(r).ravel()[:1]                  # forced sync
+    return (time.monotonic() - t) / STEPS * 1e3
+
+
+def run8(H: int, O: int, L: int = 2) -> None:
+    """w8a16: stacked + unstacked kernel vs forced-XLA dequant — parity
+    (roundoff-only: both sides see the same int8 weights) and timing."""
+    rng = np.random.default_rng(H + O)
+    x = jnp.asarray(rng.standard_normal((ROWS, H), np.float32),
+                    jnp.bfloat16)
+    # f32 host gen on purpose: f64 at the 8B fused-MLP shape is ~2 GB.
+    w = jnp.asarray(rng.standard_normal((L, H, O), np.float32))
+    qt = quantize(w)
+
+    xla = jax.jit(lambda x, q, s: x @ dequantize(QTensor(q=q, s=s),
+                                                 x.dtype))
+    for layer in (0, L - 1):
+        got = np.asarray(quant_matmul_stacked(x, qt.q, qt.s, layer),
+                         np.float32)
+        ref = np.asarray(xla(x, qt.q[layer], qt.s[layer]), np.float32)
+        err = np.max(np.abs(got - ref))
+        denom = np.max(np.abs(ref)) or 1.0
+        print(f"int8 stacked H={H} O={O} layer={layer}: rel "
+              f"{err / denom:.5f}")
+        assert err / denom < 2e-2, "w8a16 stacked kernel diverges"
+    got = np.asarray(quant_matmul(x, qt.q[0], qt.s[0]), np.float32)
+    ref = np.asarray(xla(x, qt.q[0], qt.s[0]), np.float32)
+    assert np.max(np.abs(got - ref)) / (np.max(np.abs(ref)) or 1.0) < 2e-2
+
+    k_ms = _time_ms(lambda: quant_matmul_stacked(x, qt.q, qt.s, 1))
+    x_ms = _time_ms(lambda: xla(x, qt.q[1], qt.s[1]))
+    bo = _pick_1d_bo(ROWS, H, O, 2)
+    print(f"int8 H={H} O={O} (1d bo={bo}): kernel {k_ms:.4f} ms vs XLA "
+          f"{x_ms:.4f} ms ({x_ms / k_ms:.2f}x)")
+    assert k_ms <= x_ms * 1.02, \
+        f"w8a16 kernel loses to forced XLA at H={H} O={O} — retune " \
+        f"_TILE_TABLE (ops/quant_mm.py)"
+
+
+def run4(H: int, O: int, L: int = 2) -> None:
+    """w4a16: stacked + unstacked kernel vs forced-XLA group dequant."""
+    rng = np.random.default_rng(H + O + 1)
+    x = jnp.asarray(rng.standard_normal((ROWS, H), np.float32),
+                    jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((L, H, O), np.float32))
+    qt = quantize4(w)
+    ng = qt.s.shape[-2]
+    bo = pick_int4_bo(ROWS, H, O, ng, 2)
+    assert bo is not None, f"w4a16 kernel must cover H={H} O={O} ng={ng}"
+
+    xla = jax.jit(lambda x, q, s: x @ dequantize4(QTensor4(q=q, s=s),
+                                                  x.dtype))
+    for layer in (0, L - 1):
+        got = np.asarray(quant_matmul_stacked4(x, qt.q, qt.s, layer),
+                         np.float32)
+        ref = np.asarray(xla(x, qt.q[layer], qt.s[layer]), np.float32)
+        err = np.max(np.abs(got - ref))
+        denom = np.max(np.abs(ref)) or 1.0
+        print(f"int4 stacked H={H} O={O} layer={layer}: rel "
+              f"{err / denom:.5f}")
+        assert err / denom < 2e-2, "w4a16 stacked kernel diverges"
+    got = np.asarray(quant_matmul4(x, qt.q[0], qt.s[0]), np.float32)
+    ref = np.asarray(xla(x, qt.q[0], qt.s[0]), np.float32)
+    assert np.max(np.abs(got - ref)) / (np.max(np.abs(ref)) or 1.0) < 2e-2
+
+    k_ms = _time_ms(lambda: quant_matmul_stacked4(x, qt.q, qt.s, 1))
+    x_ms = _time_ms(lambda: xla(x, qt.q[1], qt.s[1]))
+    print(f"int4 H={H} O={O} (1d bo={bo}, ng={ng}): kernel {k_ms:.4f} ms "
+          f"vs XLA {x_ms:.4f} ms ({x_ms / k_ms:.2f}x)")
+    assert k_ms <= x_ms * 1.02, \
+        f"w4a16 kernel loses to forced XLA at H={H} O={O} — retune " \
+        f"_TILE_TABLE (ops/quant_mm.py)"
+
+
+if __name__ == "__main__":
+    # (H, O) per serving config's decode projections: draft-400m's
+    # H=1024 trunk (wqkv-fused 2048 and the 4096 MLP — the _TILE_TABLE
+    # retune rows), bench-1b's H=2048, llama3.1-8b's H=4096 with the
+    # fused gate|up width.
+    for H, O in ((1024, 2048), (1024, 4096), (2048, 2048), (2048, 11264),
+                 (4096, 4096), (4096, 28672)):
+        run8(H, O)
+        run4(H, O)
+    print("quant kernel parity + timing OK")

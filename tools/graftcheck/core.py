@@ -70,7 +70,7 @@ class Config:
     # references (the router's aggregation tables live under serve/).
     metric_prefixes: tuple[str, ...] = (
         "serve_", "kv_", "prefix_", "router_", "decode_", "inter_token_",
-        "failpoint_", "retry_", "requests_", "loop_", "prefill_")
+        "failpoint_", "retry_", "requests_", "loop_", "prefill_", "model_")
     metric_suffixes: tuple[str, ...] = (
         "_total", "_seconds", "_ms", "_bytes", "_sessions", "_pages",
         "_depth", "_slots", "_occupancy", "_requests", "_entries")
