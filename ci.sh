@@ -14,7 +14,8 @@ rc=0
 
 # Static analysis runs FIRST: it needs no device and fails in seconds,
 # so a trace-safety/lock-discipline/lock-order/blocking-under-lock/
-# metrics-contract/stream-close/env-hygiene regression never waits on a
+# metrics-contract/stream-close/env-hygiene/donation-safety/
+# failpoint-contract/http-wire-contract regression never waits on a
 # compile. Any new finding fails the gate — suppress only with a
 # reasoned annotation (docs/static-analysis.md).
 echo "== graftcheck static analysis (all analyzers)"

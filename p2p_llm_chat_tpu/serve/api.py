@@ -632,9 +632,20 @@ class OllamaServer:
             if not src or not h:
                 return Response(400, {"error": "need from + h"})
             import urllib.request
+            # The replica-to-replica pull is a proxy hop: the router's
+            # trace/session context rides it so the export fetch shows
+            # up on the same timeline as the import that caused it.
+            hdrs = {}
+            raw_tid = req.headers.get(_trace.HEADER_LC)
+            if raw_tid:
+                hdrs[_trace.HEADER] = raw_tid
+            sid = req.headers.get("x-session-id")
+            if sid:
+                hdrs["X-Session-Id"] = sid
             try:
-                with urllib.request.urlopen(
+                with urllib.request.urlopen(urllib.request.Request(
                         f"{src.rstrip('/')}/admin/prefix/export?h={h}",
+                        headers=hdrs),
                         timeout=30.0) as r:
                     data = r.read()
             except Exception as e:   # noqa: BLE001 — peer may be gone
@@ -705,10 +716,19 @@ class OllamaServer:
                 return Response(400, {"error": "need from + key"})
             import urllib.parse
             import urllib.request
+            # The pull is a proxy hop: forward the caller's trace
+            # header so the source replica's export span lands on the
+            # same timeline, and the migrating session's identity as
+            # X-Session-Id for the source's access logs.
+            hdrs = {"X-Session-Id": key}
+            raw_tid = req.headers.get(_trace.HEADER_LC)
+            if raw_tid:
+                hdrs[_trace.HEADER] = raw_tid
             try:
                 q = urllib.parse.urlencode({"key": key})
-                with urllib.request.urlopen(
+                with urllib.request.urlopen(urllib.request.Request(
                         f"{src.rstrip('/')}/admin/session/export?{q}",
+                        headers=hdrs),
                         timeout=30.0) as r:
                     data = r.read()
             except Exception as e:   # noqa: BLE001 — peer may be gone

@@ -211,9 +211,14 @@ class MultihostEngine:
             return logits.astype(jnp.float32), cache
 
         # One jit object; it retraces per distinct (S, budget) input
-        # shape on its own — no manual shape-keyed cache needed.
+        # shape on its own — no manual shape-keyed cache needed. The
+        # entry cache is donated: it is freshly allocated per admission
+        # round and rebound at the single call site, so without
+        # donation XLA materializes a second full-KV copy just to
+        # write the prompt pages.
         self._prefill_j = jax.jit(
-            _prefill, out_shardings=(NamedSharding(mesh, P()), None))
+            _prefill, donate_argnums=(3,),
+            out_shardings=(NamedSharding(mesh, P()), None))
 
         @functools.partial(jax.jit, donate_argnums=(2,),
                            out_shardings=(NamedSharding(mesh, P()), None))

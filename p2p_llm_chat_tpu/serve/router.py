@@ -1168,6 +1168,7 @@ class ReplicaRouter:
         return Response(503, {"status": "no replica ready"},
                         headers={"Retry-After": "2"})
 
+    # graftcheck: http-ok trace id fans out below; a trace merge has no session to pin
     def _admin_trace(self, req: Request) -> Response:
         """GET /admin/trace: the router store's ids + stats; ``?id=``
         merges the CROSS-REPLICA timeline — the router's own routing/
@@ -1184,11 +1185,19 @@ class ReplicaRouter:
         with self._mu:
             reps = [(r.index, r.url) for r in self.replicas if r.alive]
         q = urllib.parse.urlencode({"id": tid})
+        # The per-replica fetch is itself a traced hop: forward the
+        # admin request's own X-Graft-Trace so a traced debugging
+        # session shows its fan-out in the replica ingress logs.
+        hdrs = {}
+        raw_tid = req.headers.get(_trace.HEADER_LC)
+        if raw_tid:
+            hdrs[_trace.HEADER] = raw_tid
 
         def fetch(url: str, out: dict, idx: int) -> None:
             try:
-                with urllib.request.urlopen(
-                        f"{url}/admin/trace?{q}", timeout=2.0) as r:
+                with urllib.request.urlopen(urllib.request.Request(
+                        f"{url}/admin/trace?{q}", headers=hdrs),
+                        timeout=2.0) as r:
                     out[idx] = json.loads(r.read().decode("utf-8"))
             except Exception:  # noqa: BLE001 — 404/dead replica: no spans
                 pass
@@ -1214,6 +1223,7 @@ class ReplicaRouter:
         spans.sort(key=lambda s: (s.get("t0_ms") or 0.0))
         return Response(200, {"id": tid, "spans": spans})
 
+    # graftcheck: http-ok scrape fan-out, not a request proxy — no wire context to forward
     def _metrics(self, req: Request) -> Response:
         """Aggregate /metrics: the router's own registry, each replica's
         scrape relabeled ``replica="i"``, and unsuffixed fleet totals

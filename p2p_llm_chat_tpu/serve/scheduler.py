@@ -1409,6 +1409,7 @@ class BatchScheduler:
         if p is None:
             def _ex(cache, row):
                 return cache.k[:, row, :W], cache.v[:, row, :W]
+            # graftcheck: nodonate park gather READS the live cache; the resident buffer must outlive the copy
             p = jax.jit(_ex)
             self._row_copy_programs[key] = p
         return p

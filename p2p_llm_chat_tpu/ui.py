@@ -86,6 +86,21 @@ class ChatUI:
         html = (resources.files("p2p_llm_chat_tpu") / "web_static" / "index.html").read_text()
         return Response(200, html, content_type="text/html; charset=utf-8")
 
+    @staticmethod
+    def _fwd_headers(req: Request) -> dict:
+        """Wire context to carry across a proxy hop: a browser that
+        arrived with X-Graft-Trace / X-Session-Id keeps them on the
+        upstream leg (the UI never mints either — an untraced browser
+        stays untraced)."""
+        out = {}
+        tid = req.headers.get("x-graft-trace")
+        if tid:
+            out["X-Graft-Trace"] = tid
+        sid = req.headers.get("x-session-id")
+        if sid:
+            out["X-Session-Id"] = sid
+        return out
+
     def _suggest(self, req: Request) -> Response:
         """ai_suggest (streamlit_app.py:89-101): call the LLM with the fixed
         template; degrade to placeholder strings on any failure."""
@@ -104,7 +119,8 @@ class ChatUI:
         try:
             status, resp = http_json(
                 "POST", f"{self.ollama_url}/api/generate", payload,
-                timeout=self.llm_timeout_s, raise_for_status=False)
+                timeout=self.llm_timeout_s, raise_for_status=False,
+                headers=self._fwd_headers(req))
             if status == 200 and isinstance(resp, dict) and "response" in resp:
                 suggestion = str(resp["response"]).strip()   # :97-98
             else:
@@ -152,6 +168,11 @@ class ChatUI:
         tid = req.headers.get("x-graft-trace")
         if tid:
             headers["X-Graft-Trace"] = tid
+        # Session affinity rides the hop too: the serve front's router
+        # pins X-Session-Id requests to the replica holding their KV.
+        sid = req.headers.get("x-session-id")
+        if sid:
+            headers["X-Session-Id"] = sid
         r = urllib.request.Request(
             f"{self.ollama_url}/api/generate", data=data,
             headers=headers,
@@ -224,7 +245,8 @@ class ChatUI:
             q = f"?{urllib.parse.urlencode(req.query)}" if req.query else ""
             try:
                 status, body = http_json("GET", f"{self.node_http}{path}{q}",
-                                         timeout=5.0, raise_for_status=False)
+                                         timeout=5.0, raise_for_status=False,
+                                         headers=self._fwd_headers(req))
             except ConnectionError as e:
                 return Response(502, {"error": str(e)})
             return Response(status, body)
@@ -238,7 +260,8 @@ class ChatUI:
                 return Response(400, {"error": "invalid json"})
             try:
                 status, body = http_json("POST", f"{self.node_http}{path}", payload,
-                                         timeout=10.0, raise_for_status=False)
+                                         timeout=10.0, raise_for_status=False,
+                                         headers=self._fwd_headers(req))
             except ConnectionError as e:
                 return Response(502, {"error": str(e)})
             return Response(status, body)

@@ -240,14 +240,17 @@ def http_json(
     body: Any = None,
     timeout: float = 5.0,
     raise_for_status: bool = True,
+    headers: Optional[dict] = None,
 ) -> tuple[int, Any]:
-    """Minimal JSON-over-HTTP client. Returns (status, parsed-json-or-None)."""
+    """Minimal JSON-over-HTTP client. Returns (status, parsed-json-or-None).
+    ``headers`` merge under the computed Content-Type — the hook proxy
+    hops use to forward X-Graft-Trace / X-Session-Id."""
     data = None
-    headers = {}
+    hdrs = dict(headers or {})
     if body is not None:
         data = json.dumps(body).encode("utf-8")
-        headers["Content-Type"] = "application/json"
-    req = urllib.request.Request(url, data=data, headers=headers, method=method.upper())
+        hdrs["Content-Type"] = "application/json"
+    req = urllib.request.Request(url, data=data, headers=hdrs, method=method.upper())
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             raw = resp.read()

@@ -1395,6 +1395,388 @@ class TestMarkers:
         assert {"slow", "model"} <= regs
 
 
+# -- buffer-donation safety ---------------------------------------------------
+
+class TestDonation:
+    def test_use_after_donate_flags_the_read(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax
+
+            def _step(params, tokens, cache):
+                return tokens, cache
+
+            def run(params, toks, cache):
+                step_j = jax.jit(_step, donate_argnums=(2,))
+                out, new_cache = step_j(params, toks, cache)
+                return cache.k.sum()        # donated: invalid now
+        """, select=["donation"])
+        assert rules(fs) == ["donation/use-after-donate"]
+
+    def test_rebind_in_dispatch_statement_is_clean(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax
+
+            def _step(params, tokens, cache):
+                return tokens, cache
+
+            def run(params, toks, cache):
+                step_j = jax.jit(_step, donate_argnums=(2,))
+                for _ in range(8):
+                    toks, cache = step_j(params, toks, cache)
+                return toks
+        """, select=["donation"])
+        assert fs == []
+
+    def test_loop_dispatch_without_rebind_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax
+
+            def _step(params, tokens, cache):
+                return tokens
+
+            def run(params, toks, cache):
+                step_j = jax.jit(_step, donate_argnums=(2,))
+                out = []
+                for _ in range(8):
+                    out.append(step_j(params, toks, cache))
+                return out
+        """, select=["donation"])
+        assert rules(fs) == ["donation/use-after-donate"]
+
+    def test_donate_index_out_of_range_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax
+
+            def _f(a, b):
+                return a
+
+            f_j = jax.jit(_f, donate_argnums=(5,))
+        """, select=["donation"])
+        assert rules(fs) == ["donation/bad-index"]
+
+    def test_unknown_donate_argname_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax
+
+            def _f(a, b):
+                return a
+
+            f_j = jax.jit(_f, donate_argnames=("cache",))
+        """, select=["donation"])
+        assert rules(fs) == ["donation/bad-index"]
+
+    def test_partial_decorator_form_validates_indices(self, tmp_path):
+        fs = check(tmp_path, """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(3,))
+            def _f(a, b):
+                return a
+        """, select=["donation"])
+        assert rules(fs) == ["donation/bad-index"]
+
+    def test_nodonate_advisory_fires_only_in_hot_modules(self, tmp_path):
+        src = """
+            import jax
+
+            def _step(params, tokens, cache):
+                return tokens
+
+            step_j = jax.jit(_step)
+        """
+        hot = check(tmp_path, src, name="serve/engine.py",
+                    select=["donation"])
+        assert rules(hot) == ["donation/no-donate"]
+        cold = check(tmp_path, src, name="cold.py", select=["donation"])
+        assert cold == []
+
+    def test_suppressions_clear_both_tags(self, tmp_path):
+        fs = check(tmp_path, """
+            import jax
+
+            def _step(params, tokens, cache):
+                return tokens
+
+            # graftcheck: nodonate prefill must keep its input pages
+            step_j = jax.jit(_step)
+
+            def run(params, toks, cache):
+                out = step_j(params, toks, cache)
+                return cache  # graftcheck: donated-ok cache is dense-only here
+        """, name="serve/engine.py", select=["donation"])
+        assert fs == []
+
+
+# -- failpoint-site contract --------------------------------------------------
+
+class TestFailpointContract:
+    REGISTRY = """
+        KNOWN_SITES = (
+            "serve.api.parse",
+            "serve.kv_tier.export",
+        )
+    """
+
+    def _root(self, tmp_path, registry=None, test_src=None, docs=None):
+        reg = tmp_path / "p2p_llm_chat_tpu" / "utils" / "failpoints.py"
+        reg.parent.mkdir(parents=True, exist_ok=True)
+        reg.write_text(textwrap.dedent(registry or self.REGISTRY))
+        if test_src is not None:
+            t = tmp_path / "tests" / "test_chaos.py"
+            t.parent.mkdir(parents=True, exist_ok=True)
+            t.write_text(textwrap.dedent(test_src))
+        if docs is not None:
+            d = tmp_path / "docs" / "robustness.md"
+            d.parent.mkdir(parents=True, exist_ok=True)
+            d.write_text(textwrap.dedent(docs))
+        return reg
+
+    def _run(self, tmp_path, paths):
+        cfg = Config(root=str(tmp_path))
+        return run_paths([str(p) for p in paths], cfg, ["failpoints"])
+
+    def test_unarmed_site_flags_at_registry(self, tmp_path):
+        reg = self._root(tmp_path, test_src="""
+            from p2p_llm_chat_tpu.utils import failpoints
+            def test_parse():
+                failpoints.arm("serve.api.parse", "raise")
+        """)
+        fs = self._run(tmp_path, [reg])
+        assert rules(fs) == ["failpoints/unarmed-site"]
+        assert "serve.kv_tier.export" in fs[0].message
+
+    def test_spec_literal_arms_a_site(self, tmp_path):
+        reg = self._root(tmp_path, test_src="""
+            def test_chaos(monkeypatch):
+                monkeypatch.setenv(
+                    "FAIL_POINTS",
+                    "serve.api.parse=raise*1, serve.kv_tier.export=delay:20@0.5")
+        """)
+        assert self._run(tmp_path, [reg]) == []
+
+    def test_unknown_site_typo_flags_in_the_test(self, tmp_path):
+        reg = self._root(tmp_path, test_src="""
+            from p2p_llm_chat_tpu.utils import failpoints
+            def test_all():
+                failpoints.arm("serve.api.parse", "raise")
+                failpoints.arm("serve.kv_tier.export", "raise")
+                failpoints.arm("serve.api.prase", "raise")   # typo
+        """)
+        t = tmp_path / "tests" / "test_chaos.py"
+        fs = self._run(tmp_path, [reg, t])
+        assert rules(fs) == ["failpoints/unknown-site"]
+        assert fs[0].path.endswith("test_chaos.py")
+
+    def test_scratch_prefix_sites_are_exempt(self, tmp_path):
+        reg = self._root(tmp_path, test_src="""
+            from p2p_llm_chat_tpu.utils import failpoints
+            def test_all():
+                failpoints.arm("serve.api.parse", "raise")
+                failpoints.arm("serve.kv_tier.export", "raise")
+                failpoints.arm("t.scratch", "raise")
+        """)
+        t = tmp_path / "tests" / "test_chaos.py"
+        assert self._run(tmp_path, [reg, t]) == []
+
+    def test_unregistered_call_flags(self, tmp_path):
+        reg = self._root(tmp_path, test_src="""
+            from p2p_llm_chat_tpu.utils import failpoints
+            def test_all():
+                failpoints.arm("serve.api.parse", "raise")
+                failpoints.arm("serve.kv_tier.export", "raise")
+        """)
+        mod = tmp_path / "p2p_llm_chat_tpu" / "serve" / "thing.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text(textwrap.dedent("""
+            from ..utils.failpoints import failpoint
+            def work():
+                failpoint("serve.thing.unlisted")
+        """))
+        fs = self._run(tmp_path, [reg, mod])
+        assert rules(fs) == ["failpoints/unregistered-call"]
+
+    def test_docs_catalog_undocumented_and_orphan(self, tmp_path):
+        reg = self._root(tmp_path, test_src="""
+            from p2p_llm_chat_tpu.utils import failpoints
+            def test_all():
+                failpoints.arm("serve.api.parse", "raise")
+                failpoints.arm("serve.kv_tier.export", "raise")
+        """, docs="""
+            # Robustness
+
+            <!-- failpoint-contract:begin -->
+            | `serve.api.parse` | parse | contract |
+            | `serve.api.ghost` | gone | contract |
+            <!-- failpoint-contract:end -->
+        """)
+        fs = self._run(tmp_path, [reg])
+        assert sorted(rules(fs)) == ["failpoints/orphan-site",
+                                     "failpoints/undocumented-site"]
+
+    def test_partial_run_without_registry_is_clean(self, tmp_path):
+        self._root(tmp_path)    # registry in the tree, NOT analyzed
+        mod = tmp_path / "p2p_llm_chat_tpu" / "serve" / "thing.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text("x = 1\n")
+        assert self._run(tmp_path, [mod]) == []
+
+
+# -- HTTP wire contract -------------------------------------------------------
+
+class TestHttpContract:
+    def test_503_without_retry_after_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            from .utils.http import Response
+
+            def shed(req):
+                return Response(503, {"error": "full"})
+        """, name="serve/api.py", select=["http"])
+        assert rules(fs) == ["http/503-no-retry-after"]
+
+    def test_503_with_retry_after_is_clean(self, tmp_path):
+        fs = check(tmp_path, """
+            from .utils.http import Response
+
+            def shed(req):
+                return Response(503, {"error": "full"},
+                                headers={"Retry-After": "2"})
+        """, name="serve/api.py", select=["http"])
+        assert fs == []
+
+    def test_http_rules_skip_non_front_modules(self, tmp_path):
+        fs = check(tmp_path, """
+            from .utils.http import Response
+
+            def shed(req):
+                return Response(503, {"error": "full"})
+        """, name="p2p/relay.py", select=["http"])
+        assert fs == []
+
+    def test_ndjson_stream_without_done_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import json
+            from .utils.http import Response
+
+            def handle(req):
+                def gen():
+                    for d in ("a", "b"):
+                        yield (json.dumps({"delta": d}) + "\\n").encode()
+                return Response(200, stream=gen(),
+                                content_type="application/x-ndjson")
+        """, name="serve/api.py", select=["http"])
+        assert rules(fs) == ["http/stream-no-done"]
+
+    def test_ndjson_terminal_done_on_both_paths_is_clean(self, tmp_path):
+        fs = check(tmp_path, """
+            import json
+            from .utils.http import Response
+
+            def handle(req):
+                def gen():
+                    try:
+                        for d in ("a", "b"):
+                            yield (json.dumps({"delta": d}) + "\\n").encode()
+                        yield (json.dumps({"done": True}) + "\\n").encode()
+                    except Exception as e:
+                        yield (json.dumps({"error": str(e),
+                                           "done": True}) + "\\n").encode()
+                return Response(200, stream=gen(),
+                                content_type="application/x-ndjson")
+        """, name="serve/api.py", select=["http"])
+        assert fs == []
+
+    def test_yielding_except_without_done_flags(self, tmp_path):
+        fs = check(tmp_path, """
+            import json
+            from .utils.http import Response
+
+            def handle(req):
+                def gen():
+                    try:
+                        yield b'{"delta": "a"}'
+                    except Exception:
+                        yield b'{"error": "x"}'
+                    yield b'{"done": true}'
+                return Response(200, stream=gen(),
+                                content_type="application/x-ndjson")
+        """, name="serve/api.py", select=["http"])
+        assert rules(fs) == ["http/stream-no-done"]
+
+    def test_proxy_dropping_headers_flags_both(self, tmp_path):
+        fs = check(tmp_path, """
+            from .utils.http import http_json, Response
+
+            def proxy(req):
+                status, body = http_json("GET", "http://up/x")
+                return Response(status, body)
+        """, name="ui.py", select=["http"])
+        assert sorted(rules(fs)) == ["http/proxy-no-session",
+                                     "http/proxy-no-trace"]
+
+    def test_proxy_forwarding_via_helper_is_clean(self, tmp_path):
+        fs = check(tmp_path, """
+            from .utils.http import http_json, Response
+
+            def _fwd(req):
+                out = {}
+                tid = req.headers.get("x-graft-trace")
+                if tid:
+                    out["X-Graft-Trace"] = tid
+                sid = req.headers.get("x-session-id")
+                if sid:
+                    out["X-Session-Id"] = sid
+                return out
+
+            def proxy(req):
+                status, body = http_json("GET", "http://up/x",
+                                         headers=_fwd(req))
+                return Response(status, body)
+        """, name="ui.py", select=["http"])
+        assert fs == []
+
+    def test_proxy_suppression_covers_both_rules(self, tmp_path):
+        fs = check(tmp_path, """
+            from .utils.http import http_json, Response
+
+            # graftcheck: http-ok scrape fan-out, no wire context to forward
+            def metrics(req):
+                status, body = http_json("GET", "http://rep/metrics")
+                return Response(status, body)
+        """, name="serve/router.py", select=["http"])
+        assert fs == []
+
+    def test_endpoint_catalog_mismatch_flags(self, tmp_path):
+        d = tmp_path / "docs" / "serving.md"
+        d.parent.mkdir(parents=True, exist_ok=True)
+        d.write_text(textwrap.dedent("""
+            <!-- endpoint-contract:begin -->
+            | `GET /healthz` | api | liveness |
+            | `GET /ghost` | api | never registered |
+            <!-- endpoint-contract:end -->
+        """))
+        fs = check(tmp_path, """
+            class Front:
+                def __init__(self):
+                    self.router.add("GET", "/healthz", self._health)
+                    for ep in ("/api/new", "/api/new2"):
+                        self.router.add("POST", ep, self._gen)
+        """, name="serve/api.py", select=["http"])
+        assert sorted(rules(fs)) == ["http/orphan-endpoint",
+                                     "http/undocumented-endpoint",
+                                     "http/undocumented-endpoint"]
+
+    def test_new_analyzers_clean_on_single_repo_files(self):
+        for rel, sel in (("p2p_llm_chat_tpu/ui.py", "http"),
+                         ("p2p_llm_chat_tpu/utils/failpoints.py",
+                          "failpoints"),
+                         ("p2p_llm_chat_tpu/serve/multihost.py",
+                          "donation")):
+            cfg = Config(root=REPO_ROOT)
+            fs = run_paths([f"{REPO_ROOT}/{rel}"], cfg, [sel])
+            assert fs == [], (rel, rules(fs))
+
+
 # -- CLI exit-status contract ------------------------------------------------
 
 class TestCLI:

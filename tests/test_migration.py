@@ -172,6 +172,27 @@ def test_export_failpoint_raises_and_session_survives():
     assert tier.export_payload("sid:x") is not None
 
 
+def test_import_failpoint_raises_and_tier_untouched():
+    """serve.kv_tier.import armed: the fault fires BEFORE the payload
+    is parsed or adopted, so the destination tier stays empty — a
+    failed import never half-installs a session. Disarmed, the same
+    call degrades to the ordinary malformed-payload rejection."""
+    from p2p_llm_chat_tpu.serve.scheduler import BatchScheduler
+
+    class _Stub:
+        _tier = KVTier(host_bytes=1 << 20)
+
+    stub = _Stub()
+    failpoints.arm("serve.kv_tier.import", "raise")
+    try:
+        with pytest.raises(failpoints.FailpointError):
+            BatchScheduler.session_import(stub, b"whatever")
+    finally:
+        failpoints.disarm_all()
+    assert stub._tier.sessions_meta() == {}
+    assert BatchScheduler.session_import(stub, b"not a payload") is None
+
+
 # -- the cross-engine A/B oracle (the acceptance contract) --------------------
 
 def test_cross_engine_migration_byte_identity():
@@ -246,6 +267,13 @@ def test_cross_engine_migration_byte_identity():
 
         # Incompatible payloads reject cleanly on the same engine (one
         # warmup saved vs a dedicated test — the tier-1 budget note).
+        # Retention runs on the scheduler thread AFTER a stream
+        # finishes, so wait for B's steady state (sid:oracle, sid:m,
+        # the anonymous head: key) before snapshotting — a late
+        # retention landing mid-check would read as a phantom adopt.
+        wait_for(lambda: b.scheduler.metrics_snapshot()
+                 ["kv_open_sessions"] == 3,
+                 msg="retentions settled on B")
         before = b.scheduler.metrics_snapshot()["kv_open_sessions"]
         assert b.session_import(b"not a payload") is None
         ks = np.zeros((CFG.num_layers, 64, CFG.num_kv_heads,

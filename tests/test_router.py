@@ -1048,6 +1048,40 @@ def test_failed_export_retains_source_and_client_unaffected():
         _stop(rt, reps)
 
 
+def test_migrate_failpoint_fails_step_and_source_retains():
+    """The serve.router.migrate failpoint contract: the fault fires in
+    the router's own per-session migrate loop (before the import POST
+    ever leaves), the step counts as failed, no forget fires, and the
+    source keeps the session — same retention posture as a failed
+    export, proving the router side of the loop honors it too."""
+    from p2p_llm_chat_tpu.utils import failpoints
+    backends: list = []
+
+    def factory(i):
+        b = SessionTierLLM()
+        backends.append(b)
+        return b
+
+    rt, reps = _fleet(2, backend_factory=factory)
+    try:
+        backends[0].tier.insert(_parked_session("sid:stuck"))
+        failpoints.arm("serve.router.migrate", "raise")
+        try:
+            st, body = http_json("POST", f"{rt.url}/admin/drain",
+                                 {"replica": 0})
+        finally:
+            failpoints.disarm_all()
+        assert st == 200
+        assert body["migration"]["migrated"] == 0
+        assert body["migration"]["failed"] == 1
+        assert "sid:stuck" in backends[0].tier.sessions_meta()
+        assert backends[1].tier.sessions_meta() == {}
+        snap = _router_metrics(rt)
+        assert snap["router_migration_failures_total"] == 1.0
+    finally:
+        _stop(rt, reps)
+
+
 def test_dead_replica_counts_lost_sessions_and_rehomes():
     """Replica death: the ledger counts the replica's LAST-SCRAPED open
     sessions (the KV that actually existed — not the LRU-bounded

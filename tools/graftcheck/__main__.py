@@ -18,14 +18,17 @@ def main(argv: list[str] | None = None) -> int:
         description="jax_graft static analysis: trace-safety, "
                     "lock-discipline, lock-order deadlock cycles, "
                     "blocking-under-lock, metrics contract, stream-close "
-                    "discipline, env-flag hygiene, pytest markers.")
+                    "discipline, env-flag hygiene, pytest markers, "
+                    "buffer-donation safety, failpoint-site contract, "
+                    "HTTP wire contract.")
     ap.add_argument("paths", nargs="*", default=["p2p_llm_chat_tpu"],
                     help="files or directories to analyze "
                          "(default: p2p_llm_chat_tpu)")
     ap.add_argument("--select", default="",
                     help="comma-separated analyzers to run "
                          "(trace,lock,env,markers,order,blocking,"
-                         "metrics,streams; default all)")
+                         "metrics,streams,donation,failpoints,http; "
+                         "default all)")
     ap.add_argument("--docs", default="",
                     help="comma-separated docs files for the flag-table "
                          "check (default docs/serving.md)")

@@ -76,6 +76,34 @@ class Config:
         "_depth", "_slots", "_occupancy", "_requests", "_entries")
     metrics_docs: tuple[str, ...] = ("docs/serving.md",)
     metrics_consumer_dirs: tuple[str, ...] = ("serve/",)
+    # Donation safety (donation analyzer): modules on the decode hot
+    # path where a carried cache/pool jit argument left undonated is a
+    # silent HBM-copy-per-tick — there it must either be donated or
+    # carry an explicit `# graftcheck: nodonate <reason>`.
+    donate_hot_modules: tuple[str, ...] = (
+        "serve/scheduler.py", "serve/engine.py", "serve/multihost.py",
+        "serve/draft_model.py")
+    donate_carry_params: tuple[str, ...] = ("cache", "pool")
+    # Failpoint-site contract (failpoint_contract analyzer): the
+    # registry module + tuple name, the docs catalog carrying the
+    # marked site table, the site-name grammar prefixes a spec literal
+    # must be registered under (scratch test sites use other prefixes),
+    # and where arming evidence lives.
+    failpoints_module: str = "utils/failpoints.py"
+    failpoint_registry: str = "KNOWN_SITES"
+    failpoint_prefixes: tuple[str, ...] = ("serve.", "p2p.")
+    failpoint_docs: tuple[str, ...] = ("docs/robustness.md",)
+    failpoint_test_dirs: tuple[str, ...] = ("tests",)
+    failpoint_ci_files: tuple[str, ...] = ("ci.sh",)
+    # HTTP wire contract (http_contract analyzer): the serve/chat front
+    # modules the 503/NDJSON/proxy-header disciplines apply to, the
+    # fronts whose route tables are a documented operator contract, and
+    # the docs file carrying the marked endpoint catalog.
+    http_modules: tuple[str, ...] = ("serve/", "loadgen/", "ui.py",
+                                     "node.py")
+    endpoint_modules: tuple[str, ...] = ("serve/api.py", "serve/router.py",
+                                         "ui.py", "node.py")
+    endpoint_docs: tuple[str, ...] = ("docs/serving.md",)
     # Source set for cross-file analyses (lock-order class models and
     # declarations, metrics export sites): resolved against the FULL
     # package tree even when only a few files were selected, so a
@@ -265,8 +293,9 @@ def apply_suppressions(files: list[SourceFile],
 def run_paths(paths: Iterable[str], config: Optional[Config] = None,
               select: Optional[Iterable[str]] = None) -> list[Finding]:
     """Load files and run the selected analyzers (default: all)."""
-    from . import (blocking, env_hygiene, lock_discipline, lock_order,
-                   markers, metrics_contract, stream_close, trace_safety)
+    from . import (blocking, donation, env_hygiene, failpoint_contract,
+                   http_contract, lock_discipline, lock_order, markers,
+                   metrics_contract, stream_close, trace_safety)
 
     config = config or Config()
     analyzers = {
@@ -278,6 +307,9 @@ def run_paths(paths: Iterable[str], config: Optional[Config] = None,
         "blocking": blocking.analyze,
         "metrics": metrics_contract.analyze,
         "streams": stream_close.analyze,
+        "donation": donation.analyze,
+        "failpoints": failpoint_contract.analyze,
+        "http": http_contract.analyze,
     }
     names = list(select) if select else list(analyzers)
     unknown = [n for n in names if n not in analyzers]
@@ -296,17 +328,21 @@ _TREE_CACHE: dict[tuple, list[SourceFile]] = {}
 
 
 def load_package_tree(config: Config,
-                      covered: frozenset = frozenset()) -> list[SourceFile]:
+                      covered: frozenset = frozenset(),
+                      dirs: Optional[tuple[str, ...]] = None,
+                      ) -> list[SourceFile]:
     """The full package source set (config.package_dirs under
-    config.root), cached per root — the resolution context for
-    cross-file analyzers on partial runs. Missing dirs (fixture roots)
-    yield an empty tree, which degrades those analyzers to the
-    analyzed-set-only behavior the fixture tests pin. ``covered`` paths
-    the caller already parsed short-circuit the load when they span the
-    whole tree (the CI full run — the union would discard these parses
-    anyway)."""
+    config.root, or an analyzer-supplied ``dirs`` tuple — the failpoint
+    contract resolves against package + test dirs), cached per
+    (root, dirs) — the resolution context for cross-file analyzers on
+    partial runs. Missing dirs (fixture roots) yield an empty tree,
+    which degrades those analyzers to the analyzed-set-only behavior
+    the fixture tests pin. ``covered`` paths the caller already parsed
+    short-circuit the load when they span the whole tree (the CI full
+    run — the union would discard these parses anyway)."""
+    dirs = dirs if dirs is not None else config.package_dirs
     paths = [p for p in (os.path.join(config.root, d)
-                         for d in config.package_dirs)
+                         for d in dirs)
              if os.path.isdir(p)]
     # Key on each file's (path, mtime, size) so a long-lived process
     # (fixture tests rewriting sources, a future watch mode) never
@@ -329,22 +365,28 @@ def load_package_tree(config: Config,
     if sig and all(os.path.normpath(fp) in covered
                    for fp, _, _ in sig):
         return []
-    key = (os.path.abspath(config.root), config.package_dirs,
-           tuple(sig))
+    key = (os.path.abspath(config.root), dirs, tuple(sig))
     if key not in _TREE_CACHE:
-        _TREE_CACHE.clear()     # one tree per process is plenty
+        # A handful of live trees per process: the package tree and the
+        # package+tests tree coexist in one run, and fixture tests cycle
+        # a few roots — evict oldest-first past that.
+        while len(_TREE_CACHE) >= 4:
+            _TREE_CACHE.pop(next(iter(_TREE_CACHE)))
         files, _ = load_files(paths)
         _TREE_CACHE[key] = files
     return _TREE_CACHE[key]
 
 
 def resolution_files(files: list[SourceFile],
-                     config: Config) -> list[SourceFile]:
+                     config: Config,
+                     dirs: Optional[tuple[str, ...]] = None,
+                     ) -> list[SourceFile]:
     """Analyzed set ∪ package tree, analyzed objects taking precedence
     (so node-identity side tables built during scanning stay consistent
     with the objects other passes walk)."""
     covered = frozenset(sf.path for sf in files)
-    union = {sf.path: sf for sf in load_package_tree(config, covered)}
+    union = {sf.path: sf
+             for sf in load_package_tree(config, covered, dirs)}
     union.update({sf.path: sf for sf in files})
     return list(union.values())
 

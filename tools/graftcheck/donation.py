@@ -1,0 +1,420 @@
+"""Buffer-donation safety analyzer.
+
+``jax.jit(f, donate_argnums=...)`` hands the runtime ownership of the
+argument buffers at those positions: XLA may alias them into the
+outputs, and the moment the dispatch is issued the host-side array
+behind the binding is invalid. A later host read of that binding is
+*silent corruption* — no exception, just whatever bytes the output
+buffer left behind. With 16+ donating dispatch sites on the decode hot
+path (scheduler, engine, drafter, bench) this is the sharpest
+memory-safety edge in the tree, and nothing checked it structurally.
+
+Three rules (tags ``donated-ok`` / ``nodonate``):
+
+- ``donation/bad-index`` (tag ``donated-ok``): a literal
+  ``donate_argnums`` index out of range for the wrapped function's
+  positional signature, or a ``donate_argnames`` name not in the
+  signature. JAX only errors for these at trace time — on the one code
+  path that reaches the dispatch.
+- ``donation/use-after-donate`` (tag ``donated-ok``): the dispatch
+  passes a local name at a donated position and the same scope reads
+  that name again after the dispatch without rebinding it first —
+  including the loop form, where a carried buffer that is never
+  rebound in the loop body is re-donated (already dead) on the next
+  iteration. The safe idiom rebinds in the dispatch statement itself:
+  ``toks, nxt, cache = fused_j(params, toks, cache, active)``.
+- ``donation/no-donate`` (tag ``nodonate``): advisory, only in
+  config.donate_hot_modules — a jit site whose wrapped function
+  carries a cache/pool-shaped parameter (name in
+  config.donate_carry_params or ``*_cache``/``*_pool``) at a position
+  that is NOT donated. On the decode hot path an undonated KV cache is
+  a full HBM copy per tick; sites that are deliberate (a prefill that
+  must keep its input pages) annotate ``# graftcheck: nodonate
+  <reason>``.
+
+Wrapped functions resolve the way stream_close resolves generators:
+``jax.jit(f, ...)`` call forms against the nearest enclosing scope's
+defs, and decorator forms (``@jax.jit``, ``@functools.partial(jax.jit,
+donate_argnums=...)``) against the decorated def itself. Dispatch
+handles resolve lexically too: ``h = jax.jit(...)`` then ``h(...)`` in
+the same or a nested scope, and ``self._h = jax.jit(...)`` then
+``self._h(...)`` anywhere in the same class. Non-literal
+``donate_argnums`` and unresolvable callees are skipped — this is a
+lexical checker, not an evaluator.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from .core import Config, Finding, SourceFile, dotted_name, str_const
+
+
+def _is_jit(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func).rsplit(".", 1)[-1] == "jit")
+
+
+def _partial_jit(dec: ast.AST) -> Optional[ast.Call]:
+    """``functools.partial(jax.jit, ...)`` decorator -> the Call, so
+    its keywords can be read like a direct jit call's."""
+    if isinstance(dec, ast.Call) \
+            and dotted_name(dec.func).rsplit(".", 1)[-1] == "partial" \
+            and dec.args \
+            and dotted_name(dec.args[0]).rsplit(".", 1)[-1] == "jit":
+        return dec
+    return None
+
+
+def _donated_literals(call: Optional[ast.Call]
+                      ) -> tuple[Optional[list[int]], list[str]]:
+    """(indices or None-if-nonliteral, argnames). A jit call with no
+    donate kwargs returns ([], [])."""
+    idxs: Optional[list[int]] = []
+    names: list[str] = []
+    if call is None:
+        return idxs, names
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, int):
+                    if idxs is not None:
+                        idxs.append(v.value)
+                else:
+                    idxs = None     # non-literal: skip index rules
+        elif kw.arg == "donate_argnames":
+            vals = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            for v in vals:
+                s = str_const(v)
+                if s:
+                    names.append(s)
+    return idxs, names
+
+
+def _positional_params(fn: ast.AST) -> list[str]:
+    a = fn.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+def _own_nodes(scope_node: ast.AST) -> list[ast.AST]:
+    """All nodes in this scope's own body, lexical order, not
+    descending into nested function/class/lambda bodies."""
+    out: list[ast.AST] = []
+    stack = list(ast.iter_child_nodes(scope_node))
+    while stack:
+        n = stack.pop(0)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            out.append(n)
+            continue
+        out.append(n)
+        stack[:0] = list(ast.iter_child_nodes(n))
+    out.sort(key=lambda n: (getattr(n, "lineno", 0),
+                            getattr(n, "col_offset", 0)))
+    return out
+
+
+def _own_defs(scope_node: ast.AST) -> dict[str, ast.FunctionDef]:
+    return {n.name: n for n in _own_nodes(scope_node)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _resolve(call: ast.Call,
+             chain: tuple[dict[str, ast.FunctionDef], ...]
+             ) -> Optional[ast.FunctionDef]:
+    """jax.jit(f, ...)'s wrapped def, via the nearest enclosing
+    scope."""
+    if not call.args or not isinstance(call.args[0], ast.Name):
+        return None
+    for defs in reversed(chain):
+        fn = defs.get(call.args[0].id)
+        if fn is not None:
+            return fn
+    return None
+
+
+def _carry_param(name: str, config: Config) -> bool:
+    return name in config.donate_carry_params or any(
+        name.endswith("_" + p) for p in config.donate_carry_params)
+
+
+def _stored_names(stmt: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for n in ast.walk(stmt):
+        if isinstance(n, ast.Name) \
+                and isinstance(n.ctx, (ast.Store, ast.Del)):
+            out.add(n.id)
+    return out
+
+
+def _enclosing_stmt(scope_node: ast.AST,
+                    node: ast.AST) -> Optional[ast.stmt]:
+    """The innermost SIMPLE statement in scope whose span contains
+    node — rebind-in-same-statement means the dispatch's own assign,
+    not the whole enclosing loop."""
+    best: Optional[ast.stmt] = None
+    for n in _own_nodes(scope_node):
+        if isinstance(n, ast.stmt) \
+                and not isinstance(n, (ast.For, ast.AsyncFor, ast.While,
+                                       ast.If, ast.With, ast.AsyncWith,
+                                       ast.Try, ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.ClassDef)) \
+                and n.lineno <= node.lineno \
+                <= getattr(n, "end_lineno", n.lineno):
+            if best is None or n.lineno >= best.lineno:
+                best = n
+    return best
+
+
+def _enclosing_loop(scope_node: ast.AST, line: int) -> Optional[ast.AST]:
+    best: Optional[ast.AST] = None
+    for n in _own_nodes(scope_node):
+        if isinstance(n, (ast.For, ast.AsyncFor, ast.While)) \
+                and n.lineno <= line <= getattr(n, "end_lineno",
+                                                n.lineno):
+            if best is None or n.lineno > best.lineno:
+                best = n
+    return best
+
+
+class _Scanner:
+    def __init__(self, sf: SourceFile, config: Config,
+                 findings: list[Finding], hot: bool) -> None:
+        self.sf = sf
+        self.config = config
+        self.findings = findings
+        self.hot = hot
+
+    # -- jit-site rules -------------------------------------------------------
+
+    def site(self, call: Optional[ast.Call], fn: ast.AST,
+             line: int) -> frozenset[int]:
+        """Validate one jit site against its wrapped def; returns the
+        donated positional index set (argnames resolved to indices)."""
+        idxs, names = _donated_literals(call)
+        params = _positional_params(fn)
+        donated: set[int] = set(idxs or [])
+        for name in names:
+            if name in params:
+                donated.add(params.index(name))
+            elif name not in [a.arg for a in fn.args.kwonlyargs]:
+                self.findings.append(Finding(
+                    self.sf.path, line, "donation/bad-index",
+                    "donated-ok",
+                    f"donate_argnames names `{name}` but "
+                    f"`{getattr(fn, 'name', '?')}` has no such "
+                    "parameter — the donation silently never happens"))
+        if idxs is not None and fn.args.vararg is None:
+            for i in idxs:
+                if i < 0 or i >= len(params):
+                    self.findings.append(Finding(
+                        self.sf.path, line, "donation/bad-index",
+                        "donated-ok",
+                        f"donate_argnums index {i} is out of range for "
+                        f"`{getattr(fn, 'name', '?')}` "
+                        f"({len(params)} positional parameter"
+                        f"{'s' if len(params) != 1 else ''}) — jax "
+                        "raises only at trace time, on the first real "
+                        "dispatch"))
+        if self.hot:
+            for i, p in enumerate(params):
+                if _carry_param(p, self.config) and i not in donated:
+                    self.findings.append(Finding(
+                        self.sf.path, line, "donation/no-donate",
+                        "nodonate",
+                        f"hot-path jit of `{getattr(fn, 'name', '?')}` "
+                        f"does not donate carried buffer `{p}` "
+                        f"(position {i}) — an undonated cache/pool is "
+                        "a full HBM copy per dispatch; donate it or "
+                        "annotate `# graftcheck: nodonate <reason>`"))
+        return frozenset(donated)
+
+    # -- dispatch rule --------------------------------------------------------
+
+    def dispatch(self, call: ast.Call, scope_node: ast.AST,
+                 donated: frozenset[int]) -> None:
+        if any(isinstance(a, ast.Starred) for a in call.args):
+            return      # splat shifts positions; not resolvable here
+        for i in sorted(donated):
+            if i >= len(call.args):
+                continue
+            arg = call.args[i]
+            if not isinstance(arg, ast.Name):
+                continue
+            stmt = _enclosing_stmt(scope_node, call)
+            if stmt is None:
+                continue
+            if arg.id in _stored_names(stmt):
+                continue    # rebind-with-result, the safe idiom
+            loop = _enclosing_loop(scope_node, call.lineno)
+            if loop is not None:
+                stored_in_loop = any(
+                    isinstance(n, ast.Name) and n.id == arg.id
+                    and isinstance(n.ctx, ast.Store)
+                    for n in ast.walk(loop))
+                if not stored_in_loop:
+                    self.findings.append(Finding(
+                        self.sf.path, call.lineno,
+                        "donation/use-after-donate", "donated-ok",
+                        f"`{arg.id}` is donated here inside a loop but "
+                        "never rebound in the loop body — the next "
+                        "iteration dispatches an already-donated "
+                        "buffer (silently corrupt after the first "
+                        "tick)"))
+                    continue
+            end = getattr(stmt, "end_lineno", stmt.lineno)
+            for n in _own_nodes(scope_node):
+                if not (isinstance(n, ast.Name) and n.id == arg.id
+                        and getattr(n, "lineno", 0) > end):
+                    continue
+                if isinstance(n.ctx, (ast.Store, ast.Del)):
+                    break
+                self.findings.append(Finding(
+                    self.sf.path, n.lineno,
+                    "donation/use-after-donate", "donated-ok",
+                    f"`{arg.id}` was donated to the dispatch on line "
+                    f"{call.lineno} and is read here without being "
+                    "rebound — the buffer behind it is invalid the "
+                    "moment the dispatch is issued (silent "
+                    "corruption, no exception)"))
+                break
+
+    # -- walk -----------------------------------------------------------------
+
+    def scan_scope(self, scope_node: ast.AST,
+                   chain: tuple[dict[str, ast.FunctionDef], ...],
+                   handles: tuple[dict[str, frozenset[int]], ...],
+                   cls_handles: Optional[dict[str, frozenset[int]]] = None,
+                   ) -> None:
+        chain = chain + (_own_defs(scope_node),)
+        own = _own_nodes(scope_node)
+        local: dict[str, frozenset[int]] = {}
+        jit_nodes: set[int] = set()
+        # Pass 1: jit sites in this scope (validated once each); handle
+        # bindings recorded so pass-2 dispatches resolve regardless of
+        # walk order.
+        for node in own:
+            if isinstance(node, ast.Assign) and _is_jit(node.value):
+                jit_nodes.add(id(node.value))
+                donated = self._jit_value(node.value, chain)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local[t.id] = donated
+                    elif isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self" \
+                            and cls_handles is not None:
+                        cls_handles.setdefault("self." + t.attr, donated)
+        for node in own:
+            if _is_jit(node) and id(node) not in jit_nodes:
+                jit_nodes.add(id(node))
+                self._jit_value(node, chain)
+        handles = handles + (local,)
+        # Pass 2: dispatches through known handles.
+        for node in own:
+            if not isinstance(node, ast.Call) or id(node) in jit_nodes:
+                continue
+            key = None
+            if isinstance(node.func, ast.Name):
+                key = node.func.id
+            elif isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                key = "self." + node.func.attr
+            donated: Optional[frozenset[int]] = None
+            if key is not None:
+                for hmap in reversed(handles):
+                    if key in hmap:
+                        donated = hmap[key]
+                        break
+                if donated is None and cls_handles is not None:
+                    donated = cls_handles.get(key)
+            if donated:
+                self.dispatch(node, scope_node, donated)
+        # Recurse.
+        for node in own:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._decorated_def(node)
+                self.scan_scope(node, chain, handles, cls_handles)
+            elif isinstance(node, ast.ClassDef):
+                self._scan_class(node, chain, handles)
+
+    def _scan_class(self, cls: ast.ClassDef,
+                    chain: tuple[dict[str, ast.FunctionDef], ...],
+                    handles: tuple[dict[str, frozenset[int]], ...],
+                    ) -> None:
+        """Pre-collect ``self.h = jax.jit(...)`` handles across all
+        methods first, so a handle stored in __init__ resolves at a
+        dispatch in another method regardless of definition order."""
+        cls_handles: dict[str, frozenset[int]] = {}
+        for item in cls.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            inner = chain + (_own_defs(item),)
+            for n in ast.walk(item):
+                if isinstance(n, ast.Assign) and _is_jit(n.value):
+                    donated = self._collect_only(n.value, inner)
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            cls_handles.setdefault("self." + t.attr,
+                                                   donated)
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._decorated_def(item)
+                self.scan_scope(item, chain, handles, cls_handles)
+
+    def _collect_only(self, call: ast.Call,
+                      chain: tuple[dict[str, ast.FunctionDef], ...],
+                      ) -> frozenset[int]:
+        idxs, names = _donated_literals(call)
+        donated = set(idxs or [])
+        fn = _resolve(call, chain)
+        if fn is not None:
+            params = _positional_params(fn)
+            donated.update(params.index(n) for n in names
+                           if n in params)
+        return frozenset(donated)
+
+    def _jit_value(self, call: ast.Call,
+                   chain: tuple[dict[str, ast.FunctionDef], ...],
+                   ) -> frozenset[int]:
+        fn = _resolve(call, chain)
+        if fn is None:
+            idxs, _names = _donated_literals(call)
+            return frozenset(idxs or [])
+        return self.site(call, fn, call.lineno)
+
+    def _decorated_def(self, fn: ast.FunctionDef) -> None:
+        """@jax.jit / @functools.partial(jax.jit, donate_argnums=...)
+        forms: the decorated def IS the wrapped function."""
+        for dec in fn.decorator_list:
+            pj = _partial_jit(dec)
+            if pj is not None:
+                self.site(pj, fn, dec.lineno)
+            elif not isinstance(dec, ast.Call) \
+                    and dotted_name(dec).rsplit(".", 1)[-1] == "jit":
+                self.site(None, fn, dec.lineno)
+
+
+def analyze(files: list[SourceFile], config: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in files:
+        norm = sf.path.replace("\\", "/")
+        is_test = "tests/" in norm or norm.rsplit("/", 1)[-1].startswith(
+            "test_")
+        if is_test:
+            continue
+        hot = any(norm == m or norm.endswith("/" + m)
+                  for m in config.donate_hot_modules)
+        _Scanner(sf, config, findings, hot).scan_scope(
+            sf.tree, (), ())
+    return findings

@@ -1,0 +1,269 @@
+"""Failpoint-site contract analyzer.
+
+A failpoint site name is a wire contract three parties must agree on:
+the code that calls ``failpoint("site")``, the ``KNOWN_SITES`` registry
+(utils/failpoints.py), the chaos tests that arm it (``arm("site",...)``
+or a ``FAIL_POINTS``-grammar spec string), and the operator catalog in
+docs/robustness.md. Nothing tied them together — a typo'd site in a
+test's spec string arms nothing and the chaos test passes vacuously,
+and a site nobody arms is fault-injection coverage that silently never
+runs.
+
+Rules (tag ``failpoint-ok``):
+
+- ``failpoints/unregistered-call``: ``failpoint("x")`` in the package
+  where ``x`` carries a contract prefix (config.failpoint_prefixes)
+  but is not in the registry tuple — arming it from the environment
+  warns and does nothing.
+- ``failpoints/unknown-site``: an ``arm("x")`` call or a spec-grammar
+  literal (``x=raise``/``delay``/``drop``/``error``) in tests or a CI
+  script naming a prefix-carrying site that is not registered — the
+  chaos leg passes without injecting anything. Scratch sites outside
+  the prefixes (tests use ``t.*``) are exempt by construction.
+- ``failpoints/unarmed-site``: a registered site no test ever arms —
+  the fault path has zero injection coverage.
+- ``failpoints/undocumented-site``: a registered site missing from the
+  marked ``<!-- failpoint-contract:begin/end -->`` catalog in
+  config.failpoint_docs — operators can't know the contract when it's
+  armed.
+- ``failpoints/orphan-site``: a catalog entry naming a site that is
+  not registered — the runbook documents a knob that doesn't exist.
+
+Partial-run discipline: registry, call sites, and arming evidence
+resolve against the FULL package + tests tree
+(core.load_package_tree with an analyzer-specific dir set), so
+``graftcheck serve/scheduler.py`` never reports every site unarmed.
+Registry-anchored findings (unarmed/undocumented) only fire when the
+registry module itself is in the analyzed set; literal-anchored
+findings (unknown-site, unregistered-call) only when their file is.
+Docs-anchored findings are tree-accurate and always fire.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import (Config, Finding, SourceFile, dotted_name,
+                   resolution_files, str_const)
+
+_SPEC_ENTRY_RE = re.compile(
+    r"^\s*([A-Za-z0-9_.\-]+)\s*=\s*(raise|delay|drop|error)"
+    r"([:*@][^=\s]*)?\s*$")
+_CI_SPEC_RE = re.compile(
+    r"([A-Za-z0-9_.\-]+)=(?:raise|delay|drop|error)\b")
+_DOC_TOKEN_RE = re.compile(r"`([a-z0-9_.\-]+)`")
+_DOC_BEGIN = "<!-- failpoint-contract:begin -->"
+_DOC_END = "<!-- failpoint-contract:end -->"
+
+
+def _is_test(norm: str) -> bool:
+    return "tests/" in norm or norm.rsplit("/", 1)[-1].startswith("test_")
+
+
+def _spec_sites(value: str) -> list[str]:
+    """Site names from a FAIL_POINTS spec string — only when EVERY
+    comma entry matches the arm grammar, so ordinary prose/URLs never
+    count as arming evidence."""
+    entries = [e for e in value.split(",") if e.strip()]
+    if not entries:
+        return []
+    sites = []
+    for e in entries:
+        m = _SPEC_ENTRY_RE.match(e)
+        if not m:
+            return []
+        sites.append(m.group(1))
+    return sites
+
+
+def _scan_registry(sf: SourceFile, config: Config
+                   ) -> dict[str, int]:
+    """site -> registry line, from the KNOWN_SITES tuple/list/set."""
+    sites: dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        targets: list[ast.AST] = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if not any(isinstance(t, ast.Name)
+                   and t.id == config.failpoint_registry
+                   for t in targets):
+            continue
+        if isinstance(value, ast.Call):
+            # frozenset((...)) / set([...]) wrapper forms
+            value = value.args[0] if value.args else None
+        if isinstance(value, (ast.Tuple, ast.List, ast.Set)):
+            for elt in value.elts:
+                s = str_const(elt)
+                if s and s not in sites:
+                    sites[s] = elt.lineno
+    return sites
+
+
+def _scan_arming(sf: SourceFile) -> list[tuple[str, int]]:
+    """(site, line) arming evidence in one test file: arm("x") calls
+    and spec-grammar string literals."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) \
+                and dotted_name(node.func).rsplit(".", 1)[-1] == "arm" \
+                and node.args:
+            s = str_const(node.args[0])
+            if s:
+                out.append((s, node.lineno))
+        elif isinstance(node, ast.Constant) \
+                and isinstance(node.value, str):
+            for s in _spec_sites(node.value):
+                out.append((s, node.lineno))
+    return out
+
+
+def _scan_calls(sf: SourceFile) -> list[tuple[str, int]]:
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call) \
+                and dotted_name(node.func).rsplit(".", 1)[-1] \
+                == "failpoint" and node.args:
+            s = str_const(node.args[0])
+            if s:
+                out.append((s, node.lineno))
+    return out
+
+
+def analyze(files: list[SourceFile], config: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    analyzed = {sf.path for sf in files}
+    # The contract spans code AND tests, so the resolution tree for
+    # this analyzer is package dirs + test dirs — a partial run on one
+    # scheduler file still sees every arm() call.
+    tree = resolution_files(
+        files, config, config.package_dirs + config.failpoint_test_dirs)
+
+    registry: dict[str, int] = {}
+    registry_sf = None
+    for sf in tree:
+        norm = sf.path.replace("\\", "/")
+        if norm == config.failpoints_module \
+                or norm.endswith("/" + config.failpoints_module):
+            registry_sf = sf
+            registry = _scan_registry(sf, config)
+            break
+
+    armed: dict[str, list[tuple[str, int]]] = {}
+    calls: dict[str, list[tuple[str, int]]] = {}
+    for sf in tree:
+        norm = sf.path.replace("\\", "/")
+        if _is_test(norm):
+            for site, line in _scan_arming(sf):
+                armed.setdefault(site, []).append((sf.path, line))
+        else:
+            for site, line in _scan_calls(sf):
+                calls.setdefault(site, []).append((sf.path, line))
+
+    # CI scripts are arming evidence too (the chaos leg), scanned
+    # textually: shell, not Python.
+    for rel in config.failpoint_ci_files:
+        path = os.path.join(config.root, rel)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                ci_lines = fh.readlines()
+        except OSError:
+            continue
+        for i, line in enumerate(ci_lines, 1):
+            if "FAIL_POINTS" not in line:
+                continue
+            for m in _CI_SPEC_RE.finditer(line):
+                armed.setdefault(m.group(1), []).append((rel, i))
+
+    prefixed = config.failpoint_prefixes
+
+    # Docs catalog (marked region only).
+    documented: dict[str, tuple[str, int]] = {}
+    region_seen = False
+    for rel in config.failpoint_docs:
+        path = os.path.join(config.root, rel)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc_lines = fh.readlines()
+        except OSError:
+            continue
+        in_catalog = False
+        for i, line in enumerate(doc_lines, 1):
+            if _DOC_BEGIN in line:
+                in_catalog = region_seen = True
+                continue
+            if _DOC_END in line:
+                in_catalog = False
+                continue
+            if not in_catalog:
+                continue
+            for tok in _DOC_TOKEN_RE.findall(line):
+                if "." in tok and tok.startswith(prefixed) \
+                        and tok not in documented:
+                    documented[tok] = (rel, i)
+
+    # -- literal-anchored rules ----------------------------------------------
+    if registry:
+        for site, refs in sorted(calls.items()):
+            if site in registry or not site.startswith(prefixed):
+                continue
+            for path, line in refs:
+                if path not in analyzed:
+                    continue
+                findings.append(Finding(
+                    path, line, "failpoints/unregistered-call",
+                    "failpoint-ok",
+                    f"failpoint(\"{site}\") is not in "
+                    f"{config.failpoint_registry} "
+                    f"({config.failpoints_module}) — arming it from "
+                    "FAIL_POINTS warns and injects nothing"))
+        for site, refs in sorted(armed.items()):
+            if site in registry or not site.startswith(prefixed):
+                continue
+            for path, line in refs:
+                norm = path.replace("\\", "/")
+                is_ci = any(norm == c for c in config.failpoint_ci_files)
+                if not is_ci and path not in analyzed:
+                    continue
+                findings.append(Finding(
+                    path, line, "failpoints/unknown-site",
+                    "failpoint-ok",
+                    f"spec arms `{site}`, which is not a registered "
+                    "failpoint site — the chaos leg passes without "
+                    "injecting anything (typo'd site names make fault "
+                    "tests vacuous)"))
+
+    # -- registry-anchored rules ----------------------------------------------
+    if registry_sf is not None and registry_sf.path in analyzed:
+        for site, line in sorted(registry.items()):
+            if site not in armed:
+                findings.append(Finding(
+                    registry_sf.path, line, "failpoints/unarmed-site",
+                    "failpoint-ok",
+                    f"registered failpoint site `{site}` is never "
+                    "armed by any test or CI chaos spec — its fault "
+                    "path has zero injection coverage"))
+            if region_seen and site not in documented:
+                findings.append(Finding(
+                    registry_sf.path, line,
+                    "failpoints/undocumented-site", "failpoint-ok",
+                    f"registered failpoint site `{site}` is missing "
+                    "from the failpoint-contract catalog in "
+                    f"{', '.join(config.failpoint_docs)} — operators "
+                    "can't know its contract when armed"))
+
+    # -- docs-anchored rule ---------------------------------------------------
+    if registry:
+        for site, (rel, line) in sorted(documented.items()):
+            if site not in registry:
+                findings.append(Finding(
+                    rel, line, "failpoints/orphan-site",
+                    "failpoint-ok",
+                    f"catalog documents failpoint site `{site}` but "
+                    "the registry doesn't define it — the runbook "
+                    "names a knob that doesn't exist"))
+    return findings

@@ -1,0 +1,429 @@
+"""HTTP wire-contract analyzer.
+
+The serve/chat fronts re-implement the same three wire disciplines by
+hand in every handler, and each one is a client-visible contract:
+
+- A 503 tells the loadgen/router/SDK *when to come back* — without
+  Retry-After the backoff guess is wrong on both sides of a shed.
+- An NDJSON stream's terminal ``done`` record is how clients
+  distinguish "complete" from "connection died" — a generator exit
+  path that skips it turns every error into a hang-then-guess.
+- ``X-Graft-Trace`` / ``X-Session-Id`` forwarding is what makes a
+  request traceable across the proxy hop — one handler dropping them
+  orphans the downstream span and strands session affinity.
+
+Rules (tag ``http-ok``), applied to files matching config.http_modules
+(tests excluded):
+
+- ``http/503-no-retry-after``: ``Response(503, ...)`` whose literal
+  headers dict carries no Retry-After (or has no headers at all).
+  Non-literal headers expressions are trusted.
+- ``http/stream-no-done``: a generator handed to ``Response(stream=
+  g(...), content_type=...ndjson...)`` (resolved the stream_close way:
+  nearest enclosing scope, or ``self.<m>`` against the class) whose
+  final yield — overall, or of any yielding except-handler — contains
+  no ``done`` record (a ``"done"`` key or a ``'"done"'`` JSON
+  fragment).
+- ``http/proxy-no-trace`` / ``http/proxy-no-session``: a handler (a
+  function taking ``req``) that makes an outbound call
+  (``http_json``/``urlopen``) somewhere in its body without
+  referencing the trace header (``x-graft-trace`` literal or the
+  ``trace.HEADER``/``HEADER_LC`` constants) / the ``x-session-id``
+  literal — the proxy hop drops the wire context it was handed.
+
+Endpoint catalog (config.endpoint_modules vs the marked
+``<!-- endpoint-contract:begin/end -->`` region of
+config.endpoint_docs):
+
+- ``http/undocumented-endpoint``: a ``router.add("METHOD", "/path",
+  ...)`` registration (loop-registered paths resolve through the
+  enclosing ``for`` over a literal tuple) absent from the catalog.
+- ``http/orphan-endpoint``: a catalog row naming an endpoint no front
+  registers.
+
+Partial-run discipline: registrations resolve against the full package
+tree; undocumented-endpoint anchors only in the analyzed set,
+orphan-endpoint is tree-accurate (docs-anchored). The docs region
+missing entirely disables both endpoint rules (fixture roots).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+from .core import (Config, Finding, SourceFile, dotted_name,
+                   resolution_files, str_const)
+
+_OUTBOUND = {"http_json", "urlopen"}
+_TRACE_ATTRS = {"HEADER", "HEADER_LC"}
+_DOC_EP_RE = re.compile(r"`([A-Z]+) (/[^\s`]*)`")
+_DOC_BEGIN = "<!-- endpoint-contract:begin -->"
+_DOC_END = "<!-- endpoint-contract:end -->"
+
+
+def _is_test(norm: str) -> bool:
+    return "tests/" in norm or norm.rsplit("/", 1)[-1].startswith("test_")
+
+
+def _module_match(norm: str, entries: tuple[str, ...]) -> bool:
+    for m in entries:
+        if m.endswith("/"):
+            if ("/" + m) in norm or norm.startswith(m):
+                return True
+        elif norm == m or norm.endswith("/" + m):
+            return True
+    return False
+
+
+# -- 503 discipline -----------------------------------------------------------
+
+def _check_503(sf: SourceFile, findings: list[Finding]) -> None:
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and dotted_name(node.func).rsplit(".", 1)[-1]
+                == "Response"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == 503):
+            continue
+        headers = None
+        for kw in node.keywords:
+            if kw.arg == "headers":
+                headers = kw.value
+        if headers is None:
+            findings.append(Finding(
+                sf.path, node.lineno, "http/503-no-retry-after",
+                "http-ok",
+                "503 response without a Retry-After header — clients "
+                "can't back off correctly; pass headers="
+                "{\"Retry-After\": \"<seconds>\"}"))
+            continue
+        if not isinstance(headers, ast.Dict):
+            continue    # computed headers: trusted
+        keys = [str_const(k) for k in headers.keys]
+        if any(k is None for k in keys):
+            continue    # non-literal key: trusted
+        if not any(k.lower() == "retry-after" for k in keys if k):
+            findings.append(Finding(
+                sf.path, node.lineno, "http/503-no-retry-after",
+                "http-ok",
+                "503 response whose headers dict has no Retry-After — "
+                "clients can't back off correctly"))
+
+
+# -- NDJSON terminal-done discipline ------------------------------------------
+
+def _yields(fn: ast.AST) -> list[ast.AST]:
+    out: list[ast.AST] = []
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _has_done(y: ast.AST) -> bool:
+    for n in ast.walk(y):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and (n.value == "done" or '"done"' in n.value):
+            return True
+        if isinstance(n, ast.Constant) and isinstance(n.value, bytes) \
+                and b'"done"' in n.value:
+            return True
+    return False
+
+
+def _check_gen(sf: SourceFile, gen: ast.FunctionDef,
+               findings: list[Finding], checked: set[int]) -> None:
+    if id(gen) in checked:
+        return
+    checked.add(id(gen))
+    ys = _yields(gen)
+    if not ys:
+        return
+    last = max(ys, key=lambda y: getattr(y, "lineno", 0))
+    bad: Optional[int] = None
+    if not _has_done(last):
+        bad = getattr(last, "lineno", gen.lineno)
+    for node in ast.walk(gen):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        hys = [y for y in ys
+               if node.lineno <= getattr(y, "lineno", 0)
+               <= getattr(node, "end_lineno", node.lineno)]
+        if not hys:
+            continue
+        hlast = max(hys, key=lambda y: getattr(y, "lineno", 0))
+        if not _has_done(hlast):
+            bad = getattr(hlast, "lineno", node.lineno)
+    if bad is not None:
+        findings.append(Finding(
+            sf.path, gen.lineno, "http/stream-no-done", "http-ok",
+            f"NDJSON stream generator `{gen.name}` has an exit path "
+            f"whose final yield (line {bad}) carries no `done` record "
+            "— clients can't distinguish completion from a dropped "
+            "connection"))
+
+
+def _own_defs(scope_node: ast.AST) -> dict[str, ast.FunctionDef]:
+    out: dict[str, ast.FunctionDef] = {}
+    stack = list(ast.iter_child_nodes(scope_node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[n.name] = n
+            continue
+        if isinstance(n, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _scan_streams(sf: SourceFile, scope_node: ast.AST,
+                  chain: tuple[dict[str, ast.FunctionDef], ...],
+                  findings: list[Finding], checked: set[int],
+                  cls_defs: dict[str, ast.FunctionDef] = {}) -> None:
+    chain = chain + (_own_defs(scope_node),)
+    stack = list(ast.iter_child_nodes(scope_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_streams(sf, node, chain, findings, checked, cls_defs)
+            continue
+        if isinstance(node, ast.ClassDef):
+            methods = {n.name: n for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            _scan_streams(sf, node, chain, findings, checked, methods)
+            continue
+        if isinstance(node, ast.Call) \
+                and dotted_name(node.func).rsplit(".", 1)[-1] \
+                == "Response":
+            stream = ctype = None
+            for kw in node.keywords:
+                if kw.arg == "stream":
+                    stream = kw.value
+                elif kw.arg == "content_type":
+                    ctype = str_const(kw.value)
+            if stream is not None and isinstance(stream, ast.Call) \
+                    and ctype and "ndjson" in ctype:
+                gen = None
+                if isinstance(stream.func, ast.Name):
+                    for defs in reversed(chain):
+                        gen = defs.get(stream.func.id)
+                        if gen is not None:
+                            break
+                elif (isinstance(stream.func, ast.Attribute)
+                        and isinstance(stream.func.value, ast.Name)
+                        and stream.func.value.id == "self"):
+                    gen = cls_defs.get(stream.func.attr)
+                if gen is not None:
+                    _check_gen(sf, gen, findings, checked)
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# -- proxy header forwarding --------------------------------------------------
+
+def _own_subtree(node: ast.AST) -> list[ast.AST]:
+    """node's body, excluding nested functions that take their own
+    ``req`` (those are handlers in their own right, charged
+    separately)."""
+    out: list[ast.AST] = []
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = [a.arg for a in (list(n.args.posonlyargs)
+                                     + list(n.args.args))]
+            if "req" in inner:
+                continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _evidence(subtree: list[ast.AST]) -> tuple[bool, bool, bool]:
+    """(outbound, trace, session) facts in one scope's subtree."""
+    outbound = has_trace = has_session = False
+    for n in subtree:
+        if isinstance(n, ast.Call) \
+                and dotted_name(n.func).rsplit(".", 1)[-1] in _OUTBOUND:
+            outbound = True
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            low = n.value.lower()
+            if low == "x-graft-trace":
+                has_trace = True
+            elif low == "x-session-id":
+                has_session = True
+        if isinstance(n, ast.Attribute) and n.attr in _TRACE_ATTRS:
+            has_trace = True
+    return outbound, has_trace, has_session
+
+
+def _check_proxies(sf: SourceFile, findings: list[Finding]) -> None:
+    # Per-function evidence first, so a handler that builds its
+    # forwarded headers through a same-file helper
+    # (`self._fwd_headers(req)`) gets credit — one level, no
+    # transitive closure.
+    evid: dict[str, tuple[bool, bool]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _o, t, s = _evidence(_own_subtree(node))
+            pt, ps = evid.get(node.name, (False, False))
+            evid[node.name] = (pt or t, ps or s)
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = [a.arg for a in (list(node.args.posonlyargs)
+                                  + list(node.args.args))]
+        if "req" not in params:
+            continue
+        subtree = _own_subtree(node)
+        outbound, has_trace, has_session = _evidence(subtree)
+        if not outbound:
+            continue
+        for n in subtree:
+            if not isinstance(n, ast.Call):
+                continue
+            callee = None
+            if isinstance(n.func, ast.Name):
+                callee = n.func.id
+            elif isinstance(n.func, ast.Attribute):
+                callee = n.func.attr
+            if callee in evid:
+                t, s = evid[callee]
+                has_trace = has_trace or t
+                has_session = has_session or s
+        if not has_trace:
+            findings.append(Finding(
+                sf.path, node.lineno, "http/proxy-no-trace", "http-ok",
+                f"handler `{node.name}` proxies the request outbound "
+                "without forwarding X-Graft-Trace — the downstream "
+                "span is orphaned and cross-hop attribution breaks"))
+        if not has_session:
+            findings.append(Finding(
+                sf.path, node.lineno, "http/proxy-no-session",
+                "http-ok",
+                f"handler `{node.name}` proxies the request outbound "
+                "without forwarding X-Session-Id — session affinity "
+                "is stranded at the hop"))
+
+
+# -- endpoint catalog ---------------------------------------------------------
+
+def _scan_routes(sf: SourceFile) -> list[tuple[str, int]]:
+    """("METHOD /path", line) registrations, resolving loop-registered
+    paths (`for ep in ("/a", "/b"): router.add("POST", ep, h)`)."""
+    out: list[tuple[str, int]] = []
+    loops: list[tuple[str, list[str], int, int]] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)) \
+                and isinstance(node.target, ast.Name) \
+                and isinstance(node.iter, (ast.Tuple, ast.List)):
+            vals = [str_const(e) for e in node.iter.elts]
+            if vals and all(v is not None for v in vals):
+                loops.append((node.target.id, vals, node.lineno,
+                              getattr(node, "end_lineno", node.lineno)))
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add"
+                and "router" in dotted_name(node.func).lower()
+                and len(node.args) >= 2):
+            continue
+        method = str_const(node.args[0])
+        if not method:
+            continue
+        path_node = node.args[1]
+        paths: list[str] = []
+        p = str_const(path_node)
+        if p:
+            paths = [p]
+        elif isinstance(path_node, ast.Name):
+            for name, vals, start, end in loops:
+                if name == path_node.id and start <= node.lineno <= end:
+                    paths = vals
+                    break
+        for p in paths:
+            if p.startswith("/"):
+                out.append((f"{method} {p}", node.lineno))
+    return out
+
+
+def analyze(files: list[SourceFile], config: Config) -> list[Finding]:
+    findings: list[Finding] = []
+    analyzed = {sf.path for sf in files}
+
+    for sf in files:
+        norm = sf.path.replace("\\", "/")
+        if _is_test(norm) or not _module_match(norm, config.http_modules):
+            continue
+        _check_503(sf, findings)
+        _scan_streams(sf, sf.tree, (), findings, set())
+        _check_proxies(sf, findings)
+
+    # Endpoint catalog: registrations from the full tree, docs from the
+    # marked region.
+    documented: dict[str, tuple[str, int]] = {}
+    region_seen = False
+    for rel in config.endpoint_docs:
+        path = os.path.join(config.root, rel)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc_lines = fh.readlines()
+        except OSError:
+            continue
+        in_catalog = False
+        for i, line in enumerate(doc_lines, 1):
+            if _DOC_BEGIN in line:
+                in_catalog = region_seen = True
+                continue
+            if _DOC_END in line:
+                in_catalog = False
+                continue
+            if not in_catalog:
+                continue
+            for method, p in _DOC_EP_RE.findall(line):
+                documented.setdefault(f"{method} {p}", (rel, i))
+    if not region_seen:
+        return findings
+
+    routes: dict[str, list[tuple[str, int]]] = {}
+    for sf in resolution_files(files, config):
+        norm = sf.path.replace("\\", "/")
+        if _is_test(norm) \
+                or not _module_match(norm, config.endpoint_modules):
+            continue
+        for ep, line in _scan_routes(sf):
+            routes.setdefault(ep, []).append((sf.path, line))
+
+    for ep, refs in sorted(routes.items()):
+        if ep in documented:
+            continue
+        anchored = [r for r in refs if r[0] in analyzed]
+        if not anchored:
+            continue
+        path, line = anchored[0]
+        findings.append(Finding(
+            path, line, "http/undocumented-endpoint", "http-ok",
+            f"endpoint `{ep}` is registered here but missing from the "
+            "endpoint-contract catalog in "
+            f"{', '.join(config.endpoint_docs)} — the route table is "
+            "an operator contract"))
+    if routes:
+        for ep, (rel, line) in sorted(documented.items()):
+            if ep not in routes:
+                findings.append(Finding(
+                    rel, line, "http/orphan-endpoint", "http-ok",
+                    f"catalog documents endpoint `{ep}` but no front "
+                    "registers it — the docs promise a route that "
+                    "doesn't exist"))
+    return findings

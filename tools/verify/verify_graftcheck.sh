@@ -125,6 +125,48 @@ def handler(req, Response):
 EOF
 seed_expect "$SEED/stream.py" "stream-close/no-finally"
 
+# v3 analyzers: donated-buffer re-read, typo'd FAIL_POINTS site,
+# Retry-After-less 503.
+cat > "$SEED/donate.py" <<'EOF'
+import jax
+
+def _step(params, tokens, cache):
+    return tokens
+
+def run(params, toks, cache):
+    step_j = jax.jit(_step, donate_argnums=(2,))
+    out = step_j(params, toks, cache)
+    return cache.k.sum()
+EOF
+seed_expect "$SEED/donate.py" "donation/use-after-donate"
+
+# The failpoint fixture needs a registry in the seed root (registry
+# rules disarm when no KNOWN_SITES module resolves — partial-run
+# safety), plus an analyzed test file arming a typo'd site.
+mkdir -p "$SEED/p2p_llm_chat_tpu/utils" "$SEED/tests"
+cat > "$SEED/p2p_llm_chat_tpu/utils/failpoints.py" <<'EOF'
+KNOWN_SITES = (
+    "serve.api.parse",
+)
+EOF
+cat > "$SEED/tests/test_chaos_seed.py" <<'EOF'
+from p2p_llm_chat_tpu.utils import failpoints
+
+def test_chaos():
+    failpoints.arm("serve.api.parse", "raise")
+    failpoints.arm("serve.api.prase", "raise")   # typo'd site
+EOF
+seed_expect "$SEED/tests/test_chaos_seed.py" "failpoints/unknown-site"
+
+mkdir -p "$SEED/serve"
+cat > "$SEED/serve/shed.py" <<'EOF'
+from ..utils.http import Response
+
+def shed(req):
+    return Response(503, {"error": "full"})
+EOF
+seed_expect "$SEED/serve/shed.py" "http/503-no-retry-after"
+
 # 3. ci.sh itself fails on a seeded in-tree violation: an unguarded
 # write to a guarded-by attribute, appended to dht.py in a scratch
 # copy of the tree (the real tree is never touched).
@@ -193,5 +235,6 @@ print("lockcheck: seeded unguarded write caught")
 EOF
 
 echo "PASS: graftcheck gates clean tree + flags seeded violations" \
-     "(incl. lock-order/blocking/metrics/stream + runtime lockcheck)"
+     "(incl. lock-order/blocking/metrics/stream + runtime lockcheck" \
+     "+ donation/failpoints/http)"
 exit 0
