@@ -86,6 +86,16 @@ Env knobs (all optional):
                         vocab clones to the target's). With
                         BENCH_SPEC > 0 it also drafts for the main
                         phases' workload
+- ``BENCH_SPEC_TREE``   N>0 = tree-speculation A/B at EQUAL verify
+                        budget (default 8 with the freeform phase, else
+                        0): linear chain K=N-1 vs tree K=N/2 with N
+                        node positions, both legs driving an IMPERFECT
+                        drafter (top-1 decoy / truth-as-runner-up on
+                        every 3rd cycle token — the miss-with-a-good-
+                        second-choice regime sibling leaves exist for)
+                        over dedicated warmed schedulers; accepted
+                        tokens per verify dispatch and served tok/s per
+                        leg land in the JSON ``spec_tree`` row
 - ``BENCH_PREFIX``      shared-prefix KV cache (default 1; 0 disables)
 - ``BENCH_TEMP``        request temperature (default 0.7; 0 = greedy —
                         the workload where prompt-lookup spec drafts
@@ -1122,6 +1132,122 @@ def main() -> None:
             log(f"park/wake phase FAILED: {e}")
             park_wake = {"sessions": park_sessions, "error": str(e)}
 
+    # -- tree-speculation A/B phase (BENCH_SPEC_TREE, Round-17): linear
+    # chain vs tree at the SAME verify budget (N node positions), both
+    # legs over dedicated warmed scheduler+drafter pairs after the main
+    # scheduler stops. The freeform pair's drafter predicts the target
+    # ~perfectly (shared successor map) — a regime where a LONGER linear
+    # chain trivially wins — so this phase builds an IMPERFECT drafter:
+    # on every 3rd token of the cycle its lm_head carries a decoy column
+    # (top-1 = the skip-one token, truth demoted to runner-up at a small
+    # gap). Linear speculation stops dead at each decoy; the tree's
+    # sibling leaf carries the runner-up and converts the miss into a
+    # second accepted token — accepted tokens per verify dispatch at
+    # equal budget is the row's headline.
+    spec_tree: dict = {}
+    tree_nodes = env_int("BENCH_SPEC_TREE",
+                         8 if spec_workload == "freeform" else 0)
+    if tree_nodes >= 4:
+        from p2p_llm_chat_tpu.models.synth import (quote_params as _tree_qp,
+                                                   successor_map)
+        from p2p_llm_chat_tpu.serve.draft_model import ModelDrafter \
+            as _TreeDrafter
+
+        tree_slots = max(2, min(slots, 4))
+        tree_new = max(64, env_int("BENCH_SPEC_TREE_NEW", 96))
+        dcfg_t = get_config(draft_name or "draft-400m")
+        if dcfg_t.vocab_size != config.vocab_size:
+            dcfg_t = dcfg_t.with_(vocab_size=config.vocab_size)
+        try:
+            # Imperfect drafter: freeform head + decoy columns. The
+            # decoy logit is 5|emb|^2 vs the true successor's 4|emb|^2,
+            # so the top-1/top-2 gap at a decoy is ~H while a confident
+            # position's is ~4H — gap threshold 2H separates them.
+            dp_t = dict(_tree_qp(dcfg_t, jax.random.PRNGKey(1),
+                                 dtype=dtype, mode="freeform"))
+            emb_t = np.asarray(dp_t["embed"], np.float32)
+            # np.array (copy): asarray of a jax array is read-only.
+            lm_t = np.array(dp_t["lm_head"], np.float32)
+            succ_t = successor_map(dcfg_t.vocab_size, mode="freeform")
+            for t in range(32, 127, 3):
+                lm_t[:, succ_t[succ_t[t]]] += 5.0 * emb_t[t]
+            dp_t["lm_head"] = jnp.asarray(lm_t, dtype)
+            gap_thr = 2.0 * dcfg_t.hidden_size
+
+            def tree_leg(label: str, k: int, nodes: int) -> dict:
+                s3 = BatchScheduler(
+                    params, config, tokenizer, num_slots=tree_slots,
+                    max_seq=max_seq, kv_mode=kv_mode,
+                    page_size=page_size, spec_k=k, prefix_cache=False,
+                    kv_quant=kv_quant, decode_fuse_max=fuse_k,
+                    prefill_chunk=bench_chunk,
+                    drafter=_TreeDrafter(dp_t, dcfg_t,
+                                         num_slots=tree_slots,
+                                         max_seq=max_seq, k=k),
+                    spec_tree_nodes=nodes, spec_tree_gap=gap_thr)
+                try:
+                    s3.warmup(prompt_buckets=(128,), windows=(256,))
+                    g3 = GenerateOptions(max_tokens=tree_new,
+                                         temperature=0.0, seed=0)
+                    stats3 = [RequestStats() for _ in range(tree_slots)]
+
+                    def run3(st: RequestStats) -> None:
+                        for _ in s3.submit(GenerateRequest(
+                                prompt=prompt, options=g3), st):
+                            pass
+
+                    ths3 = [threading.Thread(target=run3, args=(st,))
+                            for st in stats3]
+                    t03 = time.monotonic()
+                    for th in ths3:
+                        th.start()
+                    for th in ths3:
+                        th.join()
+                    wall3 = time.monotonic() - t03
+                    snap3 = s3.metrics_snapshot()
+                    toks3 = sum(st.completion_tokens for st in stats3)
+                    out = {
+                        "spec_k": k, "nodes": nodes if nodes else None,
+                        "served_tok_s": round(toks3 / wall3, 1),
+                        "tokens": toks3, "wall_s": round(wall3, 2),
+                        "accepted_per_dispatch": snap3.get(
+                            'serve_spec_accepted_per_dispatch'
+                            '{source="model"}', 0.0),
+                        "tree_nodes_total": snap3.get(
+                            "serve_spec_tree_nodes_total"),
+                        "tree_accepted_path_len": snap3.get(
+                            "serve_spec_tree_accepted_path_len"),
+                    }
+                    log(f"spec tree ({label}): "
+                        f"{out['accepted_per_dispatch']} accepted/"
+                        f"dispatch, {out['served_tok_s']:,.1f} tok/s")
+                    return out
+                finally:
+                    s3.stop()
+
+            lin_leg = tree_leg(f"linear K={tree_nodes - 1}",
+                               tree_nodes - 1, 0)
+            tr_leg = tree_leg(f"tree K={tree_nodes // 2} N={tree_nodes}",
+                              tree_nodes // 2, tree_nodes)
+            spec_tree = {
+                "nodes": tree_nodes, "new_tokens": tree_new,
+                "draft_config": dcfg_t.name,
+                "linear": lin_leg, "tree": tr_leg,
+                "apd_ratio": (round(tr_leg["accepted_per_dispatch"]
+                                    / lin_leg["accepted_per_dispatch"], 3)
+                              if lin_leg["accepted_per_dispatch"]
+                              else None),
+                "served_ratio": (round(tr_leg["served_tok_s"]
+                                       / lin_leg["served_tok_s"], 3)
+                                 if lin_leg["served_tok_s"] else None),
+            }
+            log(f"spec tree: {spec_tree['apd_ratio']}x accepted/dispatch "
+                f"at equal verify budget ({tree_nodes} nodes), "
+                f"{spec_tree['served_ratio']}x served tok/s")
+        except Exception as e:      # noqa: BLE001 — record, don't abort
+            log(f"spec tree phase FAILED: {e}")
+            spec_tree = {"nodes": tree_nodes, "error": str(e)}
+
     # -- replica-router phase (BENCH_REPLICAS >= 2, Round-10): N full-
     # stack engines SHARING this bench's params (immutable device
     # arrays — no extra weight copies) behind serve/router.py, driven
@@ -1326,6 +1452,12 @@ def main() -> None:
             # equality between the parked and resident runs — the
             # multi-tier KV acceptance row.
             "park_wake": park_wake or None,
+            # Tree-speculation A/B (BENCH_SPEC_TREE): linear chain vs
+            # tree at the SAME verify node budget, with an imperfect
+            # drafter — accepted tokens per verify dispatch and served
+            # tok/s for each leg, plus tree/linear ratios. The Round-17
+            # acceptance numbers live here.
+            "spec_tree": spec_tree or None,
             # Long-window sweep (BENCH_LONG_W): per (window, impl) step
             # time vs the HBM bytes bound; flash rows carry their
             # speedup over the gather path — the round-8 acceptance

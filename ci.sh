@@ -142,6 +142,13 @@ if [ "${1:-}" = "full" ]; then
     tests/test_router.py tests/test_kv_tier.py tests/test_loadgen.py \
     tests/test_stress.py -q || rc=1
 
+  # Tree speculation (round 17): the WHOLE file including the
+  # slow-marked paged / paged+int8 bit-identity legs and the model-
+  # drafter fused-dispatch oracle. Excluded from the sweep below so
+  # each case executes exactly once.
+  echo "== tree speculation: full bit-identity matrix (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_spec_tree.py -q || rc=1
+
   # Quantization (round 16): the WHOLE file including the slow-marked
   # w4a16 interpret shape matrix (bench-relevant hidden sizes incl. the
   # hidden=1024 tile-table retune). Excluded from the sweep below so
@@ -151,6 +158,7 @@ if [ "${1:-}" = "full" ]; then
 
   echo "== full test suite"
   python -m pytest tests/ -q \
+    --ignore=tests/test_spec_tree.py \
     --ignore=tests/test_quant.py \
     --ignore=tests/test_flash_append_geometry.py \
     --ignore=tests/test_failpoints.py \
@@ -273,6 +281,17 @@ else
   JAX_PLATFORMS=cpu python -m pytest tests/test_loadgen.py \
     tests/test_devcrypto.py -q -x -m 'not slow' || rc=1
 
+  # Tree speculation (round 17, tier-1 legs): tree-mask ancestry units,
+  # the single-tree verify-vs-sequential-replay logits + rejected-
+  # branch KV-containment oracle, dense greedy bit-identity tree-on vs
+  # off, the NGram linear-degrade contract, one-drafter-dispatch-per-
+  # tick pin, and the equal-budget accepted-per-dispatch A/B. The
+  # paged / paged+int8 legs are slow-marked into full mode. Excluded
+  # from the sweep below so each case executes exactly once.
+  echo "== tree speculation: bit-identity + dispatch-budget pins (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_spec_tree.py -q -x \
+    -m 'not slow' || rc=1
+
   # Weight quantization (round 16, tier-1 legs): int8 + int4 pack/
   # round-trip bounds, Pallas kernel parity in interpret mode (both
   # precisions, stacked + unstacked), the autotune-table dispatch pins
@@ -286,6 +305,7 @@ else
 
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
+    --ignore=tests/test_spec_tree.py \
     --ignore=tests/test_quant.py \
     --ignore=tests/test_trace.py \
     --ignore=tests/test_loadgen.py \

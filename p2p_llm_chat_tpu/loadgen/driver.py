@@ -68,7 +68,7 @@ class TraceRecord:
     peer: int
     sched_s: float                   # scheduled fire offset
     lag_ms: float = 0.0              # scheduled fire -> worker pickup
-    status: str = "ok"               # ok | shed | error | truncated
+    status: str = "ok"               # ok | shed | error | truncated | empty
     ttft_ms: Optional[float] = None  # measured-step send -> first delta
     itl_ms: list = field(default_factory=list)   # inter-delta gaps
     tokens: int = 0
@@ -394,10 +394,16 @@ class LoadDriver:
             rec.status = "truncated"
             return False
         if step.measured and first is None:
-            # Completed stream with zero deltas — no first token ever
-            # arrived, so there is nothing to hold the TTFT SLO against.
-            rec.status = "error"
-            rec.error_kind = "stream"
+            # Completed stream with zero deltas: the server finished
+            # cleanly but emitted nothing (long_ctx near the context
+            # budget legitimately does this — max_tokens resolves to 0
+            # after the prompt fills the window). There is nothing to
+            # hold the TTFT SLO against, but it is NOT a wire failure
+            # either — its own status keeps it out of the
+            # error+truncated fraction and the chaos contract's strict
+            # zero-error gate (the old "error/stream" classification
+            # flaked exactly those runs).
+            rec.status = "empty"
             rec.error = "done without any delta"
             return False
         if step.phase and first is not None:

@@ -183,7 +183,7 @@ def _judge_scenario(name: str, recs: list, slo: SLO, duration_s: float,
                     timelines=None) -> dict:
     n = len(recs)
     by = {s: sum(1 for r in recs if r.status == s)
-          for s in ("ok", "shed", "error", "truncated")}
+          for s in ("ok", "shed", "error", "truncated", "empty")}
     ttfts = [r.slo_ttft_ms() for r in recs
              if r.status == "ok" and r.slo_ttft_ms() is not None]
     itls: list = []
@@ -275,6 +275,10 @@ def _judge_scenario(name: str, recs: list, slo: SLO, duration_s: float,
         "phases": phases,
         "n": n, "ok": by["ok"], "shed": by["shed"], "error": by["error"],
         "truncated": by["truncated"],
+        # Clean completions that streamed zero deltas (a near-budget
+        # long_ctx turn): counted on their own, NEVER in bad_frac —
+        # they are a workload property, not a wire failure.
+        "empty": by["empty"],
         "bad_kinds": bad_kinds,
         "ttft_p50_ms": round(p50, 1) if p50 is not None else None,
         "ttft_p95_ms": round(p95, 1) if p95 is not None else None,
@@ -316,6 +320,7 @@ def build_ledger(records: list, registry: dict, duration_s: float,
     ok = sum(1 for r in records if r.status == "ok")
     shed = sum(1 for r in records if r.status == "shed")
     bad = sum(1 for r in records if r.status in ("error", "truncated"))
+    empty = sum(1 for r in records if r.status == "empty")
     failures = [f"{name}: {v}" for name, s in sorted(per.items())
                 for v in s["violations"]]
     if contract is not None:
@@ -326,7 +331,7 @@ def build_ledger(records: list, registry: dict, duration_s: float,
         "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "duration_s": round(duration_s, 2),
         "arrivals": n,
-        "ok": ok, "shed": shed, "bad": bad,
+        "ok": ok, "shed": shed, "bad": bad, "empty": empty,
         "shed_frac": round(shed / n, 4) if n else None,
         "goodput_rps": round(sum(
             s["goodput_rps"] or 0.0 for s in per.values()), 3),

@@ -477,22 +477,29 @@ def hidden_states(params: dict, config: ModelConfig, tokens: jax.Array,
                   mesh: Optional[Mesh] = None,
                   rules: LogicalRules = DEFAULT_RULES,
                   kv_window: Optional[int] = None,
-                  mlp_fn=None, causal0: bool = False
+                  mlp_fn=None, causal0: bool = False,
+                  write_pos: Optional[jax.Array] = None
                   ) -> tuple[jax.Array, KVCache]:
     """embed -> scan(blocks) -> final norm. Returns (h [B,S,H], cache) —
     the shared trunk of :func:`forward`; also the embedding feature
-    extractor (:func:`embed_pooled` / the serve /api/embed path)."""
+    extractor (:func:`embed_pooled` / the serve /api/embed path).
+
+    ``write_pos`` ([B,S], default = ``positions``): cache slots this
+    step's k/v land in, decoupled from the RoPE positions — tree
+    speculation (:func:`verify_tree`) writes node j at slot lengths+j
+    while its RoPE position is lengths+depth(j)."""
     # Compute dtype follows the params' dtype (bf16 in production; the HF
     # parity tests load f32 weights and get f32 compute for tight tolerances).
     h = params["embed"][tokens]
     h = constrain(h, mesh, ("batch", None, "act_embed"), rules)
     inv_freq = rope_frequencies(config)
+    wp = positions if write_pos is None else write_pos
 
     def body(carry, layer):
         h, ck, cv = carry
         lp = _layer_view(params["layers"], layer)
         h, ck, cv = _block(h, lp, config, inv_freq, positions, ck, cv,
-                           layer, positions, mask, mesh, rules, kv_window,
+                           layer, wp, mask, mesh, rules, kv_window,
                            mlp_fn, causal0)
         return (h, ck, cv), None
 
@@ -509,6 +516,7 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
             kv_window: Optional[int] = None,
             mlp_fn=None, causal0: bool = False,
             last_idx: Optional[jax.Array] = None,
+            write_pos: Optional[jax.Array] = None,
             ) -> tuple[jax.Array, KVCache]:
     """Shared forward: embed -> scan(blocks) -> norm -> logits.
 
@@ -526,7 +534,8 @@ def forward(params: dict, config: ModelConfig, tokens: jax.Array,
     serving on a 16 GB chip.
     """
     h, cache = hidden_states(params, config, tokens, positions, cache, mask,
-                             mesh, rules, kv_window, mlp_fn, causal0)
+                             mesh, rules, kv_window, mlp_fn, causal0,
+                             write_pos=write_pos)
     if last_idx is not None:
         h = jnp.take_along_axis(h, last_idx[:, None, None].astype(jnp.int32),
                                 axis=1)                     # [B,1,H]
@@ -773,6 +782,63 @@ def verify_step(params: dict, config: ModelConfig, tokens: jax.Array,
                    last_idx=last_idx)
 
 
+def tree_attention_mask(lengths: jax.Array, anc: jax.Array,
+                        window: int) -> jax.Array:
+    """Tree-topology attention mask for :func:`verify_tree`.
+
+    lengths: [B] committed context lengths; anc: [B,N,N] bool — anc[b,i,j]
+    iff tree node j is on node i's root path (self included). Node i
+    occupies cache slot ``lengths[b]+i``, so its query may see every
+    committed slot (< lengths[b]) plus exactly the node slots on its own
+    ancestor path — siblings and other branches stay invisible, which is
+    what makes one batched forward score every root path as if each were
+    verified alone. Returns [B,1,N,W] (True = attend).
+    """
+    B, N = anc.shape[:2]
+    cols = jnp.arange(window)[None, :]                       # [1,W]
+    committed = cols < lengths[:, None]                      # [B,W]
+    jr = cols - lengths[:, None]                             # [B,W]
+    node_col = (jr >= 0) & (jr < N)
+    anc_w = jnp.take_along_axis(anc, jnp.clip(jr, 0, N - 1)[:, None, :],
+                                axis=2)                      # [B,N,W]
+    mask = committed[:, None, :] | (node_col[:, None, :] & anc_w)
+    return mask[:, None]                                     # [B,1,N,W]
+
+
+def verify_tree(params: dict, config: ModelConfig, tokens: jax.Array,
+                depths: jax.Array, anc: jax.Array, cache: KVCache,
+                mesh: Optional[Mesh] = None,
+                rules: LogicalRules = DEFAULT_RULES,
+                kv_window: Optional[int] = None,
+                mlp_fn=None) -> tuple[jax.Array, KVCache]:
+    """Tree-speculation verify: score N tree nodes per row in ONE forward
+    (:func:`verify_step` generalised from a chain to a tree).
+
+    tokens: [B,N] — node 0 is the root (current token), nodes 1..K the
+    main draft chain, the rest sibling leaves; depths: [B,N] node depth
+    (root = 0); anc: [B,N,N] ancestor matrix (see
+    :func:`tree_attention_mask`). Node i writes cache slot ``lengths+i``
+    (slots stay node-indexed, so rejected branches are stale-beyond-
+    length exactly like rejected linear drafts) while its RoPE position
+    is ``lengths+depths[i]`` — the position in the hypothetical stream
+    its root path spells out. Lengths are NOT advanced; the caller runs
+    models/sampling.spec_verify_tree on the logits, compacts a used
+    sibling's kv onto the accepted path, and advances by accepted+1.
+
+    Returns (logits [B,N,vocab] f32 — logits[:, i] is the distribution
+    AFTER node i along its root path — and the cache with the N node
+    slots written, lengths unchanged).
+    """
+    B, N = tokens.shape
+    positions = cache.lengths[:, None] + depths              # RoPE [B,N]
+    write_pos = cache.lengths[:, None] + jnp.arange(N)[None, :]
+    window = kv_window if kv_window is not None else cache.k.shape[2]
+    mask = tree_attention_mask(cache.lengths, anc, window)
+    return forward(params, config, tokens, positions, cache, mask,
+                   mesh, rules, kv_window=kv_window, mlp_fn=mlp_fn,
+                   write_pos=write_pos)
+
+
 # -- paged decode (Pallas kernel path) ----------------------------------------
 
 def _constrain_pool(cache, mesh: Optional[Mesh],
@@ -893,6 +959,53 @@ def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
         jnp.arange(config.num_layers))
     return finish(h), cache._replace(k=new_k, v=new_v, k_scale=new_sk,
                                      v_scale=new_sv)
+
+
+def verify_tree_paged(params: dict, config: ModelConfig, tokens: jax.Array,
+                      depths: jax.Array, anc: jax.Array, cache,
+                      mesh: Optional[Mesh] = None,
+                      rules: LogicalRules = DEFAULT_RULES,
+                      *, pages: int, mlp_fn=None):
+    """:func:`verify_tree` on a PagedKVCache.
+
+    Always rides verify_step_paged's gather path (regardless of the
+    decode impl): every node's query attends the committed pool window
+    (ops/paged_attention._gather_window_scores — ``pos < lengths`` is
+    already branch-agnostic) plus the in-register block k/v filtered by
+    the ancestor matrix ``anc`` instead of the chain-causal triangle.
+    RoPE positions are ``lengths+depths``; ONE batched scatter lands
+    node i at pool position ``lengths+i`` afterwards
+    (write_decode_multi_all_layers — node-indexed slots, beyond-
+    allocation writes land in garbage page 0, so rejected-branch
+    containment is inherent, int8 scales included).
+    """
+    from ..ops.paged_attention import paged_attention_verify_append
+    from ..ops.paged_kv import write_decode_multi_all_layers
+
+    cache = _constrain_pool(cache, mesh, rules)
+    positions = cache.lengths[:, None] + depths              # RoPE [B,N]
+    h = params["embed"][tokens]
+    h = constrain(h, mesh, ("batch", None, "act_embed"), rules)
+    inv_freq = rope_frequencies(config)
+
+    def body(h, layer):
+        lp = _layer_view(params["layers"], layer)
+        q, k, v = _attn_qkv(h, lp, config, inv_freq, positions, mesh,
+                            rules)
+        attn = paged_attention_verify_append(
+            q, k, v, cache, cache.lengths, layer, pages=pages,
+            block_mask=anc)
+        h = _post_attn(h, attn, lp, config, mesh, rules, mlp_fn)
+        return h, (k, v)
+
+    h, (k_all, v_all) = jax.lax.scan(body, h, jnp.arange(config.num_layers))
+    cache = write_decode_multi_all_layers(cache, k_all, v_all)
+    h = rms_norm(h, params["final_norm"], config.rms_norm_eps)
+    lm_head = (params["embed"].T if config.tie_embeddings
+               else params["lm_head"])
+    logits = mm(h, lm_head).astype(jnp.float32)
+    return constrain(logits, mesh, ("batch", None, "act_vocab"),
+                     rules), cache
 
 
 def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,

@@ -387,6 +387,19 @@ def verify_step(params: dict, config: ModelConfig, tokens: jax.Array,
                              last_idx=last_idx)
 
 
+def verify_tree(params: dict, config: ModelConfig, tokens: jax.Array,
+                depths: jax.Array, anc: jax.Array, cache: KVCache,
+                mesh: Optional[Mesh] = None,
+                rules: LogicalRules = DEFAULT_RULES,
+                kv_window: Optional[int] = None
+                ) -> tuple[jax.Array, KVCache]:
+    """llama.verify_tree with the MoE MLP (tree-speculation verify; the
+    node count is tiny, so the expert bucket stays exact)."""
+    return llama.verify_tree(params, config, tokens, depths, anc, cache,
+                             mesh, rules, kv_window,
+                             mlp_fn=_mlp_fn(config, None))
+
+
 def decode_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
                       cache, mesh: Optional[Mesh] = None,
                       rules: LogicalRules = DEFAULT_RULES,
@@ -414,6 +427,16 @@ def verify_step_paged(params: dict, config: ModelConfig, tokens: jax.Array,
                                    rules, pages=pages, interpret=interpret,
                                    mlp_fn=_mlp_fn(config, None),
                                    last_idx=last_idx)
+
+
+def verify_tree_paged(params: dict, config: ModelConfig, tokens: jax.Array,
+                      depths: jax.Array, anc: jax.Array, cache,
+                      mesh: Optional[Mesh] = None,
+                      rules: LogicalRules = DEFAULT_RULES, *, pages: int):
+    """llama.verify_tree_paged with the MoE MLP."""
+    return llama.verify_tree_paged(params, config, tokens, depths, anc,
+                                   cache, mesh, rules, pages=pages,
+                                   mlp_fn=_mlp_fn(config, None))
 
 
 def embed_pooled(params: dict, config: ModelConfig, tokens: jax.Array,

@@ -307,6 +307,165 @@ def spec_verify_batched(logits: jax.Array, drafts: jax.Array,
     return accepted.astype(jnp.int32), correction, new_keys
 
 
+def spec_verify_tree(logits: jax.Array, drafts: jax.Array,
+                     sib_tok: jax.Array, sib_node: jax.Array,
+                     keys: jax.Array, temperature: jax.Array,
+                     top_k: jax.Array, top_p: jax.Array,
+                     max_accept: jax.Array,
+                     top_c: int = 64, ring: Optional[jax.Array] = None,
+                     rp: Optional[jax.Array] = None,
+                     ctx_len: Optional[jax.Array] = None
+                     ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Tree-speculation acceptance: linear main chain + top-2 sibling
+    LEAVES, one verify dispatch (serve/scheduler.py tree spec tick).
+
+    logits: [B,N,V] f32 from models.llama.verify_tree — node 0 is the
+    root (current token), nodes 1..K the main greedy draft chain, nodes
+    K+1..N-1 sibling leaves; node j's row is the model's distribution
+    AFTER consuming node j's token along its ancestor path. drafts:
+    [B,K] main-chain tokens (inputs at nodes 1..K). sib_tok/sib_node:
+    [B,K] — the drafter's second-choice token for main position j and
+    the tree node index it occupies (-1 = no sibling budgeted there).
+
+    Main-chain acceptance is EXACTLY :func:`spec_verify_batched` over
+    logits[:, :K+1]. At the first probabilistic rejection a0, the
+    sibling at that position (if any) gets one more exact multi-round
+    test: greedy rows accept it iff it IS the argmax (in which case the
+    correction comes from the sibling node's own distribution — bit-
+    identical to what the next sequential tick would emit); sampled
+    rows accept it with the exact residual probability
+    p(sib)/(1 - p(draft)) (point-mass proposals, sib != draft by top-2
+    distinctness), and on acceptance the correction samples from the
+    sibling node's own warped distribution unmodified. If the sibling
+    also rejects, the correction resamples from position a0 with BOTH
+    the draft and the sibling removed and renormalised — still the
+    exact residual. Repeat-penalty counts follow the accepted path
+    (context + drafts[:a0] [+ sib]), reusing the linear eviction/draft
+    prefix algebra.
+
+    NOTE: the carried key stream differs from :func:`spec_verify_batched`
+    (one extra sibling-uniform split), so sampled streams tree-on vs
+    tree-off are differently-random but identically-distributed; greedy
+    streams are bit-identical.
+
+    Returns (accepted [B] int32 — total accepted tokens INCLUDING a
+    used sibling —, used_sib [B] int32 0/1, correction [B] int32,
+    advanced keys [B,2]).
+    """
+    B, N, V = logits.shape
+    K = drafts.shape[1]
+    main = logits[:, :K + 1]
+    ev_pref = dr_pref = None
+    if ring is not None:
+        # Identical sliding-window algebra to spec_verify_batched over
+        # the main chain; ev_pref/dr_pref are kept for the sibling leg.
+        Rw = ring.shape[1]
+        in_cnt = jnp.zeros((B, V), jnp.float32).at[
+            jnp.arange(B)[:, None], ring].add(1.0, mode="drop")
+        cnt = jnp.broadcast_to(in_cnt[:, None], (B, K + 1, V))
+        shifts = jnp.arange(1, K + 1)[None, :]
+        ev_slots = (ctx_len[:, None] + shifts) % Rw
+        ev = jnp.take_along_axis(ring, ev_slots, axis=1)
+        zero = jnp.zeros((B, 1, V), jnp.float32)
+        ev_pref = jnp.concatenate(
+            [zero, jnp.cumsum(jax.nn.one_hot(ev, V, dtype=jnp.float32),
+                              1)], 1)
+        dr_pref = jnp.concatenate(
+            [zero, jnp.cumsum(jax.nn.one_hot(drafts, V,
+                                             dtype=jnp.float32), 1)], 1)
+        cnt = cnt - ev_pref + dr_pref
+        member = cnt > 0.5
+        rp_b = rp[:, None, None]
+        pen = jnp.where(main > 0, main / rp_b, main * rp_b)
+        main = jnp.where(member, pen, main)
+    C = min(top_c, V)
+    flat = main.reshape(B * (K + 1), V)
+    sorted_logits, order = jax.lax.top_k(flat, C)
+    sorted_logits = sorted_logits.reshape(B, K + 1, C)
+    order = order.reshape(B, K + 1, C)
+    wprobs = _warp(sorted_logits, temperature, top_k, top_p)
+
+    # carried key + correction key + sibling-uniform key + K acceptance
+    # uniforms (one extra split vs the linear path).
+    split = jax.vmap(lambda k: jax.random.split(k, K + 3))(keys)
+    new_keys, corr_key, sib_key = split[:, 0], split[:, 1], split[:, 2]
+    subs = split[:, 3:]
+
+    greedy_row = (temperature <= 0.0)[:, None]
+    argmax_tok = jnp.argmax(main, axis=-1).astype(jnp.int32)      # [B,K+1]
+
+    dmatch = order[:, :K] == drafts[:, :, None]
+    p_draft = jnp.sum(jnp.where(dmatch, wprobs[:, :K], 0.0), -1)  # [B,K]
+    u = jax.vmap(jax.vmap(jax.random.uniform))(subs)
+    ok = jnp.where(greedy_row, drafts == argmax_tok[:, :K], u < p_draft)
+    ok &= jnp.arange(K)[None, :] < max_accept[:, None]
+    a0 = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+
+    j = a0[:, None, None]
+    probs_j = jnp.take_along_axis(wprobs, j, axis=1)[:, 0]        # [B,C]
+    order_j = jnp.take_along_axis(order, j, axis=1)[:, 0]         # [B,C]
+    prob_rejected = a0 < jnp.minimum(max_accept, K)
+    m = jnp.minimum(a0, K - 1)[:, None]
+    dr = jnp.take_along_axis(drafts, m, axis=1)[:, 0]
+    st = jnp.take_along_axis(sib_tok, m, axis=1)[:, 0]
+    sn = jnp.take_along_axis(sib_node, m, axis=1)[:, 0]
+    has_sib = prob_rejected & (sn >= 0)
+
+    # Sibling test — exact residual round. Greedy: the sibling is usable
+    # iff it IS the penalised argmax at the rejected position (then the
+    # emitted token equals what linear's correction would have been, and
+    # we gain its follow-up from the sibling node's own logits).
+    g_tok = jnp.take_along_axis(argmax_tok, a0[:, None], -1)[:, 0]
+    p_rej = jnp.take_along_axis(p_draft, m, axis=1)[:, 0]
+    p_sib = jnp.sum(jnp.where(order_j == st[:, None], probs_j, 0.0), -1)
+    ratio = jnp.minimum(p_sib / jnp.maximum(1.0 - p_rej, 1e-20), 1.0)
+    u_sib = jax.vmap(jax.random.uniform)(sib_key)
+    sib_ok = jnp.where(greedy_row[:, 0], st == g_tok, u_sib < ratio)
+    used_sib = has_sib & sib_ok
+
+    # Sibling node's own distribution (the correction after accepting
+    # the sibling): penalty counts for the path context + drafts[:a0] +
+    # [sib] reuse the main chain's eviction/draft prefixes (a0+1 <= K
+    # whenever has_sib, so the gathers stay in range).
+    sib_logits = jnp.take_along_axis(
+        logits, jnp.clip(sn, 0, N - 1)[:, None, None], axis=1)[:, 0]
+    if ring is not None:
+        a1 = jnp.minimum(a0 + 1, K)[:, None, None]
+        ev_s = jnp.take_along_axis(ev_pref, a1, axis=1)[:, 0]     # [B,V]
+        dr_s = jnp.take_along_axis(dr_pref, a0[:, None, None],
+                                   axis=1)[:, 0]
+        cnt_s = (in_cnt - ev_s + dr_s
+                 + jax.nn.one_hot(st, V, dtype=jnp.float32))
+        rp_c = rp[:, None]
+        pen_s = jnp.where(sib_logits > 0, sib_logits / rp_c,
+                          sib_logits * rp_c)
+        sib_logits = jnp.where(cnt_s > 0.5, pen_s, sib_logits)
+    sorted_sib, order_sib = jax.lax.top_k(sib_logits, C)
+    wprobs_sib = _warp(sorted_sib, temperature, top_k, top_p)
+
+    # Correction. Not-used-sib: linear residual at a0 with the draft
+    # removed (when probabilistically rejected) and the sibling ALSO
+    # removed when it was tested and failed. Used-sib: the sibling
+    # node's warped distribution, unmodified (nothing was tested there).
+    drop = ((order_j == dr[:, None]) & prob_rejected[:, None]
+            | (order_j == st[:, None]) & (has_sib & ~sib_ok)[:, None])
+    resid = jnp.where(drop, 0.0, probs_j)
+    probs_f = jnp.where(used_sib[:, None], wprobs_sib, resid)
+    order_f = jnp.where(used_sib[:, None], order_sib, order_j)
+    probs_f = probs_f / jnp.maximum(probs_f.sum(-1, keepdims=True), 1e-20)
+    choice = jax.vmap(jax.random.categorical)(
+        corr_key, jnp.where(probs_f > 0, jnp.log(probs_f), NEG_INF))
+    sampled = jnp.take_along_axis(order_f, choice[:, None], -1)[:, 0]
+    g_corr = jnp.where(used_sib,
+                       jnp.argmax(sib_logits, axis=-1).astype(jnp.int32),
+                       g_tok)
+    correction = jnp.where(greedy_row[:, 0], g_corr,
+                           sampled).astype(jnp.int32)
+    accepted = a0 + used_sib.astype(jnp.int32)
+    return (accepted.astype(jnp.int32), used_sib.astype(jnp.int32),
+            correction, new_keys)
+
+
 def sample_np(logits: np.ndarray, rng: np.random.Generator,
               temperature: float = 0.0, top_k: int = 0,
               top_p: float = 1.0, recent=None,

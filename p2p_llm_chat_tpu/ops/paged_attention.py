@@ -632,7 +632,7 @@ def _flash_kernel(pt_ref, len_ref, layer_ref, q_ref, k_hbm, v_hbm, o_ref,
 
 
 def paged_attention_verify_append(q_blk, k_blk, v_blk, cache, lengths,
-                                  layer, *, pages: int):
+                                  layer, *, pages: int, block_mask=None):
     """Speculative-verify attention where the candidate block's k/v is
     NOT yet in the pool: position j attends the pool window (positions
     < ``lengths``, identical mask for every j) plus block positions
@@ -651,7 +651,12 @@ def paged_attention_verify_append(q_blk, k_blk, v_blk, cache, lengths,
     generalisation of :func:`paged_attention_append`.
 
     q_blk: [B, S, Hq, D]; k_blk/v_blk: [B, S, Hkv, D]; lengths: pool
-    positions per row (excluding the block). Returns [B, S, Hq, D].
+    positions per row (excluding the block). ``block_mask`` ([B,S,S]
+    bool, True = attend, self-diagonal included) replaces the chain-
+    causal triangle over the in-register block — tree speculation
+    (models/llama.verify_tree_paged) passes its ancestor matrix so each
+    node sees only its own root path; the pool-window mask is branch-
+    agnostic either way. Returns [B, S, Hq, D].
     """
     B, S, Hq, D = q_blk.shape
     Hkv = k_blk.shape[2]
@@ -664,8 +669,11 @@ def paged_attention_verify_append(q_blk, k_blk, v_blk, cache, lengths,
     scores_b = jnp.einsum("bsgrd,btgd->bgrst", qg.astype(jnp.float32),
                           k_blk.astype(jnp.float32))     # [B,G,rep,S,S]
     scores_b = scores_b / jnp.sqrt(D).astype(jnp.float32)
-    causal = (jnp.arange(S)[None, :] <= jnp.arange(S)[:, None])
-    scores_b = jnp.where(causal[None, None, None], scores_b, NEG_INF)
+    if block_mask is None:
+        causal = (jnp.arange(S)[None, :] <= jnp.arange(S)[:, None])
+        scores_b = jnp.where(causal[None, None, None], scores_b, NEG_INF)
+    else:
+        scores_b = jnp.where(block_mask[:, None, None], scores_b, NEG_INF)
 
     scores = jnp.concatenate([scores_w, scores_b], axis=-1)  # [.., W+S]
     probs = jax.nn.softmax(scores, axis=-1)

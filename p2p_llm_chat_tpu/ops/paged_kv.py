@@ -568,6 +568,49 @@ def write_decode_multi(cache: PagedKVCache, layer: jax.Array, k: jax.Array,
                            upd, mode="drop"))
 
 
+def copy_slot(cache: PagedKVCache, src_pos: jax.Array,
+              dst_pos: jax.Array) -> PagedKVCache:
+    """Move ONE kv slot per row (all layers) from absolute position
+    ``src_pos[b]`` to ``dst_pos[b]`` — the tree-speculation sibling
+    compaction (serve/scheduler.py tree spec tick): an accepted sibling
+    leaf's kv, written at its node slot, is copied onto the accepted-
+    path slot before lengths advance over it. Raw pool words move
+    (int8 values + their head-major scales together), so the copy is
+    exact — never a requantize. Rows with ``src_pos == dst_pos``
+    self-copy harmlessly; positions past a row's table width route to
+    garbage page 0 both ways (same containment as
+    :func:`_multi_write_indices`).
+    """
+    ps = cache.page_size
+
+    def indices(pos):                                  # [B] -> (phys, slot)
+        logical = pos // ps
+        safe = jnp.minimum(logical, cache.max_pages_per_row - 1)
+        phys = jnp.take_along_axis(cache.page_table, safe[:, None],
+                                   axis=1)[:, 0]
+        phys = jnp.where(logical < cache.max_pages_per_row, phys, 0)
+        return phys.astype(jnp.int32), (pos % ps).astype(jnp.int32)
+
+    sp, so = indices(src_pos)
+    dp, do = indices(dst_pos)
+    out = cache._replace(
+        k=cache.k.at[:, dp, do].set(cache.k[:, sp, so]),
+        v=cache.v.at[:, dp, do].set(cache.v[:, sp, so]))
+    if cache.quantized:
+        # Head-major scales [L,N,Hkv,ps_pad]: the batch indices sit on
+        # non-adjacent dims, so index every axis explicitly to keep the
+        # gather/scatter in [L,B,Hkv] array order.
+        L, _, Hkv, _ = cache.k_scale.shape
+        li = jnp.arange(L)[:, None, None]
+        hi = jnp.arange(Hkv)[None, None, :]
+        src_ix = (li, sp[None, :, None], hi, so[None, :, None])
+        dst_ix = (li, dp[None, :, None], hi, do[None, :, None])
+        out = out._replace(
+            k_scale=cache.k_scale.at[dst_ix].set(cache.k_scale[src_ix]),
+            v_scale=cache.v_scale.at[dst_ix].set(cache.v_scale[src_ix]))
+    return out
+
+
 # -- page-set extract / inject (KV tiering, serve/kv_tier.py) -----------------
 
 def gather_pages(cache: PagedKVCache, pages: jax.Array) -> tuple:

@@ -140,6 +140,11 @@ class ModelDrafter(DraftSource):
         self._await_obs: set[int] = set()
         self._feed_programs: dict[tuple[int, int], object] = {}
         self._draft_programs: dict[tuple[int, int], object] = {}
+        # Dispatch counters (scheduler thread only): draft-program and
+        # catch-up-feed launches. tests/test_spec_tree.py pins one draft
+        # launch per spec tick through these.
+        self.n_draft_dispatches = 0
+        self.n_feed_dispatches = 0
 
     # -- memory accounting ----------------------------------------------------
 
@@ -181,8 +186,12 @@ class ModelDrafter(DraftSource):
         argmax, then K-1 more greedy steps through the existing
         decode_fused scan (argmax sample_fn, no stop parking — the
         TARGET's verify decides what an EOS draft means). Returns the
-        [B, K] proposals; rejected drafts' KV is rolled back by the next
-        dispatch's host-supplied lengths."""
+        [B, K] proposals plus the per-position runner-up token and
+        top-1/top-2 logit gap ([B, K] each — the tree-speculation branch
+        signal, captured in the SAME dispatch via a top-2 in the scan's
+        sample state; the draft tokens themselves stay the plain argmax,
+        so the linear path is bit-unchanged). Rejected drafts' KV is
+        rolled back by the next dispatch's host-supplied lengths."""
         prog = self._draft_programs.get((M, W))
         if prog is None:
             model, config, mesh = self._model, self.config, self.mesh
@@ -190,6 +199,7 @@ class ModelDrafter(DraftSource):
             stop_ids = np.zeros((0,), np.int32)
 
             def _draft(params, tokens, pend, lengths, cache):
+                B = tokens.shape[0]
                 cache = cache._replace(lengths=lengths)
                 logits, cache = model.verify_step(params, config, tokens,
                                                   cache, mesh, kv_window=W)
@@ -197,20 +207,31 @@ class ModelDrafter(DraftSource):
                     logits, jnp.clip(pend - 1, 0, M - 1)[:, None, None],
                     axis=1)[:, 0]                                  # [B,V]
                 cache = cache._replace(lengths=cache.lengths + pend)
-                d1 = jnp.argmax(last, axis=-1).astype(jnp.int32)   # [B]
+                v2, i2 = jax.lax.top_k(last, 2)
+                d1 = i2[:, 0].astype(jnp.int32)                    # argmax
+                sec = jnp.zeros((B, K), jnp.int32).at[:, 0].set(
+                    i2[:, 1].astype(jnp.int32))
+                gap = jnp.full((B, K), jnp.inf, jnp.float32).at[:, 0].set(
+                    (v2[:, 0] - v2[:, 1]).astype(jnp.float32))
                 if K == 1:
-                    return d1[:, None], cache
+                    return d1[:, None], sec, gap, cache
                 act = pend > 0
 
                 def sample_fn(lg, state, emit_pos, a):
-                    return jnp.argmax(lg, axis=-1).astype(jnp.int32), state
+                    s, g, i = state
+                    v2s, i2s = jax.lax.top_k(lg, 2)
+                    s = s.at[:, i].set(i2s[:, 1].astype(jnp.int32))
+                    g = g.at[:, i].set(
+                        (v2s[:, 0] - v2s[:, 1]).astype(jnp.float32))
+                    return (i2s[:, 0].astype(jnp.int32), (s, g, i + 1))
 
-                toks_all, _, _, cache, _, _ = model.decode_fused(
+                toks_all, _, _, cache, _, (sec, gap, _) = model.decode_fused(
                     params, config, d1[:, None], cache, mesh, active=act,
-                    num_steps=K - 1, sample_fn=sample_fn, sample_state=(),
+                    num_steps=K - 1, sample_fn=sample_fn,
+                    sample_state=(sec, gap, jnp.int32(1)),
                     stop_ids=stop_ids, kv_window=W)
                 drafts = jnp.concatenate([d1[:, None], toks_all.T], axis=1)
-                return drafts, cache
+                return drafts, sec, gap, cache
 
             prog = jax.jit(_draft, donate_argnums=(4,))
             self._draft_programs[(M, W)] = prog
@@ -243,6 +264,7 @@ class ModelDrafter(DraftSource):
         need = max(self._fed[r] + len(pend_toks[r]) for r in rows) + 1
         W = self._window(need)
         tokens, pend, lengths = self._host_arrays(rows, pend_toks, M)
+        self.n_feed_dispatches += 1
         self._cache = self._feed_for(M, W)(
             self._params, tokens, pend, lengths, self._cache)
         for row in rows:
@@ -293,12 +315,14 @@ class ModelDrafter(DraftSource):
         self._fed[row] = 0
         self._await_obs.discard(row)
 
-    def draft_batch(self, rows: list[int],
-                    ctxs: dict[int, tuple]) -> dict[int, list[int]]:
-        """Propose K greedy tokens for each requested row: catch up the
-        pending context suffix, then one combined feed+draft dispatch.
-        Costs one device dispatch + a [B, K] int32 readback — the price
-        the verify's accepted tokens must amortise (the scheduler's
+    def _dispatch_draft(self, rows: list[int], ctxs: dict[int, tuple]
+                        ) -> tuple[list[int], np.ndarray, np.ndarray,
+                                   np.ndarray]:
+        """Shared draft-dispatch core: catch up the pending context
+        suffix, then ONE combined feed+draft launch. Returns the rows
+        actually drafted and the [B,K] (drafts, second, gap) arrays.
+        Costs one device dispatch + the readback — the price the
+        verify's accepted tokens must amortise (the scheduler's
         per-source EMA throttle turns this off when they don't)."""
         # Rows whose context + drafts would overrun the drafter budget
         # stop model-drafting (they are about to finish anyway; n-gram
@@ -307,7 +331,7 @@ class ModelDrafter(DraftSource):
                 if _ctx_len(ctxs[r]) + self.k + 1 <= self.max_seq
                 and _ctx_len(ctxs[r]) > self._fed[r]]
         if not rows:
-            return {}
+            return [], np.zeros(0), np.zeros(0), np.zeros(0)
         self._catch_up_oversize(rows, ctxs)
         pend_toks = {r: _ctx_suffix(ctxs[r], self._fed[r]) for r in rows}
         M = _pow2(max(len(t) for t in pend_toks.values()), _MIN_FEED,
@@ -315,14 +339,36 @@ class ModelDrafter(DraftSource):
         need = max(self._fed[r] + len(pend_toks[r]) for r in rows) + self.k
         W = self._window(need)
         tokens, pend, lengths = self._host_arrays(rows, pend_toks, M)
-        drafts_dev, self._cache = self._draft_for(M, W)(
+        self.n_draft_dispatches += 1
+        drafts_dev, sec_dev, gap_dev, self._cache = self._draft_for(M, W)(
             self._params, tokens, pend, lengths, self._cache)
         # graftcheck: sync-ok intentional: [B,K] int32 draft readback, the spec tick consumes it
         drafts = np.asarray(drafts_dev)
+        sec = np.asarray(sec_dev)
+        gap = np.asarray(gap_dev)
         for row in rows:
             self._fed[row] += len(pend_toks[row])
             self._await_obs.add(row)
+        return rows, drafts, sec, gap
+
+    def draft_batch(self, rows: list[int],
+                    ctxs: dict[int, tuple]) -> dict[int, list[int]]:
+        """Propose K greedy tokens for each requested row (linear spec):
+        one combined feed+draft dispatch, runner-up capture ignored."""
+        rows, drafts, _, _ = self._dispatch_draft(rows, ctxs)
         return {row: [int(t) for t in drafts[row]] for row in rows}
+
+    def draft_tree_batch(self, rows: list[int], ctxs: dict[int, tuple]
+                         ) -> dict[int, tuple[list[int], list[int],
+                                              list[float]]]:
+        """Tree proposals from the SAME single dispatch as
+        :meth:`draft_batch`: the main chain is the identical greedy
+        argmax path, and each position's runner-up token + top-1/top-2
+        logit gap ride along as the scheduler's branch-site signal."""
+        rows, drafts, sec, gap = self._dispatch_draft(rows, ctxs)
+        return {row: ([int(t) for t in drafts[row]],
+                      [int(t) for t in sec[row]],
+                      [float(g) for g in gap[row]]) for row in rows}
 
     def observe(self, row: int, accepted: int) -> None:
         """Verify outcome: accepted drafts became context — their KV
@@ -371,7 +417,7 @@ class ModelDrafter(DraftSource):
         pend = jnp.zeros((self.num_slots,), jnp.int32)
         lengths = jnp.asarray(np.asarray(self._fed, np.int32))
         if draft:
-            _, self._cache = self._draft_for(M, W)(
+            _, _, _, self._cache = self._draft_for(M, W)(
                 self._params, tokens, pend, lengths, self._cache)
         else:
             self._cache = self._feed_for(M, W)(

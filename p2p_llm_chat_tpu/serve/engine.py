@@ -100,7 +100,9 @@ class TPUEngine:
                  queue_max: Optional[int] = None,
                  draft: Optional[tuple] = None,
                  kv_host_gb: float = 0.0,
-                 kv_idle_s: float = 30.0) -> None:
+                 kv_idle_s: float = 30.0,
+                 spec_tree_nodes: int = 0,
+                 spec_tree_gap: float = 4.0) -> None:
         """``draft``: optional ``(params, config)`` of a small draft
         model made resident alongside this engine's target for
         speculative decoding (SERVE_DRAFT; serve/draft_model.py). Needs
@@ -165,7 +167,9 @@ class TPUEngine:
                                         queue_max=queue_max,
                                         drafter=drafter,
                                         kv_host_gb=kv_host_gb,
-                                        kv_idle_s=kv_idle_s)
+                                        kv_idle_s=kv_idle_s,
+                                        spec_tree_nodes=spec_tree_nodes,
+                                        spec_tree_gap=spec_tree_gap)
 
     def generate_stream(self, req: GenerateRequest,
                         stats: Optional[RequestStats] = None) -> Iterator[str]:
@@ -405,6 +409,13 @@ def build_engine_from_env() -> Backend:
         log.warning("SERVE_DRAFT set but SERVE_SPEC=0 — no speculative "
                     "ticks will run; set SERVE_SPEC (e.g. 4) to enable "
                     "the drafter")
+    # Tree speculation (round 17): widen the verify window from K+1 to
+    # this many node positions (pow2-snapped; needs >= spec_k+2 for a
+    # sibling slot, else the scheduler degrades to linear spec). Only
+    # engages when SERVE_SPEC > 0. SERVE_SPEC_TREE_GAP is the top-1/
+    # top-2 drafter logit gap below which a position gets a sibling.
+    spec_tree_nodes = env_int("SERVE_SPEC_TREE_NODES", 8) if spec_k else 0
+    spec_tree_gap = env_float("SERVE_SPEC_TREE_GAP", 4.0)
     # Fused multi-step decode: up to this many decode steps per device
     # dispatch (adaptive — see scheduler.decode_fuse_max). 1 disables.
     decode_fuse_max = max(1, env_int("SERVE_FUSE", 4))
@@ -527,7 +538,9 @@ def build_engine_from_env() -> Backend:
                          prefill_chunk=prefill_chunk,
                          queue_max=queue_max,
                          draft=load_draft_for(config),
-                         kv_host_gb=kv_host_gb, kv_idle_s=kv_idle_s)
+                         kv_host_gb=kv_host_gb, kv_idle_s=kv_idle_s,
+                         spec_tree_nodes=spec_tree_nodes,
+                         spec_tree_gap=spec_tree_gap)
 
     def warmup_buckets():
         warmup = env_or("SERVE_WARMUP", "128,256")

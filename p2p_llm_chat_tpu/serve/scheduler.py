@@ -294,7 +294,9 @@ class BatchScheduler:
                  loop_budget_ms: Optional[float] = None,
                  drafter: Optional[object] = None,
                  kv_host_gb: float = 0.0,
-                 kv_idle_s: float = 30.0) -> None:
+                 kv_idle_s: float = 30.0,
+                 spec_tree_nodes: int = 0,
+                 spec_tree_gap: float = 4.0) -> None:
         """``admit_chunk``: burst-admission width. None (default) admits a
         backlog burst through one full-width prefill (minimal dispatches —
         best p95/throughput); a fixed power-of-two (e.g. 8) staggers the
@@ -342,6 +344,22 @@ class BatchScheduler:
         the target (same batch geometry, same vocabulary — validated
         here). None = n-gram-only speculation (the pre-round-9
         behavior). Requires ``spec_k`` > 0 to have any effect.
+
+        ``spec_tree_nodes``: tree speculation (round 17). > 0 turns the
+        spec tick's verify into a TREE of that many nodes (pow2-snapped
+        up): node 0 the current token, nodes 1..spec_k the main draft
+        chain, the rest top-2 sibling leaves placed at the drafter's
+        least-certain main positions (top-1/top-2 logit gap below
+        ``spec_tree_gap``). One batched verify scores every root path
+        via a tree-topology attention mask
+        (models/llama.verify_tree[_paged]); acceptance stays
+        distribution-exact (models/sampling.spec_verify_tree), and
+        greedy output is BIT-identical tree on/off. Needs spec_k >= 1
+        and at least one sibling slot (nodes >= spec_k + 2) —
+        otherwise it normalizes to 0 and the linear program runs.
+        Sources without runner-up scores (n-gram) degrade to a linear
+        chain through the tree program (utils/draft.DraftSource.
+        draft_tree_batch).
 
         ``kv_quant``: store the paged pool as int8 with per-(slot,
         kv-head) scales (ops/paged_kv.py). Decode is KV-bandwidth-bound,
@@ -683,6 +701,36 @@ class BatchScheduler:
             if d.k != spec_k:
                 raise ValueError(
                     f"drafter k={d.k} != spec_k={spec_k}")
+        # Tree-speculation budget normalization: pow2-snap up, then
+        # require at least one sibling slot past root + main chain —
+        # a tree with no branch budget is the linear program with
+        # extra mask plumbing, so it degrades to 0 (linear path).
+        nodes = int(spec_tree_nodes or 0)
+        if nodes > 0 and spec_k > 0:
+            snapped = 1 << max(0, nodes - 1).bit_length()
+            if snapped != nodes:
+                log.info("spec_tree_nodes %d snapped to %d", nodes, snapped)
+            nodes = snapped
+            if nodes < spec_k + 2:
+                log.info("spec_tree_nodes %d < spec_k+2 (%d): no sibling "
+                         "budget — tree speculation off (linear spec)",
+                         nodes, spec_k + 2)
+                nodes = 0
+        else:
+            nodes = 0
+        self.spec_tree_nodes = nodes
+        self.spec_tree_gap = float(spec_tree_gap)
+        self._tree_base_np: Optional[tuple] = None   # owned-by: _loop
+        # Tree-speculation counters (owned-by: _loop): total tree nodes
+        # verified, drafted rows per tree dispatch, and accepted tokens
+        # on tree ticks — /metrics serve_spec_tree_* series.
+        self._n_spec_tree_nodes = 0
+        self._n_spec_tree_rows = 0
+        self._n_spec_tree_accepted = 0
+        # Per-source verify-dispatch counts (ticks where that source
+        # drafted >= 1 row) — the accepted-tokens-per-verify-dispatch
+        # denominator. owned-by: _loop.
+        self._n_spec_dispatch_src: dict[str, int] = {}
         # Adaptive speculation: PER-SOURCE EMA of accepted drafts per
         # spec tick. The verify forward computes K+1 positions for every
         # row, so when a source's drafts stop landing, paying its
@@ -826,6 +874,92 @@ class BatchScheduler:
 
         self._make_spec = _make_spec
         self._spec_programs: dict[int, object] = {}
+
+        def _make_spec_tree(kv_window: int):
+            """Tree-speculation tick: ONE verify forward over the [B,N]
+            node tree (tree-topology mask, per-node depths for RoPE),
+            exact tree acceptance, sibling-kv compaction, and length
+            advance, all fused. Host reads back 3×B int32 (accepted,
+            used_sib, correction)."""
+            from ..models.sampling import spec_verify_tree
+            from ..ops.paged_kv import copy_slot
+
+            def _spec_tree(params, tokens, depths, anc, drafts, sib_tok,
+                           sib_node, max_acc, cache, active, temps,
+                           top_ks, top_ps, keys, ring, rps):
+                B, N = tokens.shape
+                K = drafts.shape[1]
+                lengths_pre = cache.lengths
+                if self.kv_mode == "paged":
+                    pages = min(-(-(kv_window + N) // self.page_size),
+                                cache.max_pages_per_row)
+                    logits, cache = model.verify_tree_paged(
+                        params, config, tokens, depths, anc, cache, mesh,
+                        pages=pages)
+                else:
+                    logits, cache = model.verify_tree(
+                        params, config, tokens, depths, anc, cache, mesh,
+                        kv_window=kv_window)
+                accepted, used_sib, correction, keys = spec_verify_tree(
+                    logits.astype(jnp.float32), drafts, sib_tok,
+                    sib_node, keys, temps, top_ks, top_ps, max_acc,
+                    ring=ring, rp=rps, ctx_len=lengths_pre)
+                # Sibling kv compaction: an accepted sibling's kv lives
+                # at its NODE slot (lengths + sib_node); move it onto
+                # the accepted-path slot (lengths + accepted, i.e. the
+                # slot right after the accepted main prefix) BEFORE
+                # lengths advance over it. Rows that used no sibling
+                # self-copy harmlessly (src == dst). The sibling node
+                # index is always > accepted, so the vacated slot stays
+                # stale-beyond-length — rejected-branch containment.
+                sel = jnp.clip(accepted - 1, 0, K - 1)[:, None]
+                sn = jnp.take_along_axis(sib_node, sel, axis=1)[:, 0]
+                st = jnp.take_along_axis(sib_tok, sel, axis=1)[:, 0]
+                move = active & (used_sib > 0)
+                dst = lengths_pre + accepted
+                src = jnp.where(move, lengths_pre + sn, dst)
+                if self.kv_mode == "paged":
+                    cache = copy_slot(cache, src, dst)
+                else:
+                    b_ix = jnp.arange(B)
+                    src_c = jnp.minimum(src, cache.k.shape[2] - 1)
+                    cache = cache._replace(
+                        k=cache.k.at[:, b_ix, dst].set(
+                            cache.k[:, b_ix, src_c], mode="drop"),
+                        v=cache.v.at[:, b_ix, dst].set(
+                            cache.v[:, b_ix, src_c], mode="drop"))
+                inc = jnp.where(active, accepted + 1, 0)
+                cache = cache._replace(
+                    lengths=cache.lengths
+                    + inc.astype(cache.lengths.dtype))
+                # Emitted tokens enter the penalty ring at their context
+                # positions — the linear tick's rule, except a used
+                # sibling replaces the main draft at the rejected
+                # position (index accepted-1).
+                ar = jnp.arange(K + 1)[None, :]
+                pos = (lengths_pre[:, None] + 1 + ar) % _RING
+                emit_ok = (ar <= accepted[:, None]) & active[:, None]
+                idx = jnp.where(emit_ok, pos, _RING)
+                emitted = jnp.where(
+                    ar < accepted[:, None],
+                    jnp.concatenate(
+                        [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1),
+                    correction[:, None])
+                emitted = jnp.where(
+                    (used_sib > 0)[:, None]
+                    & (ar == (accepted - 1)[:, None]),
+                    st[:, None], emitted)
+                ring = ring.at[jnp.arange(B)[:, None], idx].set(
+                    emitted, mode="drop")
+                next_tokens = jnp.where(active[:, None],
+                                        correction[:, None],
+                                        tokens[:, :1])
+                return (accepted, used_sib, correction, next_tokens,
+                        cache, keys, ring)
+            return jax.jit(_spec_tree, donate_argnums=(8, 13, 14))
+
+        self._make_spec_tree = _make_spec_tree
+        self._spec_tree_programs: dict[int, object] = {}
 
         def _make_wake(kv_window: int, S: int):
             """Session-wake admission program (multi-tier KV): ONE fused
@@ -1387,6 +1521,31 @@ class BatchScheduler:
             self._spec_programs[window] = p
         return p
 
+    def _spec_tree_for(self, window: int):
+        p = self._spec_tree_programs.get(window)
+        if p is None:
+            p = self._make_spec_tree(window)
+            self._spec_tree_programs[window] = p
+        return p
+
+    def _tree_base(self) -> tuple[np.ndarray, np.ndarray]:
+        """Static per-(N, K) host base of the node tree: depths and
+        ancestor sets for the main chain (node 0 = pending token, nodes
+        1..K = the linear draft), sibling slots zeroed (depth 0,
+        self-only ancestry) until a tick budgets them. Cached — the
+        spec tick copies it per dispatch."""
+        if self._tree_base_np is None:
+            N, K = self.spec_tree_nodes, self.spec_k
+            depths = np.zeros((N,), np.int32)
+            depths[: K + 1] = np.arange(K + 1, dtype=np.int32)
+            anc = np.zeros((N, N), bool)
+            for i in range(K + 1):
+                anc[i, : i + 1] = True
+            for s in range(K + 1, N):
+                anc[s, s] = True
+            self._tree_base_np = (depths, anc)
+        return self._tree_base_np
+
     def _decode_fused_for(self, window: int, K: int):
         p = self._decode_fused_programs.get((window, K))
         if p is None:
@@ -1914,6 +2073,23 @@ class BatchScheduler:
              self._ring_dev) = self._spec_for(w)(
                 self._params, warm_tokens,
                 jnp.zeros((B, K), jnp.int32),
+                jnp.zeros((B,), jnp.int32), self._cache, inactive,
+                self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                self._keys, self._ring_dev, self._rps_dev)
+        if self.spec_k and self.spec_tree_nodes:
+            K, N = self.spec_k, self.spec_tree_nodes
+            depths_b, anc_b = self._tree_base()
+            warm_tokens = jnp.concatenate(
+                [self._next_dev, jnp.zeros((B, N - 1), jnp.int32)],
+                axis=1)
+            (_, _, _, self._next_dev, self._cache, self._keys,
+             self._ring_dev) = self._spec_tree_for(w)(
+                self._params, warm_tokens,
+                jnp.asarray(np.broadcast_to(depths_b, (B, N)).copy()),
+                jnp.asarray(np.broadcast_to(anc_b, (B, N, N)).copy()),
+                jnp.zeros((B, K), jnp.int32),
+                jnp.full((B, K), -1, jnp.int32),
+                jnp.full((B, K), -1, jnp.int32),
                 jnp.zeros((B,), jnp.int32), self._cache, inactive,
                 self._temps_dev, self._top_ks_dev, self._top_ps_dev,
                 self._keys, self._ring_dev, self._rps_dev)
@@ -2849,6 +3025,26 @@ class BatchScheduler:
                     round(acc / prop, 4) if prop else 0.0)
                 out[f'serve_spec_accept_ema{{source="{n}"}}'] = round(
                     self._spec_ema[n], 4)
+                # Accepted tokens per verify dispatch that THIS source
+                # drafted into — the lever tree speculation moves
+                # (more accepted per dispatch at the same verify
+                # budget), so it is first-class per source.
+                disp = self._n_spec_dispatch_src.get(n, 0)
+                out[f'serve_spec_accepted_per_dispatch{{source="{n}"}}'] = (
+                    round(acc / disp, 3) if disp else 0.0)
+            # Aggregate accepted-per-dispatch across all spec ticks.
+            out["serve_spec_accepted_per_dispatch"] = round(
+                self._n_spec_accepted / max(1, self._n_spec_ticks), 3)
+            if self.spec_tree_nodes:
+                # Tree speculation: total node positions verified
+                # (root + drafts + siblings over drafted rows) and the
+                # mean accepted PATH length (root included, so a
+                # zero-acceptance tick still walked 1 node).
+                out["serve_spec_tree_nodes_total"] = (
+                    self._n_spec_tree_nodes)
+                out["serve_spec_tree_accepted_path_len"] = round(
+                    1 + self._n_spec_tree_accepted
+                    / max(1, self._n_spec_tree_rows), 3)
         if self._prefix is not None:
             out["serve_prefix_entries"] = len(self._prefix)
             out["serve_prefix_admits_total"] = self._n_prefix_admits
@@ -3659,6 +3855,7 @@ class BatchScheduler:
             self._spec_cooldown[s.name] = 0
             self._n_spec_proposed_src[s.name] = 0
             self._n_spec_accepted_src[s.name] = 0
+            self._n_spec_dispatch_src[s.name] = 0
             self._sources.append(s)
 
     # graftcheck: runs-on _loop
@@ -3702,12 +3899,36 @@ class BatchScheduler:
         correction) — 2×B int32. Rejected drafts' kv slots are
         stale-beyond-length (free rollback, target AND drafter — the
         drafter rewinds via observe()); near-budget rows cap acceptance
-        via max_acc so trusted slots never pass their budget."""
+        via max_acc so trusted slots never pass their budget.
+
+        Tree mode (``spec_tree_nodes`` = N > 0): the verify window
+        widens from K+1 to N node positions. Nodes 0..K are the linear
+        chain exactly as above; nodes K+1..N-1 are SIBLING leaves — the
+        drafter's second-choice token at its least-certain main-chain
+        positions (top-1/top-2 logit gap < ``spec_tree_gap``), so the
+        one position most likely to be rejected carries a ready-scored
+        alternative. Verify is still ONE forward (tree-topology mask,
+        per-node depths); acceptance walks the main chain and, at the
+        first rejection, may hop to that position's sibling
+        (models/sampling.spec_verify_tree — exact, and bit-identical
+        to linear under greedy). An accepted sibling's kv slot is
+        compacted onto the accepted path inside the same dispatch;
+        rejected branches stay stale-beyond-length like rejected
+        drafts. Sources observe their MAIN-CHAIN accepted prefix only
+        (a used sibling diverges from the drafter's fed state)."""
         K = self.spec_k
+        N = self.spec_tree_nodes
+        tree = bool(N)
         B = self.num_slots
-        tokens = np.zeros((B, K + 1), np.int32)
+        tokens = np.zeros((B, N if tree else K + 1), np.int32)
         drafts = np.zeros((B, K), np.int32)
         max_acc = np.zeros((B,), np.int32)
+        if tree:
+            depth_b, anc_b = self._tree_base()
+            depths = np.broadcast_to(depth_b, (B, N)).copy()
+            anc = np.broadcast_to(anc_b, (B, N, N)).copy()
+            sib_tok = np.full((B, K), -1, np.int32)
+            sib_node = np.full((B, K), -1, np.int32)
         budgets: dict[int, int] = {}
         # Contexts as UNCONCATENATED (prompt_ids, ids) reference pairs —
         # the DraftSource contract — so a spec tick copies no per-row
@@ -3726,18 +3947,29 @@ class BatchScheduler:
             budgets[row] = budget
             ctxs[row] = (slot.prompt_ids, slot.ids)
             remaining.append(row)
-        # row -> (source name, proposal) — first source to propose wins.
-        proposals: dict[int, tuple[str, list[int]]] = {}
+        # row -> (source name, main chain, second choices, gaps) — first
+        # source to propose wins. Non-tree ticks carry empty sec/gap.
+        proposals: dict[int, tuple[str, list[int], list[int],
+                                   list[float]]] = {}
         consulted: list[str] = []
         for s in self._sources:
             if not remaining or not allowed.get(s.name):
                 continue
             consulted.append(s.name)
-            got = s.draft_batch(remaining, ctxs)
-            for row in remaining:
-                d = got.get(row)
-                if d:
-                    proposals[row] = (s.name, list(d[:K]))
+            if tree:
+                got_t = s.draft_tree_batch(remaining, ctxs)
+                for row in remaining:
+                    t = got_t.get(row)
+                    if t and t[0]:
+                        d, sec, gap = t
+                        proposals[row] = (s.name, list(d[:K]),
+                                          list(sec[:K]), list(gap[:K]))
+            else:
+                got = s.draft_batch(remaining, ctxs)
+                for row in remaining:
+                    d = got.get(row)
+                    if d:
+                        proposals[row] = (s.name, list(d[:K]), [], [])
             remaining = [r for r in remaining if r not in proposals]
         # A consulted source that proposed NOTHING decays like a
         # zero-acceptance tick: an unthrottled source is what keeps the
@@ -3746,17 +3978,42 @@ class BatchScheduler:
         # exactly like "never accepted" (a free-form stream under
         # n-gram-only speculation otherwise ran unpipelined forever).
         for name in consulted:
-            if not any(src == name for src, _ in proposals.values()):
+            if not any(src == name for src, *_ in proposals.values()):
                 self._spec_ema[name] *= (1 - _SPEC_EMA_ZERO_ALPHA)
         if not proposals:
             return False
         src_rows: dict[str, list[int]] = {s.name: [] for s in self._sources}
-        for row, (src, d) in proposals.items():
+        for row, (src, d, sec, gap) in proposals.items():
             src_rows[src].append(row)
             self._n_spec_proposed_src[src] += len(d)
             drafts[row, : len(d)] = d
             tokens[row, 1: 1 + len(d)] = d
             max_acc[row] = min(len(d), budgets[row])
+            if tree:
+                n_sib = 0
+                # Sibling write-validity guard: node slots K+1..N-1
+                # write kv at lengths + node; past the row's cache
+                # capacity those writes are dropped (garbage page /
+                # mode="drop"), and compacting a dropped slot would
+                # copy stale kv — so near-capacity rows run the tick
+                # as a plain linear chain.
+                if (self._slots[row] is not None
+                        and self._slots[row].ctx_len + N + 2
+                        <= self.max_seq):
+                    sites = [j for j in range(min(len(d), len(sec),
+                                                  len(gap)))
+                             if gap[j] < self.spec_tree_gap
+                             and sec[j] != d[j]]
+                    for j in sites[: N - K - 1]:
+                        node = K + 1 + n_sib
+                        tokens[row, node] = sec[j]
+                        depths[row, node] = j + 1
+                        anc[row, node, : j + 1] = True
+                        sib_tok[row, j] = sec[j]
+                        sib_node[row, j] = node
+                        n_sib += 1
+                self._n_spec_tree_rows += 1
+                self._n_spec_tree_nodes += 1 + len(d) + n_sib
 
         self._n_decode_ticks += 1
         self._n_spec_ticks += 1
@@ -3770,13 +4027,30 @@ class BatchScheduler:
             self._active_host = active
             # graftcheck: sync-ok host tuple -> device upload, not a readback
             self._active_dev = jnp.asarray(np.array(active, bool))
-        spec_j = self._spec_for(self._window(extra=K))
-        (accepted, correction, self._next_dev, self._cache,
-         self._keys, self._ring_dev) = spec_j(
-            self._params, jnp.asarray(tokens), jnp.asarray(drafts),
-            jnp.asarray(max_acc), self._cache, self._active_dev,
-            self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys,
-            self._ring_dev, self._rps_dev)
+        for name, rows_d in src_rows.items():
+            if rows_d:
+                self._n_spec_dispatch_src[name] = (
+                    self._n_spec_dispatch_src.get(name, 0) + 1)
+        if tree:
+            spec_j = self._spec_tree_for(self._window(extra=N - 1))
+            (accepted, used_sib, correction, self._next_dev,
+             self._cache, self._keys, self._ring_dev) = spec_j(
+                self._params, jnp.asarray(tokens), jnp.asarray(depths),
+                jnp.asarray(anc), jnp.asarray(drafts),
+                jnp.asarray(sib_tok), jnp.asarray(sib_node),
+                jnp.asarray(max_acc), self._cache, self._active_dev,
+                self._temps_dev, self._top_ks_dev, self._top_ps_dev,
+                self._keys, self._ring_dev, self._rps_dev)
+            used = np.asarray(used_sib)  # graftcheck: sync-ok 3xB int32 verify readback
+        else:
+            spec_j = self._spec_for(self._window(extra=K))
+            (accepted, correction, self._next_dev, self._cache,
+             self._keys, self._ring_dev) = spec_j(
+                self._params, jnp.asarray(tokens), jnp.asarray(drafts),
+                jnp.asarray(max_acc), self._cache, self._active_dev,
+                self._temps_dev, self._top_ks_dev, self._top_ps_dev, self._keys,
+                self._ring_dev, self._rps_dev)
+            used = np.zeros((B,), np.int32)
         acc = np.asarray(accepted)  # graftcheck: sync-ok 2xB int32 verify readback
         corr = np.asarray(correction)  # graftcheck: sync-ok same dispatch, already synced
         # Per-source EMA update over the rows THAT source drafted this
@@ -3806,7 +4080,11 @@ class BatchScheduler:
                 ema = max(ema, _SPEC_EMA_SEED)
             self._spec_ema[s.name] = ema
             for r in rows_s:
-                s.observe(r, int(acc[r]))
+                # MAIN-CHAIN accepted prefix only: a used sibling's
+                # token diverges from what this source fed itself, so
+                # the drafter must rewind to just before it (the EMA
+                # above still credits the full acceptance).
+                s.observe(r, int(acc[r]) - int(used[r]))
         for row, slot in enumerate(self._slots):
             if slot is None:
                 continue
@@ -3815,7 +4093,18 @@ class BatchScheduler:
                 continue
             a = int(acc[row])
             self._n_spec_accepted += a
-            emitted = [int(t) for t in drafts[row, :a]] + [int(corr[row])]
+            if tree and row in proposals:
+                self._n_spec_tree_accepted += a
+            if int(used[row]):
+                # Position a-1 accepted the SIBLING token, not the main
+                # draft; the correction then comes from the sibling
+                # node's own logits.
+                a0 = a - 1
+                emitted = ([int(t) for t in drafts[row, :a0]]
+                           + [int(sib_tok[row, a0])] + [int(corr[row])])
+            else:
+                emitted = ([int(t) for t in drafts[row, :a]]
+                           + [int(corr[row])])
             for t in emitted:
                 slot.ctx_len += 1    # per token, mirroring the plain tick
                 if not self._append_token(slot, row, t):

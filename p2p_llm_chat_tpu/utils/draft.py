@@ -74,6 +74,26 @@ class DraftSource:
         (the model drafter: the suffix past its fed prefix)."""
         raise NotImplementedError
 
+    def draft_tree_batch(self, rows: list[int],
+                         ctxs: dict[int, tuple[list[int], list[int]]]
+                         ) -> dict[int, tuple[list[int], list[int],
+                                              list[float]]]:
+        """Tree proposals: row -> (main_chain, second_choices, gaps).
+        ``main_chain`` is exactly what :meth:`draft_batch` would
+        propose; ``second_choices[j]``/``gaps[j]`` are the source's
+        second-best token at main position j and its top-1/top-2 score
+        gap (smaller = less certain = better branch site). The default
+        degrades to a LINEAR chain — empty second/gap lists, so the
+        scheduler budgets no siblings and the tree is a path
+        (NGramSource proposes from a lookup table with no runner-up
+        score; it rides tree ticks unchanged this way). Sources with
+        real runner-up scores (serve/draft_model.ModelDrafter)
+        override. ``observe`` still reports the MAIN-CHAIN accepted
+        prefix only — a used sibling diverges from this source's fed
+        state, so it must not be counted as fed context."""
+        return {r: (d, [], [])
+                for r, d in self.draft_batch(rows, ctxs).items()}
+
     def observe(self, row: int, accepted: int) -> None:
         """Verify outcome for a row this source drafted this tick."""
 

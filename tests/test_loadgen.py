@@ -214,6 +214,26 @@ def test_truncated_stream_classification(stub):
     assert recs and all(r.status == "truncated" for r in recs)
 
 
+def test_empty_stream_classification(stub):
+    """A stream that completes CLEANLY with zero deltas (long_ctx at the
+    context budget: max_tokens resolves to 0 after the prompt fills the
+    window) is its own ``empty`` status — not error, not truncated — so
+    it neither trips the bad-fraction gate nor the chaos mixes' strict
+    zero-error contract (the old error/stream classification flaked
+    exactly those runs)."""
+    s = stub(deltas=0)                  # done record, no deltas ever
+    recs = _drive(s, _serve_only(s), rate=30.0, dur=0.5)
+    assert recs and all(r.status == "empty" for r in recs)
+    assert all(r.error_kind == "" for r in recs)
+    row = build_ledger(recs, {"short_chat": REGISTRY["short_chat"]},
+                       duration_s=0.5)
+    s_row = row["scenarios"]["short_chat"]
+    assert s_row["empty"] == len(recs)
+    assert s_row["error"] == 0 and s_row["truncated"] == 0
+    assert row["empty"] == len(recs) and row["bad"] == 0
+    assert not any("error+truncated" in v for v in s_row["violations"])
+
+
 def test_open_loop_arrivals_fire_on_schedule_despite_stall(stub):
     # Server stalls 400 ms before the first delta. A closed-loop
     # generator would slow its arrival stream to the completion rate;
