@@ -155,6 +155,19 @@ Env knobs (all optional):
                         (``long_w`` rows in the JSON). TPU + paged only.
 - ``BENCH_HBM_GBPS``    HBM bandwidth used for the bytes bound
                         (default 819 — one v5e chip)
+- ``BENCH_MOE_SCALE``   1 = MoE-scale ablation phase (round 18): decode
+                        step time at ``BENCH_MOE_CONFIG`` across four
+                        legs — paged + fused wgu_e + auto matmul impl
+                        (the served configuration), split gate/up
+                        projections, forced-XLA dequant matmuls, and
+                        the dense cache — with per-leg effective-impl
+                        labels and ratios (``moe_scale`` row). Runs
+                        after the serving phases on its own params.
+- ``BENCH_MOE_CONFIG``  config for that phase (default bench-moe;
+                        ``mixtral-large`` on hardware that fits it)
+- ``BENCH_MOE_SLOTS``   decode rows for it (default 8)
+- ``BENCH_MOE_WINDOW``  attention window it decodes at (default 512)
+- ``BENCH_MOE_STEPS``   timing-loop depth (default 8)
 """
 
 from __future__ import annotations
@@ -1379,6 +1392,158 @@ def main() -> None:
             for eng in engines:
                 eng.stop()
 
+    # -- MoE-scale ablations (BENCH_MOE_SCALE, round 18): the expert
+    # decode trunk measured leg by leg at a real-MoE config, AFTER the
+    # serving phases so its params/pool never share HBM with the main
+    # scheduler's. Four legs isolate the round's three mechanisms:
+    # paged+fused+auto (the served configuration), split gate/up (the
+    # wgu_e fusion win is pure dispatch count — tests pin the outputs
+    # bitwise-identical), forced-XLA dequant (the stacked expert-stripe
+    # kernel's margin), and the dense cache (the paged-walk gap the
+    # hd-aware flash policy exists to close). Each leg is labeled by
+    # the matmul impl it can actually dispatch — on a CPU host the
+    # kernel gate answers no, so auto and forced-XLA honestly time the
+    # same program and the ratio reads 1.0 by construction.
+    moe_scale: dict = {}
+    if env_bool("BENCH_MOE_SCALE", False):
+        from p2p_llm_chat_tpu.models.quant import set_mm_impl
+        from p2p_llm_chat_tpu.ops.paged_kv import PagedKVCache as _MPKV
+        moe_cfg_name = env_or("BENCH_MOE_CONFIG", "bench-moe")
+        moe_slots = env_int("BENCH_MOE_SLOTS", 8)
+        moe_window = env_int("BENCH_MOE_WINDOW", 512)
+        moe_steps = max(4, env_int("BENCH_MOE_STEPS", 8))
+        moe_quant = quant or "int8"
+        try:
+            moe_cfg = get_config(moe_cfg_name)
+            if not moe_cfg.is_moe:
+                raise ValueError(
+                    f"BENCH_MOE_CONFIG={moe_cfg_name!r} has no experts")
+            moe_fam = family_for(moe_cfg)
+            moe_params = moe_fam.init_params_quantized(
+                moe_cfg, jax.random.PRNGKey(7), dtype=dtype,
+                quant=moe_quant)
+            jax.block_until_ready(moe_params)
+            # The split-gu tree: slice the fused [NE,H,2F] pool back
+            # into gate/up halves (column-concat commutes with the
+            # per-output-channel scales, so the math is identical —
+            # only the per-layer einsum count doubles).
+            wgu = moe_params["layers"]["wgu_e"]
+            E_moe = wgu.q.shape[-1] // 2
+            split_layers = dict(moe_params["layers"])
+            del split_layers["wgu_e"]
+            split_layers["w_gate"] = type(wgu)(q=wgu.q[..., :E_moe],
+                                               s=wgu.s[..., :E_moe])
+            split_layers["w_up"] = type(wgu)(q=wgu.q[..., E_moe:],
+                                             s=wgu.s[..., E_moe:])
+            split_params = dict(moe_params, layers=split_layers)
+
+            pages_m = -(-moe_window // page_size)
+            toks_m = jnp.ones((moe_slots, 1), jnp.int32)
+            # Parked rows: lengths hold, every step reads the same full
+            # window — the long-window sweep's steady-state convention.
+            parked_m = jnp.zeros((moe_slots,), bool)
+            mn1 = max(2, moe_steps // 4)
+            mn2 = max(moe_steps, 2 * mn1)
+
+            def moe_leg(leg_params, paged_leg: bool,
+                        force_xla: bool) -> dict:
+                set_mm_impl("xla" if force_xla else "auto")
+                if paged_leg:
+                    pool_m = _MPKV.create(
+                        moe_cfg, moe_slots, moe_slots * pages_m + 1,
+                        page_size, max_pages_per_row=pages_m,
+                        dtype=dtype, quantized=kv_quant)
+                    table_m = (1 + jnp.arange(moe_slots * pages_m,
+                                              dtype=jnp.int32)
+                               ).reshape(moe_slots, pages_m)
+                    cache_m = pool_m._replace(
+                        page_table=table_m,
+                        lengths=jnp.full((moe_slots,), moe_window - 2,
+                                         jnp.int32))
+
+                    def _mstep(p, t, c, a):
+                        return moe_fam.decode_step_paged(
+                            p, moe_cfg, t, c, active=a, pages=pages_m)
+                else:
+                    cache_m = KVCache.create(moe_cfg, moe_slots,
+                                             moe_window, dtype)
+                    cache_m = cache_m._replace(
+                        lengths=jnp.full((moe_slots,), moe_window - 2,
+                                         jnp.int32))
+
+                    def _mstep(p, t, c, a):
+                        return moe_fam.decode_step(p, moe_cfg, t, c,
+                                                   active=a)
+
+                # graftcheck: retrace-ok one fresh program per leg by design — set_mm_impl and the leg's param tree both change what the trace dispatches
+                mj = jax.jit(_mstep, donate_argnums=(2,))
+
+                def m_loop(n: int) -> float:
+                    nonlocal cache_m
+                    lg, cache_m = mj(leg_params, toks_m, cache_m,
+                                     parked_m)
+                    np.asarray(lg[:1, 0, :1])
+                    t0m = time.monotonic()
+                    for _ in range(n):
+                        lg, cache_m = mj(leg_params, toks_m, cache_m,
+                                         parked_m)
+                    np.asarray(lg[:1, 0, :1])
+                    return (time.monotonic() - t0m) / n
+
+                w1, w2 = m_loop(mn1), m_loop(mn2)
+                d = (mn2 * w2 - mn1 * w1) / (mn2 - mn1)
+                ms = (d if d > 0.05 * w2 else w2) * 1e3
+                return {
+                    "step_ms": round(ms, 3),
+                    "tok_s": round(moe_slots / (ms / 1e3), 1),
+                    "mm_impl": ("xla" if force_xla else
+                                "auto-kernel" if platform == "tpu"
+                                else "auto-xla"),
+                }
+
+            legs = {}
+            try:
+                legs["paged_fused"] = moe_leg(moe_params, True, False)
+                legs["paged_split_gu"] = moe_leg(split_params, True,
+                                                 False)
+                legs["paged_fused_xla"] = moe_leg(moe_params, True, True)
+                legs["dense_fused"] = moe_leg(moe_params, False, False)
+            finally:
+                set_mm_impl("auto")
+            base_ms = legs["paged_fused"]["step_ms"]
+            moe_scale = {
+                "config": moe_cfg_name,
+                "quant": moe_quant,
+                "slots": moe_slots,
+                "window": moe_window,
+                "weight_stream_gb": round(
+                    param_bytes(moe_params) / 1e9, 3),
+                "legs": legs,
+                # >1 = splitting gate/up costs; the fusion keeps it at
+                # the fused dispatch count for identical math.
+                "split_gu_over_fused": round(
+                    legs["paged_split_gu"]["step_ms"] / base_ms, 3),
+                # >1 = the stacked kernel beats forced dequant at this
+                # shape (1.0 by construction off-TPU, see labels).
+                "xla_over_auto": round(
+                    legs["paged_fused_xla"]["step_ms"] / base_ms, 3),
+                # The dense-vs-paged gap at MoE dims — the number the
+                # hd-aware flash-append policy is judged on.
+                "paged_over_dense": round(
+                    base_ms / legs["dense_fused"]["step_ms"], 3),
+            }
+            log(f"moe scale ({moe_cfg_name}, {moe_quant}, W={moe_window},"
+                f" B={moe_slots}): " + ", ".join(
+                    f"{k} {v['step_ms']:.2f} ms [{v['mm_impl']}]"
+                    for k, v in legs.items())
+                + f"; split/fused {moe_scale['split_gu_over_fused']}x,"
+                f" xla/auto {moe_scale['xla_over_auto']}x,"
+                f" paged/dense {moe_scale['paged_over_dense']}x")
+            del moe_params, split_params
+        except Exception as e:      # noqa: BLE001 — record, don't abort
+            log(f"moe scale phase FAILED: {e}")
+            moe_scale = {"config": moe_cfg_name, "error": str(e)}
+
     result = {
         "metric": f"p50_ttft_ms_{slots}_concurrent_{cfg_name}",
         "value": round(p50, 2),
@@ -1458,6 +1623,11 @@ def main() -> None:
             # tok/s for each leg, plus tree/linear ratios. The Round-17
             # acceptance numbers live here.
             "spec_tree": spec_tree or None,
+            # MoE-scale ablations (BENCH_MOE_SCALE): decode step at a
+            # real-MoE config across fused/split, auto/forced-XLA and
+            # paged/dense legs — the round-18 expert-trunk acceptance
+            # row (each leg labeled by its effective matmul impl).
+            "moe_scale": moe_scale or None,
             # Long-window sweep (BENCH_LONG_W): per (window, impl) step
             # time vs the HBM bytes bound; flash rows carry their
             # speedup over the gather path — the round-8 acceptance

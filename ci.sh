@@ -303,10 +303,23 @@ else
   JAX_PLATFORMS=cpu python -m pytest tests/test_quant.py -q -x \
     -m 'not slow' || rc=1
 
+  # MoE at expert scale (round 18, tier-1 legs): the grouped
+  # expert-stripe kernels vs the dequant-einsum oracle in interpret
+  # mode (int8 + int4, incl. the odd-group-count half-group walk),
+  # wgu_e fusion bit-identity, paged-vs-dense decode on the QUANTIZED
+  # MoE trunk, and the stripe-gate/tile-table/expert-dispatch decision
+  # matrix at the production shapes (bench-moe + mixtral-large).
+  # Excluded from the sweep below so each case executes exactly once.
+  echo "== MoE expert kernels: parity + dispatch decision matrix (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_moe_expert_kernels.py \
+    tests/test_qmm_tile_table_dispatch.py -q -x || rc=1
+
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
     --ignore=tests/test_spec_tree.py \
     --ignore=tests/test_quant.py \
+    --ignore=tests/test_moe_expert_kernels.py \
+    --ignore=tests/test_qmm_tile_table_dispatch.py \
     --ignore=tests/test_trace.py \
     --ignore=tests/test_loadgen.py \
     --ignore=tests/test_devcrypto.py \

@@ -60,6 +60,17 @@ load" list:
                 P2P delivery leg gets its own SLO instead of hiding
                 inside short_chat's unmeasured first step. Serve-only
                 runs degrade to a short ``/api/chat`` turn.
+``multi_model``  the heterogeneous fleet (round 18): one arrival
+                stream split across the run's two ``SERVE_MODELS``
+                tags — most arrivals hit the interactive default
+                model, the rest the large-MoE trunk.
+                ``LOADGEN_MODELS=tagA,tagB`` names the tags (resolved
+                at build time); each measured step is phase-tagged
+                ``model_a``/``model_b`` so the ledger judges the two
+                latency classes separately instead of blending a 7B
+                TTFT with a 47B-class one. With ``LOADGEN_MODELS``
+                unset the steps carry no ``model`` field — plain
+                single-model traffic, still judgeable.
 ``disagg_session`` a two-turn session whose turns ride the
                 prefill→decode handoff on a disaggregated fleet
                 (docs/serving.md Round-14): turn 1 is a NEW
@@ -84,7 +95,7 @@ import random
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from ..utils.env import env_float
+from ..utils.env import env_float, env_or
 
 __all__ = [
     "SLO", "Step", "Scenario", "Endpoints", "REGISTRY",
@@ -360,6 +371,48 @@ def _build_relay_path(rng: random.Random, peer: int,
                  stream=True, measured=True)]
 
 
+# The multi_model arrival split: this fraction of arrivals hits the
+# FIRST tag (the interactive default); the rest hit the second (the
+# large trunk). A fixed constant, not an env knob — the determinism
+# contract pins the schedule AND the per-arrival picks to the seed, and
+# a knob that skews the split would silently re-weight the judged
+# phases between runs that claim the same seed.
+MULTI_MODEL_SPLIT = 0.75
+
+
+def _multi_model_tags() -> tuple:
+    """``LOADGEN_MODELS=tagA,tagB`` -> ("tagA", "tagB"): the two
+    ``SERVE_MODELS`` tags the multi_model scenario spreads arrivals
+    across. Read at BUILD time, not import, so the launcher can export
+    it after this module loads. Degrades: unset = no ``model`` field on
+    any step (the engine's default serves everything — single-model
+    runs stay judgeable); one tag = both classes pin that tag (the
+    phase split still measures, it just measures one model)."""
+    return tuple(t.strip()
+                 for t in env_or("LOADGEN_MODELS", "").split(",")
+                 if t.strip())
+
+
+def _build_multi_model(rng: random.Random, peer: int,
+                       ep: Endpoints) -> list:
+    """One short generate turn aimed at a per-arrival model pick: the
+    heterogeneous-fleet shape round 18's large-MoE config exists for —
+    a run serving ``tiny`` and ``mixtral-large`` side by side must keep
+    the interactive class fast WHILE the expert trunk decodes. The
+    phase tag carries the pick into the ledger's per-phase judgement
+    (report.py), so a miss names the model class, not the blend."""
+    tags = _multi_model_tags()
+    big = rng.random() >= MULTI_MODEL_SPLIT
+    phase = "model_b" if big else "model_a"
+    payload: dict = {"prompt": _chat_text(rng, "whichever model")
+                     + "\n\nReply:",
+                     "options": {"num_predict": 8}, "stream": True}
+    if tags:
+        payload["model"] = tags[1] if big and len(tags) > 1 else tags[0]
+    return [Step(url=f"{ep.serve_url}/api/generate", payload=payload,
+                 stream=True, measured=True, phase=phase)]
+
+
 def _build_disagg_session(rng: random.Random, peer: int,
                           ep: Endpoints) -> list:
     """Two turns under one session id, phase-tagged: turn 1 is a NEW
@@ -454,6 +507,23 @@ REGISTRY: dict = {
                  slo=SLO(ttft_p50_ms=4000, ttft_p95_ms=12000,
                          itl_p95_ms=None, max_shed_frac=0.25),
                  build=_build_relay_path),
+        # Heterogeneous models (round 18): the blended scenario SLO is
+        # sized for the mix; the per-phase SLOs split misses by MODEL
+        # class — model_a holds the interactive default's tight budget,
+        # model_b the large-MoE trunk's wider one (an 8-expert pool
+        # legitimately decodes slower per token; what it may NOT do is
+        # drag the interactive class down with it, which is exactly
+        # what a model_a phase violation would read as).
+        Scenario("multi_model", weight=0.5,
+                 slo=SLO(ttft_p50_ms=6000, ttft_p95_ms=18000,
+                         itl_p95_ms=2500, max_shed_frac=0.3),
+                 build=_build_multi_model,
+                 phase_slos={
+                     "model_a": SLO(ttft_p50_ms=4000, ttft_p95_ms=12000,
+                                    itl_p95_ms=2000, max_shed_frac=0.3),
+                     "model_b": SLO(ttft_p50_ms=8000, ttft_p95_ms=20000,
+                                    itl_p95_ms=3000, max_shed_frac=0.3),
+                 }),
         # Disaggregated session (round 14): judged on the turn-2 wake;
         # the per-phase SLOs split misses by pool — prefill's budget is
         # wider (it carries the chunked prefill AND the handoff), the

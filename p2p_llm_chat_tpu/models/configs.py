@@ -113,6 +113,27 @@ _register(ModelConfig(
     bos_token_id=1, eos_token_ids=(2,), max_seq_len=32768,
 ))
 
+# ~7.3B-total MoE config for single-chip benching at REAL expert scale:
+# each expert is 3*4096*11520 ≈ 141.6M params — 16.4x bench-moe's 8.65M,
+# Mixtral-8x7B-class expert width at Mixtral's 8-expert top-2 routing —
+# with depth cut to 6 layers so the streamed quantized load fits a 16 GB
+# chip next to its KV pool (int8 ≈ 7.3 GB, int4 ≈ 3.9 GB incl. group
+# scales; 32 layers of these experts would be a 37B model, BASELINE.json
+# config-5 territory — multi-chip). The per-layer MoE arithmetic the
+# round-18 bench measures (expert weight streaming, wgu_e fusion,
+# dispatch overheads) is layer-count-invariant, so 6 honest layers beat
+# 32 unloadable ones. intermediate 11520 = 45*256 = 90*128: divisible
+# for the expert-stripe kernels in BOTH int4 groupings (group 256 at
+# ng=45 — the odd-count segment walk — and group 128 at ng=90), and by
+# every w8a16 block candidate via 128.
+_register(ModelConfig(
+    name="mixtral-large", vocab_size=32000, hidden_size=4096,
+    intermediate_size=11520, num_layers=6, num_heads=32, num_kv_heads=8,
+    head_dim=128, rope_theta=1e6, num_experts=8, num_experts_per_tok=2,
+    moe_capacity_factor=2.0,
+    bos_token_id=1, eos_token_ids=(2,), max_seq_len=8192,
+))
+
 # -- test sizes (same code paths, CI-sized) ----------------------------------
 
 _register(ModelConfig(

@@ -319,17 +319,20 @@ def _layer_view(layers: dict, layer: jax.Array) -> dict:
     Why not scan xs: scan's per-iteration slicing of the stacked weights
     materialises each layer's slice before the Pallas w8a16 matmul
     (custom-call operands cannot alias a slice view) — measured at ~1.9 ms
-    of a 3.8 ms bench-1b decode step, half the step. Stacked int8 matmul
-    weights therefore stay WHOLE here, wrapped as
-    :class:`~.quant.LayerSlice` so ``mm`` feeds them to the layer-indexed
-    kernel (ops/quant_mm.quant_matmul_stacked); everything else (norms,
-    bf16 weights, 4-D MoE expert leaves) is sliced lazily — XLA fuses
-    those slices into their consumers for free.
+    of a 3.8 ms bench-1b decode step, half the step. Stacked quantized
+    matmul weights therefore stay WHOLE here, wrapped as
+    :class:`~.quant.LayerSlice` so ``mm`` / ``q_einsum`` feed them to the
+    layer-indexed kernels (ops/quant_mm.quant_matmul_stacked and the
+    4-D expert twin quant_matmul_experts_stacked — before round-18 the
+    expert stacks were sliced eagerly here, which bypassed the Pallas
+    path for every MoE expert matmul); everything else (norms, bf16
+    weights) is sliced lazily — XLA fuses those slices into their
+    consumers for free.
     """
     out = {}
     for k, v in layers.items():
         if isinstance(v, (QTensor, QTensor4)):
-            if v.q.ndim == 3:
+            if v.q.ndim >= 3:
                 out[k] = LayerSlice(v, layer)
             else:
                 out[k] = type(v)(

@@ -317,11 +317,51 @@ def mm(x: jax.Array, w) -> jax.Array:
     return x @ w
 
 
+# Expert einsum specs that are exactly a batched per-expert matmul
+# x[e] @ w[e] (contraction at w's -2, out axis last) — the two forms
+# models/mixtral.moe_mlp emits and the only ones the expert-stripe
+# Pallas kernels serve.
+_EXPERT_MM_SPECS = frozenset({"ech,ehf->ecf", "ecf,efh->ech"})
+
+
 def q_einsum(spec: str, x: jax.Array, w) -> jax.Array:
     """``einsum(spec, x, w)`` for plain or quantized ``w``. The spec's
     contraction over ``w`` must be its -2 axis (the quantize() axis) and
     the output must end with ``w``'s out axis — true for every expert
-    einsum in models/mixtral.py (``ech,ehf->ecf`` / ``ecf,efh->ech``)."""
+    einsum in models/mixtral.py (``ech,ehf->ecf`` / ``ecf,efh->ech``).
+
+    A :class:`LayerSlice` wrapping a layer-stacked 4-D expert pool
+    (llama._layer_view defers those exactly like the dense projections)
+    dispatches decode-shaped batched-matmul specs to the expert-stripe
+    Pallas kernels (ops/quant_mm.quant_matmul_experts_stacked[4]) so the
+    expert trunk streams quantized bytes from the scan-invariant pool —
+    the eager fallback slices the layer out and recurses, which is
+    bit-identical to what _layer_view did before the kernels existed."""
+    if isinstance(w, LayerSlice):
+        inner, layer = w.w, w.layer
+        if not isinstance(inner, (QTensor, QTensor4)):
+            raise TypeError("LayerSlice wraps stacked QTensors only")
+        if (inner.q.ndim == 4 and x.ndim == 3 and spec in _EXPERT_MM_SPECS
+                and x.shape[1] <= _KERNEL_MAX_ROWS and _kernel_wanted()):
+            C, H = x.shape[1], x.shape[2]
+            O = inner.q.shape[-1]
+            if isinstance(inner, QTensor):
+                from ..ops.quant_mm import (pick_expert_bo,
+                                            quant_matmul_experts_stacked)
+                if pick_expert_bo(C, H, O, x.dtype.itemsize):
+                    return quant_matmul_experts_stacked(x, inner.q, inner.s,
+                                                        layer)
+            else:
+                from ..ops.quant_mm import (pick_int4_bo,
+                                            quant_matmul_experts_stacked4)
+                if pick_int4_bo(C, H, O, inner.s.shape[-2],
+                                x.dtype.itemsize):
+                    return quant_matmul_experts_stacked4(x, inner.q,
+                                                         inner.s, layer)
+        inner = type(inner)(
+            q=jax.lax.dynamic_index_in_dim(inner.q, layer, 0, False),
+            s=jax.lax.dynamic_index_in_dim(inner.s, layer, 0, False))
+        return q_einsum(spec, x, inner)
     if isinstance(w, QTensor):
         y = jnp.einsum(spec, x, w.q.astype(x.dtype))
         return y * w.s.astype(x.dtype)       # s: [..., 1, out] broadcasts
@@ -343,15 +383,42 @@ _QUANT_LEAVES = frozenset({
 })
 
 
-def _quantize_leaf(v: jax.Array, mode: str):
-    """One matmul weight leaf at ``mode``. int4 needs a group (128, else
-    64) dividing the even contraction dim; leaves whose dims cannot group
-    (odd / sub-64 contraction — tiny test heads) fall back to per-channel
-    int8 so a mixed tree still serves."""
+def _int4_group(K: int, expert: bool) -> int | None:
+    """Group size for an int4 leaf with contraction ``K``, or None ->
+    the leaf keeps int8. Dense leaves group at 128 (the lane-aligned
+    kernel size) with a 64 fallback, as ever. Expert-stacked leaves
+    (``expert=True``, ndim >= 3) with a large 256-divisible contraction
+    group at 256 instead: at real expert scale the f32 scale rows are no
+    longer negligible (mixtral-large w_down: ng=90 at group 128 -> 35 MB
+    of scales halved to ng=45), and the segment-walk kernels serve the
+    odd count that results (ops/quant_mm.int4_stripe_seg — G=256 is
+    exactly the odd-count alignment bar)."""
+    if K % 2:
+        return None
+    if expert and K >= 8192 and K % 256 == 0:
+        return 256
+    if K % 128 == 0:
+        return 128
+    if K % 64 == 0:
+        return 64
+    return None
+
+
+def _quantize_leaf(v: jax.Array, mode: str, expert: bool | None = None):
+    """One matmul weight leaf at ``mode``. int4 needs a group (see
+    :func:`_int4_group`) dividing the even contraction dim; leaves whose
+    dims cannot group (odd / sub-64 contraction — tiny test heads) fall
+    back to per-channel int8 so a mixed tree still serves. ``expert``
+    defaults to ``v.ndim >= 3`` — right for the PER-LAYER leaves the
+    streaming init/load loops pass (dense 2-D, expert stacks 3-D);
+    :func:`quantize_params` walks LAYER-stacked trees and passes it
+    explicitly (dense 3-D there)."""
     if mode == "int4":
-        K = v.shape[-2]
-        if K % 2 == 0 and (K % 128 == 0 or K % 64 == 0):
-            return quantize4(v)
+        if expert is None:
+            expert = v.ndim >= 3
+        group = _int4_group(v.shape[-2], expert)
+        if group is not None:
+            return quantize4(v, group=group)
     return quantize(v)
 
 
@@ -362,8 +429,8 @@ def stream_bufs(L: int, shape: tuple, mode: str):
     ``init_params_quantized``, weights.load_checkpoint_quantized) splice
     layer slices into these so the bf16 tree never materialises."""
     K, O = shape[-2], shape[-1]
-    if mode == "int4" and K % 2 == 0 and (K % 128 == 0 or K % 64 == 0):
-        group = 128 if K % 128 == 0 else 64
+    group = _int4_group(K, len(shape) >= 3) if mode == "int4" else None
+    if group is not None:
         return QTensor4(
             q=jnp.zeros((L, *shape[:-2], K // 2, O), jnp.int8),
             s=jnp.zeros((L, *shape[:-2], K // group, O), jnp.float32))
@@ -392,7 +459,9 @@ def quantize_params(params: dict, mesh=None, mode: str = "int8") -> dict:
             if isinstance(v, dict):
                 out[k] = walk(v)
             elif k in _QUANT_LEAVES:
-                out[k] = _quantize_leaf(v, mode)
+                # Leaves here carry the leading layer axis: dense
+                # projections are 3-D, expert stacks 4-D.
+                out[k] = _quantize_leaf(v, mode, expert=v.ndim >= 4)
             else:
                 out[k] = v
         return out

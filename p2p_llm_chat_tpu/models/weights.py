@@ -545,13 +545,13 @@ def load_checkpoint_quantized(ckpt_dir: str,
         q = np.clip(np.round(wf / s), -127, 127).astype(np.int8)
         return q, s
 
-    def _host_quant4(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    def _host_quant4(w: np.ndarray, group: int) -> tuple[np.ndarray,
+                                                         np.ndarray]:
         # quant.quantize4's exact math in host numpy: group-wise abs-max
         # / 7, round-half-even, clip to [-7, 7], split-half nibble pack
         # (quant.pack4's layout; the uint8 view IS the explicit wrap).
         wf = _bf16_round(w)
         K = wf.shape[-2]
-        group = 128 if K % 128 == 0 else 64
         ng = K // group
         g = wf.reshape(*wf.shape[:-2], ng, group, wf.shape[-1])
         amax = np.abs(g).max(axis=-2, keepdims=True)
@@ -564,12 +564,14 @@ def load_checkpoint_quantized(ckpt_dir: str,
         return q, np.squeeze(s, -2)
 
     def host_quant(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-        # Per-leaf precision mirrors quant._quantize_leaf: int4 needs a
-        # group (128, else 64) dividing the even contraction dim.
-        K = w.shape[-2]
-        if (quant == "int4" and K % 2 == 0
-                and (K % 128 == 0 or K % 64 == 0)):
-            return _host_quant4(w)
+        # Per-leaf precision mirrors quant._quantize_leaf via the SAME
+        # group chooser (per-layer leaves: dense 2-D, expert stacks
+        # 3-D — matching _quantize_leaf's streaming-loop default).
+        from .quant import _int4_group
+        group = (_int4_group(w.shape[-2], w.ndim >= 3)
+                 if quant == "int4" else None)
+        if group is not None:
+            return _host_quant4(w, group)
         return _host_quant8(w)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
@@ -629,9 +631,9 @@ def load_checkpoint_quantized(ckpt_dir: str,
         # head). The class mirrors host_quant's per-leaf precision
         # choice (quant._quantize_leaf's predicate).
         head = top["lm_head"]
-        K = head.shape[-2]
-        cls = (QTensor4 if (quant == "int4" and K % 2 == 0
-                            and (K % 128 == 0 or K % 64 == 0))
+        from .quant import _int4_group
+        cls = (QTensor4 if (quant == "int4"
+                            and _int4_group(head.shape[-2], False))
                else QTensor)
         q, s = host_quant(head)
         params["lm_head"] = cls(q=jnp.asarray(q), s=jnp.asarray(s))

@@ -456,7 +456,8 @@ def paged_attention_append(q, k_cur, v_cur, cache, lengths, layer,
             cache.v_scale, cache.page_table, lengths, layer, pages=pages,
             quantized=cache.k_scale is not None, interpret=interpret)
     W = pages * cache.k.shape[2]
-    if not interpret and _flash_append_wanted(W):
+    if not interpret and _flash_append_wanted(
+            W, cache.k.shape[3] * cache.k.shape[4]):
         # Long-window default (round-8): the (B, chunk)-grid flash
         # kernel reads each page exactly once per (layer, step) and
         # holds only bounded tiles in VMEM, so there is no multi-chunk
@@ -695,29 +696,47 @@ def paged_attention_verify_append(q_blk, k_blk, v_blk, cache, lengths,
 _FLASH_CHUNK_PAGES = 8
 
 # Per-dtype chunk sizing for the flash-append DMA pipeline: bytes of
-# one (k or v) buffer side per token — the chunk token budget is
-# _FLASH_CHUNK_TOK_BYTES // pool_itemsize, i.e. 1024 int8 tokens /
-# 512 bf16 tokens / 256 f32 tokens per grid step. VMEM ceiling at
-# bench shapes (Hkv=8, D=128, HD=1024): double-buffered int8 k+v DMA
+# one (k or v) buffer side per token AT THE CALIBRATION GEOMETRY
+# (_FLASH_HD_REF) — the chunk token budget is
+# _FLASH_CHUNK_TOK_BYTES * _FLASH_HD_REF / (hd * pool_itemsize), i.e.
+# 1024 int8 tokens / 512 bf16 tokens / 256 f32 tokens per grid step at
+# the bench-1b geometry where the budget was measured (Hkv=8, D=128,
+# hd=1024), and proportionally MORE tokens per chunk at narrower KV
+# geometries (bench-moe's Hkv=4: 2048 int8 tokens — same VMEM bytes,
+# half the grid programs, which is half the per-chunk fixed cost the
+# round-5 MoE paged-walk gap is made of). VMEM ceiling is
+# geometry-invariant by construction: double-buffered int8 k+v DMA
 # slots 4 MB + the chunk-local bf16 dequant view 4 MB + f32 softmax
 # state ~0.2 MB = 8.2 MB, comfortably under the 16 MB stack that the
 # round-5 whole-chunk design overflowed (20.7 MB). Module-level so
-# tests can shrink it to exercise many-chunk grids in interpret mode.
+# tests can shrink both knobs to exercise many-chunk grids in
+# interpret mode at tiny geometries.
 _FLASH_CHUNK_TOK_BYTES = 1024
+
+# The Hkv * head_dim the chunk budget and the round-8 min-W boundary
+# were calibrated at (bench-1b / llama-8B class: 8 kv heads x 128).
+_FLASH_HD_REF = 1024
+
+# Floor for the geometry-scaled engagement boundary: below ~2 default
+# chunks the split-K grid cannot pipeline DMAs across programs and the
+# gather path's XLA fusion wins on every geometry measured.
+_FLASH_MIN_W_FLOOR = 256
 
 
 def _flash_append_min_w() -> int:
     """Engage the flash append kernel at windows >= this many tokens
-    (TPU only; <=0 disables it and the gather path runs everywhere).
-    Read per dispatch decision — NOT frozen at import — so tests and
-    bench phases can flip ``PAGED_APPEND_FLASH_MIN_W`` at runtime (the
-    pattern serve/scheduler.py established for ``prefill_chunk``); each
-    jitted caller traces the decision once per static shape."""
+    AT THE CALIBRATION GEOMETRY (see _flash_append_policy for the
+    per-geometry scaling; TPU only; <=0 disables it and the gather path
+    runs everywhere). Read per dispatch decision — NOT frozen at import
+    — so tests and bench phases can flip ``PAGED_APPEND_FLASH_MIN_W``
+    at runtime (the pattern serve/scheduler.py established for
+    ``prefill_chunk``); each jitted caller traces the decision once per
+    static shape."""
     return env_int("PAGED_APPEND_FLASH_MIN_W", 2048)
 
 
-def _flash_append_policy(window: int, append_impl: str,
-                         min_w: int) -> bool:
+def _flash_append_policy(window: int, append_impl: str, min_w: int,
+                         hd: int = _FLASH_HD_REF) -> bool:
     """The pure dispatch rule for the append path on TPU, split from
     the platform guard so CPU tests can pin the decision table
     hardware-free (tests/test_flash_append_geometry.py):
@@ -725,29 +744,46 @@ def _flash_append_policy(window: int, append_impl: str,
     - ``PAGED_APPEND_IMPL=flash``  -> flash kernel at EVERY window;
     - ``PAGED_APPEND_IMPL=kernel`` -> never (the round-4 block kernel
       owns the dispatch upstream);
-    - otherwise flash iff ``min_w > 0 and window >= min_w`` — the
-      round-8 default boundary (min_w = 2048).
+    - otherwise flash iff ``min_w > 0`` and the window reaches the
+      GEOMETRY-SCALED boundary ``max(256, min_w * hd / 1024)`` where
+      ``hd = Hkv * head_dim``.
+
+    Why the scaling (round-18): the round-8 boundary (2048) was
+    measured at hd=1024. Per window token, the gather path pays hd
+    bytes of materialised copy PLUS a geometry-invariant index/mask
+    overhead, while the flash kernel pays the same hd bytes streamed
+    once plus a per-chunk fixed cost that the hd-aware chunk budget
+    AMORTISES OVER MORE TOKENS as hd shrinks (same VMEM bytes per
+    chunk). Narrow-KV geometries therefore cross over earlier in
+    tokens: at bench-moe's hd=512 the boundary halves to W >= 1024 —
+    squarely inside the windows where BASELINE.md round-5 recorded the
+    ~1.3 ms MoE paged-walk gap the gather path was paying. The floor
+    keeps sub-2-chunk windows on gather everywhere.
     """
     if append_impl == "flash":
         return True
     if append_impl == "kernel":
         return False
-    return min_w > 0 and window >= min_w
+    if min_w <= 0:
+        return False
+    return window >= max(_FLASH_MIN_W_FLOOR,
+                         min_w * hd // _FLASH_HD_REF)
 
 
-def _flash_append_wanted(window: int) -> bool:
+def _flash_append_wanted(window: int, hd: int = _FLASH_HD_REF) -> bool:
     if jax.devices()[0].platform != "tpu":
         return False            # non-interpret pallas_call needs the TPU
     return _flash_append_policy(window, _APPEND_IMPL,
-                                _flash_append_min_w())
+                                _flash_append_min_w(), hd)
 
 
-def effective_flash_min_w() -> int:
+def effective_flash_min_w(hd: int = _FLASH_HD_REF) -> int:
     """The flash-append engagement boundary as ONE number, for gauges
     and logs (serve/scheduler.py's ``paged_flash_min_w``): 0 = the
     kernel cannot engage in this process (non-TPU platform, disabled,
     or the block-kernel override), 1 = the flash override (every
-    window), else the min-W threshold. Kept next to
+    window), else the geometry-scaled min-W threshold for ``hd =
+    Hkv * head_dim`` (the scheduler passes its model's). Kept next to
     _flash_append_policy so the dispatch rule has exactly one home."""
     if jax.devices()[0].platform != "tpu":
         return 0
@@ -755,7 +791,10 @@ def effective_flash_min_w() -> int:
         return 1
     if _APPEND_IMPL == "kernel":
         return 0
-    return max(0, _flash_append_min_w())
+    min_w = _flash_append_min_w()
+    if min_w <= 0:
+        return 0
+    return max(_FLASH_MIN_W_FLOOR, min_w * hd // _FLASH_HD_REF)
 
 
 def _flash_append_kernel_body(quantized: bool, page_size: int, pages: int,
@@ -973,11 +1012,18 @@ def _paged_attention_flash_append(q, k_cur, v_cur, k_pages, v_pages,
     layer = jnp.asarray(layer, jnp.int32).reshape(1)
     # Chunk budget in TOKENS, bounded by the VMEM stack, NOT by the
     # window: _FLASH_CHUNK_TOK_BYTES derives the per-dtype chunk (1024
-    # int8 / 512 bf16 / 256 f32 tokens). The grid — not a bigger chunk —
-    # is what amortises per-chunk overhead now, so chunks never grow
-    # with W and the round-5 whole-chunk VMEM OOM cannot recur.
+    # int8 / 512 bf16 / 256 f32 tokens at the hd=1024 calibration
+    # geometry), scaled by _FLASH_HD_REF / hd so the chunk's VMEM BYTES
+    # stay constant across KV geometries — narrow-KV models (bench-moe:
+    # hd=512) carry 2x the tokens per chunk for the same VMEM, halving
+    # the per-chunk fixed cost per window token. The grid — not a
+    # bigger chunk — is what amortises per-chunk overhead now, so
+    # chunks never grow with W and the round-5 whole-chunk VMEM OOM
+    # cannot recur.
+    hd = Hkv * D
     tok_budget = max(page_size,
-                     _FLASH_CHUNK_TOK_BYTES // k_pages.dtype.itemsize)
+                     _FLASH_CHUNK_TOK_BYTES * _FLASH_HD_REF
+                     // (hd * k_pages.dtype.itemsize))
     chunk_pages = max(1, min(pages, tok_budget // page_size))
     num_chunks = -(-pages // chunk_pages)
     # bf16 math on hardware; f32 in interpret mode so CPU parity tests

@@ -25,15 +25,27 @@ The w4a16 kernels (:func:`quant_matmul4` / :func:`quant_matmul_stacked4`)
 stream the PACKED int4 bytes — HBM weight traffic is half of int8's,
 the entire point — and unpack nibbles + fold group-wise scales in VMEM.
 They run the 1D whole-contraction grid only, statically unrolled over
-lo/hi group PAIRS of the split-half packing (models/quant.pack4): packed
-byte rows ``[g*G, (g+1)*G)`` are exactly logical group ``g`` (low
-nibbles) and group ``ng/2 + g`` (high nibbles), so each iteration
-unpacks one small [G, bo] tile (a whole-stripe int32 unpack would blow
-VMEM at 8B dims), runs two [rows, G] x [G, bo] dots, and scales each
-after its dot — group scales are constant within a dot, which is what
-makes scale-after-dot legal per group. Preconditions: even group count,
-group % 128 == 0 (lane-aligned x slices); everything else takes the
-dequant XLA fallback in models/quant.mm.
+SEGMENTS of the split-half packing (models/quant.pack4): each segment of
+packed byte rows unpacks one small [seg, bo] tile (a whole-stripe int32
+unpack would blow VMEM at 8B dims), runs two [rows, seg] x [seg, bo]
+dots, and scales each after its dot — the segment width is chosen so
+every dot's logical rows fall inside ONE scale group, which is what
+makes scale-after-dot legal per group. Even group counts walk whole
+groups (seg = G: packed rows ``[g*G, (g+1)*G)`` are exactly logical
+group ``g`` low-nibble and group ``ng/2 + g`` high-nibble); odd group
+counts walk HALF-groups (seg = G/2: the hi-nibble half starts at
+logical row ng*G/2 — a half-group boundary — so whole-group segments
+would straddle two scales, half-group segments never do).
+Preconditions (:func:`int4_stripe_seg`): group % 128 == 0 for even
+counts, group % 256 == 0 for odd ones (x slices must stay lane-
+aligned at the segment width); everything else takes the dequant XLA
+fallback in models/quant.mm.
+
+The ``*_experts_stacked`` kernels extend both precisions to the 4-D
+MoE expert pools [L, NE, H, O]: grid (NE, O/bo) per layer, each program
+DMAing one expert's whole-contraction stripe, so the top-k gathered
+expert matmuls of models/mixtral.moe_mlp ride the quantized stream
+instead of falling back to an XLA dequant of the full expert stack.
 """
 
 from __future__ import annotations
@@ -82,7 +94,18 @@ _X_VMEM_BUDGET_BYTES = 6 * 1024 * 1024
 # the dispatch decision, tools/check_quant_kernel.py measures it on
 # chip. Caps only apply when they divide O (else the next smaller
 # candidate divisor wins via the normal search).
-_TILE_TABLE = {1024: 256}
+#
+# MoE expert contractions (round-18): 2816 is bench-moe's w_down stripe
+# — uncapped it picks bo=1024 and leaves the O=1024 projection ONE grid
+# program (no DMA/compute overlap at all, the hidden=1024 failure mode
+# taken to its limit); 128 restores 8 programs. 11520 is mixtral-large's
+# w_down: the 4 MiB stripe budget already shrinks it to bo=256, pinned
+# here so the decision survives budget retunes (grid depth 16 at
+# O=4096). Both derive from the same grid-depth arithmetic the
+# hidden=1024 probe measured; tools/check_quant_kernel.py carries the
+# expert-shape matrix for the on-chip confirmation (BASELINE.md
+# round-18 deferral).
+_TILE_TABLE = {1024: 256, 2816: 128, 11520: 256}
 
 
 def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref):
@@ -150,29 +173,33 @@ def _qmm_kernel_2d_stacked(layer_ref, x_ref, q_ref, s_ref, o_ref, acc_ref):
 
 def _qmm4_body(x, pk_rows, s_rows, o_dtype):
     """Shared w4a16 kernel body: x [rp, K]; pk_rows [K/2, bo] packed
-    int8; s_rows [ng, bo] f32. Statically unrolled over the ng/2 group
-    PAIRS of the split-half packing: packed byte rows [g*G, (g+1)*G) are
-    logical group g in the low nibbles and group ng/2 + g in the high
-    nibbles, so each iteration unpacks ONE [G, bo] tile to int32 (small —
-    a whole-stripe unpack would blow VMEM at K=14336), runs two
-    [rp, G] x [G, bo] dots and folds each group's scale after its dot
-    (legal per group: the scale is constant within the dot's
-    contraction). Nibble math stays in int32 where & 0xF and the
+    int8; s_rows [ng, bo] f32. Statically unrolled over SEGMENTS of the
+    split-half packing: each iteration unpacks ONE [seg, bo] tile to
+    int32 (small — a whole-stripe unpack would blow VMEM at K=14336),
+    runs two [rp, seg] x [seg, bo] dots and folds each group's scale
+    after its dot (legal per group: the segment width divides the group
+    so a dot's contraction never crosses a scale boundary — see
+    :func:`int4_stripe_seg` for why odd counts need half-group
+    segments). Nibble math stays in int32 where & 0xF and the
     arithmetic >> 4 are sign-robust for negative reinterpreted bytes."""
     K = x.shape[1]
     ng = s_rows.shape[0]
     G = K // ng
-    half = ng // 2
+    seg = int4_stripe_seg(K, ng)
     acc = jnp.zeros((x.shape[0], pk_rows.shape[1]), jnp.float32)
-    for g in range(half):
-        pk = pk_rows[g * G:(g + 1) * G, :].astype(jnp.int32)
+    for t in range((K // 2) // seg):
+        pk = pk_rows[t * seg:(t + 1) * seg, :].astype(jnp.int32)
         w_lo = ((pk & 0xF) - 8).astype(x.dtype)
         w_hi = (((pk >> 4) & 0xF) - 8).astype(x.dtype)
-        s_lo = s_rows[g, :].astype(jnp.float32)
-        s_hi = s_rows[half + g, :].astype(jnp.float32)
-        acc += jax.lax.dot(x[:, g * G:(g + 1) * G], w_lo,
+        # Logical rows of this segment: low nibbles at t*seg, high
+        # nibbles at K/2 + t*seg; both offsets are seg-multiples and seg
+        # divides G, so each lies inside exactly one scale group.
+        s_lo = s_rows[(t * seg) // G, :].astype(jnp.float32)
+        s_hi = s_rows[(K // 2 + t * seg) // G, :].astype(jnp.float32)
+        acc += jax.lax.dot(x[:, t * seg:(t + 1) * seg], w_lo,
                            preferred_element_type=jnp.float32) * s_lo[None, :]
-        acc += jax.lax.dot(x[:, K // 2 + g * G:K // 2 + (g + 1) * G], w_hi,
+        acc += jax.lax.dot(x[:, K // 2 + t * seg:K // 2 + (t + 1) * seg],
+                           w_hi,
                            preferred_element_type=jnp.float32) * s_hi[None, :]
     return acc.astype(o_dtype)
 
@@ -188,6 +215,26 @@ def _qmm4_kernel_1d_stacked(layer_ref, x_ref, q_ref, s_ref, o_ref):
     scalar-prefetched layer index, no per-layer slice materialisation —
     same motivation as _qmm_kernel_1d_stacked."""
     o_ref[...] = _qmm4_body(x_ref[...], q_ref[0], s_ref[0], o_ref.dtype)
+
+
+def _qmm_kernel_experts_stacked(layer_ref, x_ref, q_ref, s_ref, o_ref):
+    """w8a16 expert stripe: one program = one expert's whole-contraction
+    [H, bo] tile from the [L, NE, H, O] pool at the scalar-prefetched
+    layer — the batched-expert twin of _qmm_kernel_1d_stacked. The
+    expert axis is the OUTER grid dim, so the per-expert x block
+    [C, H] is fetched once and the O/bo stripe walk streams under it."""
+    x = x_ref[0]                                   # [Cp, H] bf16
+    q = q_ref[0, 0].astype(x.dtype)                # [H, bo] int8 -> bf16
+    acc = jax.lax.dot(x, q, preferred_element_type=jnp.float32)
+    s = s_ref[0, 0, 0].astype(jnp.float32)         # [bo]
+    o_ref[0] = (acc * s[None, :]).astype(o_ref.dtype)
+
+
+def _qmm4_kernel_experts_stacked(layer_ref, x_ref, q_ref, s_ref, o_ref):
+    """w4a16 expert stripe over the [L, NE, K/2, O] packed pool — the
+    batched-expert twin of _qmm4_kernel_1d_stacked, sharing the
+    segment-walk body (and its odd-group support)."""
+    o_ref[0] = _qmm4_body(x_ref[0], q_ref[0, 0], s_ref[0, 0], o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -282,17 +329,51 @@ def _pick_1d_bo(rp: int, H: int, O: int, x_itemsize: int,
     return bo
 
 
+def int4_stripe_seg(K: int, ng: int) -> int | None:
+    """Segment width (in packed byte rows) of the w4a16 stripe walk for
+    contraction ``K`` with ``ng`` scale groups, or None if the kernels
+    cannot serve the grouping — the single coverage gate every int4
+    dispatch decision derives from (the expert-stripe table of the
+    round-18 MoE work; pick_int4_bo and _qmm4_body both consult it).
+
+    Even counts walk whole groups: seg = G, needing G % 128 == 0 for
+    lane-aligned x slices. Odd counts CANNOT walk whole groups — the
+    hi-nibble half starts at logical row ng*G/2, a half-group boundary,
+    so a whole-group segment would straddle two scales — they walk
+    half-groups instead: seg = G/2, needing G % 256 == 0 to keep the
+    half-width slices lane-aligned. G=64 shapes (and odd counts at
+    G=128) fall back to the XLA dequant path in models/quant.
+    """
+    if ng <= 0 or K % ng or K % 2:
+        return None
+    G = K // ng
+    if ng % 2 == 0:
+        return G if G % 128 == 0 else None
+    return G // 2 if G % 256 == 0 else None
+
+
+def pick_expert_bo(rows: int, H: int, O: int,
+                   x_itemsize: int) -> int | None:
+    """Output-block width for the w8a16 expert-stripe kernel, or None ->
+    models/quant.q_einsum keeps the XLA dequant path. The same budget /
+    tile-table search as the dense 1D grids, applied to ONE expert's
+    [C, H] bucket and [H, bo] stripe (there is no 2D fallback for the
+    expert grid — uncovered shapes are prefill-class and XLA's batched
+    einsum is the right tool there anyway)."""
+    rp = rows + ((-rows) % 8)
+    return _pick_1d_bo(rp, H, O, x_itemsize)
+
+
 def pick_int4_bo(rows: int, H: int, O: int, ng: int,
                  x_itemsize: int) -> int | None:
     """Output-block width for the w4a16 1D whole-stripe kernel, or None
-    -> models/quant.mm takes the dequant XLA fallback. Preconditions on
-    top of the shared budgets: an even group count (the split-half
-    packing pairs lo/hi groups per byte row) and 128-aligned groups
-    (the kernel's x slices must be lane-aligned; G=64 shapes fall back).
+    -> models/quant.mm takes the dequant XLA fallback. The coverage
+    gate is :func:`int4_stripe_seg` (even groups at G % 128 == 0, odd
+    at G % 256 == 0 — the round-18 fix: the old even-only gate rejected
+    odd expert group counts the segment walk now serves); the block
+    width then comes from the shared budget/tile-table search.
     """
-    if ng <= 0 or ng % 2 or H % ng:
-        return None
-    if (H // ng) % 128:
+    if int4_stripe_seg(H, ng) is None:
         return None
     rp = rows + ((-rows) % 8)
     return _pick_1d_bo(rp, H, O, x_itemsize, stripe_rows=H // 2)
@@ -431,3 +512,97 @@ def quant_matmul_stacked4(x: jax.Array, q: jax.Array, s: jax.Array,
         interpret=interpret,
     )(ly, x, q, s)
     return out[:rows] if pad else out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quant_matmul_experts_stacked(x: jax.Array, q: jax.Array, s: jax.Array,
+                                 layer: jax.Array, *,
+                                 interpret: bool = False) -> jax.Array:
+    """Batched per-expert ``x[e] @ dequant(q[layer, e], s[layer, e])``
+    reading the 4-D expert pool directly — the MoE twin of
+    :func:`quant_matmul_stacked`, for mixtral's capacity-bucket expert
+    matmuls (models/quant.q_einsum dispatches here for decode-shaped
+    buckets so the expert trunk streams int8 instead of an XLA dequant
+    of the whole [NE, H, O] stack).
+
+    x: [NE, C, H] expert buckets; q: [L, NE, H, O] int8;
+    s: [L, NE, 1, O] f32; layer: scalar int32. Returns [NE, C, O].
+    Caller guarantees ``pick_expert_bo`` accepts the shape.
+    """
+    NE, C, H = x.shape
+    O = q.shape[-1]
+    bo = pick_expert_bo(C, H, O, x.dtype.itemsize)
+    if bo is None:
+        raise ValueError(
+            f"expert w8a16 kernel does not cover C={C} H={H} O={O}; use "
+            "the XLA path (models/quant.q_einsum gates on pick_expert_bo)")
+    pad = (-C) % 8
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    cp = C + pad
+    ly = jnp.asarray(layer, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(NE, O // bo),
+        in_specs=[
+            pl.BlockSpec((1, cp, H), lambda e, i, ly: (e, 0, 0)),
+            pl.BlockSpec((1, 1, H, bo), lambda e, i, ly: (ly[0], e, 0, i)),
+            pl.BlockSpec((1, 1, 1, bo), lambda e, i, ly: (ly[0], e, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, cp, bo), lambda e, i, ly: (e, 0, i)),
+    )
+    out = pl.pallas_call(
+        _qmm_kernel_experts_stacked,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((NE, cp, O), x.dtype),
+        interpret=interpret,
+    )(ly, x, q, s)
+    return out[:, :C] if pad else out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quant_matmul_experts_stacked4(x: jax.Array, q: jax.Array, s: jax.Array,
+                                  layer: jax.Array, *,
+                                  interpret: bool = False) -> jax.Array:
+    """int4 twin of :func:`quant_matmul_experts_stacked`: the packed
+    [L, NE, H/2, O] expert pool streams at int4-packed bytes, unpacked
+    per stripe by the shared segment walk (odd expert group counts
+    included — mixtral-large's w_down groups at 256 into ng=45).
+
+    x: [NE, C, H]; q: [L, NE, H/2, O] int8 packed nibbles;
+    s: [L, NE, ng, O] f32 group scales; layer: scalar int32. Returns
+    [NE, C, O]. Caller guarantees :func:`pick_int4_bo` accepts the
+    per-expert shape.
+    """
+    NE, C, H = x.shape
+    O = q.shape[-1]
+    ng = s.shape[-2]
+    bo = pick_int4_bo(C, H, O, ng, x.dtype.itemsize)
+    if bo is None:
+        raise ValueError(
+            f"expert w4a16 kernel does not cover C={C} H={H} O={O} "
+            f"ng={ng}; use the XLA fallback (models/quant.q_einsum gates "
+            "on pick_int4_bo)")
+    pad = (-C) % 8
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    cp = C + pad
+    ly = jnp.asarray(layer, jnp.int32).reshape(1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(NE, O // bo),
+        in_specs=[
+            pl.BlockSpec((1, cp, H), lambda e, i, ly: (e, 0, 0)),
+            pl.BlockSpec((1, 1, H // 2, bo),
+                         lambda e, i, ly: (ly[0], e, 0, i)),
+            pl.BlockSpec((1, 1, ng, bo), lambda e, i, ly: (ly[0], e, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, cp, bo), lambda e, i, ly: (e, 0, i)),
+    )
+    out = pl.pallas_call(
+        _qmm4_kernel_experts_stacked,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((NE, cp, O), x.dtype),
+        interpret=interpret,
+    )(ly, x, q, s)
+    return out[:, :C] if pad else out

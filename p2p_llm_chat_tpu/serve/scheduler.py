@@ -449,7 +449,7 @@ class BatchScheduler:
         # bench sweeps do — but the LIVE programs keep whatever they
         # traced, so the gauge must report the compiled-in value, not
         # the current env).
-        self._paged_flash_min_w = self._flash_min_w()
+        self._paged_flash_min_w = self._flash_min_w(config.kv_dim)
         if admit_chunk is not None and admit_chunk < 1:
             raise ValueError(f"admit_chunk must be >= 1, got {admit_chunk}")
         self.admit_chunk = admit_chunk
@@ -608,6 +608,29 @@ class BatchScheduler:
         self._promote_done: "queue.Queue[tuple]" = queue.Queue()
         self._promote_pending: set = set()  # owned-by: _loop — submitted, not yet integrated
         self._promote_worker: Optional[threading.Thread] = None
+        # Round 18: the promotion worker ALSO ahead-of-time compiles
+        # (lower + compile, never execute) every admission program the
+        # new prefix will serve through — the splice jits donate the
+        # live cache/sampling buffers, so the worker can never RUN them,
+        # but AOT compilation touches only shapes. The executables merge
+        # into these loop-owned tables in _drain_promotions, BEFORE the
+        # entry goes live; _admit_chunk/_dispatch_prefill_chunk consult
+        # them ahead of the lazily-compiling jit wrappers. Measured
+        # before: the first prefix-hit admission after a mid-traffic
+        # promotion compiled its (P, S, R) splice ON the scheduler
+        # thread — a multi-second decode_stall_ms spike for every
+        # in-flight stream (the grain pre-warm only covers the smallest
+        # suffix bucket).
+        self._admit_prefix_aot: dict[tuple, object] = {}   # owned-by: _loop — (P,S,R) -> Compiled
+        self._prefill_chunk_aot: dict[tuple, object] = {}  # owned-by: _loop — (P0,S,off,C,R) -> Compiled
+        self._params_struct = None    # lazy jax.ShapeDtypeStruct tree of params
+        # Chunk widths promotions compile against before a warmup
+        # records the real set (mirrors warmup()'s chunk_sizes default).
+        if self.admit_chunk:
+            self._warmed_chunks: tuple[int, ...] = (self.admit_chunk,)
+        else:
+            self._warmed_chunks = tuple(sorted({
+                _MAX_ADMIT_CHUNK, max(self.num_slots, _MAX_ADMIT_CHUNK)}))
         # Fused multi-step decode state (tentpole of the wall/device-gap
         # work): the ramp remembers the last dispatched K, the counters
         # feed /metrics (decode_fused_* — realized K is steps/dispatches),
@@ -1860,6 +1883,10 @@ class BatchScheduler:
         # (_serving_bucket) — recorded only after every program compiled.
         def _record():
             self._warmed_buckets = buckets
+            # Promotion AOT builds mirror the warmed admission surface:
+            # the worker compiles one splice program per (warmed bucket,
+            # chunk-width) combo for the freshly promoted prefix length.
+            self._warmed_chunks = chunk_sizes
             # Long-window kernel ladder: name which warmed windows baked
             # in the multi-chunk flash-append kernel (W >= min_w on TPU
             # — ops/paged_attention._flash_append_policy). The windows
@@ -1868,7 +1895,8 @@ class BatchScheduler:
             # mid-serving never compiles over active streams.
             flash_note = ""
             if self.kv_mode == "paged":
-                min_w = self._paged_flash_min_w = self._flash_min_w()
+                min_w = self._paged_flash_min_w = self._flash_min_w(
+                    self.config.kv_dim)
                 kernel_ws = [w for w in windows if min_w and w >= min_w]
                 if kernel_ws:
                     flash_note = (f", flash-append kernel at windows "
@@ -1909,8 +1937,13 @@ class BatchScheduler:
 
     def _build_promotion(self) -> None:
         """Hand one queued prefix promotion to the build worker
-        (scheduler thread only). The worker computes the prefix KV off
-        the serving loop; _drain_promotions integrates the result."""
+        (scheduler thread only). The worker computes the prefix KV AND
+        ahead-of-time compiles the splice programs the new prefix will
+        admit through, both off the serving loop; _drain_promotions
+        integrates the results. The admission-shape combos and the
+        live-state shape skeletons are snapshotted HERE, on the
+        scheduler thread — metadata-only reads, but _warmed_buckets /
+        _chunk_shapes_run / the buffer trees are loop-owned."""
         self._last_promote_tick = self._n_decode_ticks
         head = self._promote_q.pop(0)
         if self._promote_worker is None:
@@ -1919,39 +1952,163 @@ class BatchScheduler:
                 name="prefix-promote")
             self._promote_worker.start()
         self._promote_pending.add(head)
-        self._promote_work.put(head)
+        self._promote_work.put((head, self._promotion_combos(len(head)),
+                                self._promotion_structs()))
+
+    def _promotion_combos(self, P: int) -> list[tuple]:
+        """Admission shapes a fresh prefix of length ``P`` can serve
+        through, mirroring warmup()'s prefix sub-ladder: one
+        (S, R, C, offs) per (warmed suffix bucket, chunk width) — offs
+        is the continuation-chunk offset ladder for chunked buckets,
+        None for single-shot. Shapes already compiled (a prior
+        promotion at the same grain, or the warmup grain pre-warm's
+        ladder recorded in _chunk_shapes_run) are skipped."""
+        C = self.prefill_chunk
+        combos: list[tuple] = []
+        for S in (getattr(self, "_warmed_buckets", None) or ()):
+            if P + S > self.max_seq:
+                continue
+            for R in self._chunks_for(P + S, self._warmed_chunks):
+                if C and S > C and S % C == 0:
+                    offs = tuple(
+                        off for off in range(0, S, C)
+                        if (P, S, off, C, R) not in self._chunk_shapes_run
+                        and (P, S, off, C, R) not in self._prefill_chunk_aot)
+                    if offs:
+                        combos.append((S, R, C, offs))
+                elif (P, S, R) not in self._admit_prefix_aot:
+                    combos.append((S, R, C, None))
+        return combos
+
+    def _promotion_structs(self) -> dict:
+        """Shape/dtype skeletons of the live serving state, captured on
+        the scheduler thread (metadata only — no device reads, no
+        buffer references escape to the worker beyond structs) so the
+        promotion worker can lower admission programs against exactly
+        the shapes/placements the loop will execute them with."""
+        def _sds(x):
+            return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
+                                        sharding=getattr(x, "sharding",
+                                                         None))
+        if self._params_struct is None:
+            # Params are immutable for the scheduler's lifetime.
+            self._params_struct = jax.tree.map(_sds, self._params)
+        return {
+            "params": self._params_struct,
+            "cache": jax.tree.map(_sds, self._cache),
+            "sample": jax.tree.map(_sds, (
+                self._keys, self._next_dev, self._temps_dev,
+                self._top_ks_dev, self._top_ps_dev, self._ring_dev,
+                self._rps_dev)),
+            "mppr": (self._cache.max_pages_per_row
+                     if self.kv_mode == "paged" else 0),
+        }
+
+    def _compile_promotion_aot(self, P: int, k, v, combos: list[tuple],
+                               structs: dict) -> tuple[dict, dict]:
+        """AOT-compile (lower + compile — never execute) the splice
+        programs for a promoted prefix of length ``P``. Runs on the
+        promotion worker thread: tracing and XLA compilation consume
+        only shape skeletons, so the donated live buffers the programs
+        will eventually run against are never touched off-loop; the
+        scheduler thread calls the returned executables with the real
+        arrays exactly as it would the jit wrappers."""
+        params_s, cache_s, sample_s = (structs["params"], structs["cache"],
+                                       structs["sample"])
+        ks = jax.ShapeDtypeStruct(k.shape, k.dtype)
+        vs = jax.ShapeDtypeStruct(v.shape, v.dtype)
+        paged = self.kv_mode == "paged"
+        aot_admit: dict[tuple, object] = {}
+        aot_chunks: dict[tuple, object] = {}
+        for S, R, C, offs in combos:
+            ints5 = jax.ShapeDtypeStruct((5, R), jnp.int32)
+            floats3 = jax.ShapeDtypeStruct((3, R), jnp.float32)
+            rings = jax.ShapeDtypeStruct((R, _RING), jnp.int32)
+            tables = (jax.ShapeDtypeStruct((R, structs["mppr"]), jnp.int32)
+                      if paged else None)
+            if offs is None:
+                args = [params_s, ks, vs,
+                        jax.ShapeDtypeStruct((R, S), jnp.int32), ints5,
+                        floats3, rings]
+                if paged:
+                    args.append(tables)
+                args += [cache_s, *sample_s]
+                aot_admit[(P, S, R)] = (
+                    self._admit_prefix_j.lower(*args).compile())
+                continue
+            toks = jax.ShapeDtypeStruct((R, C), jnp.int32)
+            carry_s = jax.eval_shape(
+                lambda R=R, W=P + S: KVCache.create(self.config, R, W,
+                                                    dtype=self._dtype))
+            logits_s = jax.ShapeDtypeStruct((R, self.config.vocab_size),
+                                            jnp.float32)
+            for off in offs:
+                prog = self._make_prefill_chunk_program(P, S, off, C)
+                if off == 0:
+                    args = [params_s, ks, vs, toks, ints5]
+                    if paged:
+                        args.append(tables)
+                    args.append(cache_s)
+                elif off + C < S:
+                    args = [params_s, toks, ints5, carry_s, logits_s]
+                    if paged:
+                        args.append(tables)
+                    args.append(cache_s)
+                else:
+                    args = [params_s, toks, ints5, floats3, rings, carry_s,
+                            logits_s]
+                    if paged:
+                        args.append(tables)
+                    args += [cache_s, *sample_s]
+                aot_chunks[(P, S, off, C, R)] = (
+                    prog.lower(*args).compile())
+        return aot_admit, aot_chunks
 
     def _promotion_worker(self) -> None:
-        """Daemon: builds promotion prefix KV off the scheduler thread.
-        Touches ONLY immutable state (params, the jitted builder — jit
-        call caches are thread-safe); results go back through
-        _promote_done for the scheduler thread to install."""
+        """Daemon: builds promotion prefix KV — and AOT-compiles the
+        admission programs that will splice it — off the scheduler
+        thread. Touches ONLY immutable state (params, the jitted
+        builder — jit call caches are thread-safe) plus the shape
+        skeletons snapshotted by _build_promotion; results go back
+        through _promote_done for the scheduler thread to install."""
         while True:
-            head = self._promote_work.get()
-            if head is None or self._closed.is_set():
+            item = self._promote_work.get()
+            if item is None or self._closed.is_set():
                 return
+            head, combos, structs = item
             try:
                 # Failpoint: a failed promotion build is dropped (it is
                 # an optimization) — serving must be untouched.
                 failpoint("serve.scheduler.promote")
                 k, v = self._build_prefix_kv(head)
-                self._promote_done.put((head, k, v))
+                aot_admit, aot_chunks = self._compile_promotion_aot(
+                    len(head), k, v, combos, structs)
+                self._promote_done.put((head, k, v, aot_admit, aot_chunks))
             except Exception:   # noqa: BLE001 — promotion is optional
                 log.exception("prefix promotion build failed")
-                self._promote_done.put((head, None, None))
+                self._promote_done.put((head, None, None, {}, {}))
 
     def _drain_promotions(self) -> None:
         """Install finished promotion builds (scheduler thread only —
-        keeps the store single-writer)."""
+        keeps the store and the AOT tables single-writer). The worker's
+        executables merge BEFORE the entry goes live: the very next
+        admission may hit the new prefix, and the contract is that it
+        dispatches an already-compiled program."""
         while True:
             try:
-                head, k, v = self._promote_done.get_nowait()
+                (head, k, v, aot_admit,
+                 aot_chunks) = self._promote_done.get_nowait()
             except queue.Empty:
                 return
             self._promote_pending.discard(head)
             if k is None:
                 continue
-            self._install_prefix(head, k, v, note=", promoted off-thread")
+            self._admit_prefix_aot.update(aot_admit)
+            self._prefill_chunk_aot.update(aot_chunks)
+            self._install_prefix(
+                head, k, v,
+                note=(f", promoted off-thread, "
+                      f"{len(aot_admit) + len(aot_chunks)} AOT programs"))
 
     def _chunks_for(self, footprint: int,
                     chunk_sizes: tuple[int, ...]) -> list[int]:
@@ -3096,18 +3253,23 @@ class BatchScheduler:
         return out
 
     @staticmethod
-    def _flash_min_w() -> int:
+    def _flash_min_w(hd: int) -> int:
         """Window threshold at which this process's paged decode
         programs dispatch the multi-chunk flash-append kernel instead of
         the gather path: 0 = cannot engage (CPU, disabled, block-kernel
-        override), 1 = the flash override (every window). One source of
-        truth: ops/paged_attention.effective_flash_min_w, next to the
-        dispatch policy itself."""
+        override), 1 = the flash override (every window). ``hd`` is the
+        model's per-token KV row width (kv_dim = num_kv_heads *
+        head_dim): narrow-KV models cross into the kernel at smaller
+        windows (round 18 — the gather path's per-token index/mask
+        overhead is geometry-invariant while its payload shrinks with
+        hd). One source of truth:
+        ops/paged_attention.effective_flash_min_w, next to the dispatch
+        policy itself."""
         import importlib
         # ops/__init__ rebinds `paged_attention` to the FUNCTION;
         # importlib reaches the module.
         _pa = importlib.import_module("p2p_llm_chat_tpu.ops.paged_attention")
-        return _pa.effective_flash_min_w()
+        return _pa.effective_flash_min_w(hd)
 
     def _try_reserve(self, slot: _Slot) -> bool:
         """Paged mode: claim the slot's page budget (prompt + generation
@@ -3463,11 +3625,16 @@ class BatchScheduler:
         if prefix is not None:
             self._n_prefix_admits += len(chunk)
             self._n_prefix_tokens += P * len(chunk)
+            # A promotion-built AOT executable (exact (P, S, R) match)
+            # dispatches ahead of the jit wrapper — same signature, but
+            # compiled on the worker thread instead of here.
+            prog = self._admit_prefix_aot.get((P, S, R),
+                                              self._admit_prefix_j)
             if self.kv_mode == "paged":
                 (toks_dev, self._cache, self._keys, self._next_dev,
                  self._temps_dev, self._top_ks_dev, self._top_ps_dev,
                  self._ring_dev, self._rps_dev) = \
-                    self._admit_prefix_j(
+                    prog(
                         self._params, prefix.k, prefix.v,
                         jnp.asarray(tokens), jnp.asarray(ints),
                         jnp.asarray(floats), jnp.asarray(rings),
@@ -3478,7 +3645,7 @@ class BatchScheduler:
                 (toks_dev, self._cache, self._keys, self._next_dev,
                  self._temps_dev, self._top_ks_dev, self._top_ps_dev,
                  self._ring_dev, self._rps_dev) = \
-                    self._admit_prefix_j(
+                    prog(
                         self._params, prefix.k, prefix.v,
                         jnp.asarray(tokens), jnp.asarray(ints),
                         jnp.asarray(floats), jnp.asarray(rings),
@@ -3676,11 +3843,15 @@ class BatchScheduler:
         Returns (carry_kv, carry_logits, None) for a non-final chunk and
         (None, None, first_tokens_dev) for the final one."""
         first, final = off == 0, off + C == S
-        prog = self._prefill_chunk_for(P0, S, off, C)
+        shape_key = (P0, S, off, C, tokens.shape[0])
+        # Promotion-built AOT executables (keyed by the full R-specific
+        # shape) dispatch ahead of the per-(P0,S,off,C) jit wrappers.
+        prog = self._prefill_chunk_aot.get(shape_key)
+        if prog is None:
+            prog = self._prefill_chunk_for(P0, S, off, C)
         t = jnp.asarray(np.ascontiguousarray(tokens))
         ij = jnp.asarray(ints)
         paged = self.kv_mode == "paged"
-        shape_key = (P0, S, off, C, tokens.shape[0])
         if first:
             args = [self._params]
             if P0:

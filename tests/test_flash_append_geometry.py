@@ -74,6 +74,12 @@ def _check_case(cfg_name, pages, ps, lengths, quantized, monkeypatch,
     rng = np.random.default_rng(seed)
     if chunk_bytes is not None:
         monkeypatch.setattr(pa, "_FLASH_CHUNK_TOK_BYTES", chunk_bytes)
+    # Pin the calibration geometry AT the test config's hd so the
+    # round-18 hd-aware scaling is identity here and the chunk layouts
+    # documented per case (pages/chunk, boundary positions) hold
+    # exactly; the scaling itself is pinned by the policy-table test.
+    monkeypatch.setattr(pa, "_FLASH_HD_REF",
+                        cfg.num_kv_heads * cfg.head_dim)
     monkeypatch.setattr(pa, "_APPEND_IMPL", "gather")  # pin the oracle path
     cache = _filled_cache(cfg, pages, ps, lengths, quantized, rng)
     B = len(lengths)
@@ -170,6 +176,26 @@ def test_dispatch_policy_table(monkeypatch):
     # Explicit impl overrides win in both directions.
     assert pa._flash_append_policy(64, "flash", 2048)
     assert not pa._flash_append_policy(1 << 20, "kernel", 2048)
+    # Geometry scaling (round-18): the boundary is min_w * hd / 1024.
+    # At the calibration geometry (hd=1024) nothing changes; at
+    # bench-moe's narrow KV (4 kv heads x 128 = 512) it halves to 1024
+    # — the window regime where the recorded ~1.3 ms MoE paged-walk gap
+    # lived; at 70B-class hd=1024 it is identity again.
+    assert pa._flash_append_policy(2048, "gather", 2048, hd=1024)
+    assert not pa._flash_append_policy(1024, "gather", 2048, hd=1024)
+    assert pa._flash_append_policy(1024, "gather", 2048, hd=512)
+    assert not pa._flash_append_policy(1023, "gather", 2048, hd=512)
+    assert pa._flash_append_policy(512, "gather", 2048, hd=256)
+    # The floor: no geometry engages below 256 tokens on the default
+    # rule (sub-2-chunk grids cannot pipeline).
+    assert not pa._flash_append_policy(255, "gather", 2048, hd=32)
+    assert pa._flash_append_policy(256, "gather", 2048, hd=32)
+    # Wider-than-calibration KV raises the bar symmetrically.
+    assert not pa._flash_append_policy(2048, "gather", 2048, hd=2048)
+    assert pa._flash_append_policy(4096, "gather", 2048, hd=2048)
+    # Overrides ignore geometry.
+    assert pa._flash_append_policy(64, "flash", 2048, hd=2048)
+    assert not pa._flash_append_policy(1 << 20, "kernel", 2048, hd=256)
     # Runtime toggle: read through utils/env at dispatch time.
     monkeypatch.setenv("PAGED_APPEND_FLASH_MIN_W", "4096")
     assert pa._flash_append_min_w() == 4096

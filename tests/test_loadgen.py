@@ -21,6 +21,7 @@ a durable E2E row with a computed verdict. ci.sh runs it in full mode.
 
 import json
 import os
+import random
 import subprocess
 import sys
 import time
@@ -232,6 +233,67 @@ def test_empty_stream_classification(stub):
     assert s_row["error"] == 0 and s_row["truncated"] == 0
     assert row["empty"] == len(recs) and row["bad"] == 0
     assert not any("error+truncated" in v for v in s_row["violations"])
+
+
+def _multi_model_steps(n):
+    ep = Endpoints(serve_url="http://serve")
+    return [REGISTRY["multi_model"].build(random.Random(i), 0, ep)[0]
+            for i in range(n)]
+
+
+def test_multi_model_resolves_tags_and_split(monkeypatch):
+    """LOADGEN_MODELS names the two SERVE_MODELS tags; each arrival's
+    seeded rng picks one at the fixed 3:1 split, and the payload's
+    model field always matches the phase tag the ledger judges under
+    (model_a = first tag, model_b = second)."""
+    monkeypatch.setenv("LOADGEN_MODELS", "tiny, moe")
+    steps = _multi_model_steps(400)
+    counts = {"model_a": 0, "model_b": 0}
+    for s in steps:
+        assert s.measured and s.stream
+        assert s.payload["model"] == \
+            {"model_a": "tiny", "model_b": "moe"}[s.phase]
+        counts[s.phase] += 1
+    assert counts["model_b"] > 0
+    frac = counts["model_a"] / len(steps)
+    assert 0.65 < frac < 0.85           # MULTI_MODEL_SPLIT = 0.75
+
+
+def test_multi_model_degrades_without_models_env(monkeypatch):
+    # Unset: no model field at all — the engine's default serves both
+    # classes, phases still tag (single-model runs stay judgeable).
+    monkeypatch.delenv("LOADGEN_MODELS", raising=False)
+    steps = _multi_model_steps(40)
+    assert all("model" not in s.payload for s in steps)
+    assert {s.phase for s in steps} == {"model_a", "model_b"}
+    # One tag: both classes pin it — the split measures one model.
+    monkeypatch.setenv("LOADGEN_MODELS", "only")
+    steps = _multi_model_steps(40)
+    assert all(s.payload["model"] == "only" for s in steps)
+
+
+def test_multi_model_ledger_judges_per_model_phases(stub, monkeypatch):
+    """Driven end-to-end through the stub (which ignores the model
+    field, as a single-model front would): the ledger row carries BOTH
+    per-model phase judgements, each with its own SLO — the
+    heterogeneous-fleet attribution the scenario exists for."""
+    monkeypatch.setenv("LOADGEN_MODELS", "tiny,moe")
+    s = stub(deltas=2)
+    recs = _drive(s, _serve_only(s), mix="multi_model=1", rate=60.0,
+                  dur=0.8)
+    assert recs and all(r.status == "ok" for r in recs)
+    assert all(set(r.phase_ttft_ms) <= {"model_a", "model_b"}
+               and len(r.phase_ttft_ms) == 1 for r in recs)
+    row = build_ledger(recs, {"multi_model": REGISTRY["multi_model"]},
+                       duration_s=0.8)
+    phases = row["scenarios"]["multi_model"]["phases"]
+    assert set(phases) == {"model_a", "model_b"}
+    assert phases["model_a"]["n"] + phases["model_b"]["n"] == \
+        sum(1 for r in recs if r.status == "ok")
+    assert phases["model_a"]["n"] > phases["model_b"]["n"] > 0
+    # Each class judged against ITS OWN budget, not a blend.
+    assert phases["model_b"]["slo"]["ttft_p95_ms"] > \
+        phases["model_a"]["slo"]["ttft_p95_ms"]
 
 
 def test_open_loop_arrivals_fire_on_schedule_despite_stall(stub):

@@ -405,6 +405,10 @@ def test_flash_append_kernel_interpret_matches_gather(monkeypatch):
     pa = importlib.import_module("p2p_llm_chat_tpu.ops.paged_attention")
     monkeypatch.setattr(pa, "_FLASH_CHUNK_TOK_BYTES", 64)  # 16 f32 tokens
     cfg = get_config("tiny-tp")     # 4 kv heads, head_dim 32
+    # Identity hd scaling at the test geometry (see
+    # test_flash_append_geometry._check_case).
+    monkeypatch.setattr(pa, "_FLASH_HD_REF",
+                        cfg.num_kv_heads * cfg.head_dim)
     rng = np.random.default_rng(7)
     B, pages, ps = 4, 3, 16
     mppr = pages

@@ -360,3 +360,52 @@ def test_midtraffic_warmup_does_not_perturb_live_seeded_stream(spec_k):
             eng.stop()
 
     assert serve_once(True) == serve_once(False)
+
+
+def test_promotion_aot_compiles_admission_off_scheduler_thread():
+    """Round 18: an auto-promoted prefix must admit through a program
+    the promotion WORKER compiled ahead of time — the splice jit's call
+    cache must not grow when the first post-promotion prefix-hit
+    admission dispatches at a suffix bucket the warmup grain pre-warm
+    did not cover (the pre-warm only runs the SMALLEST bucket; a lazy
+    compile here lands the whole multi-second XLA compile inside
+    decode_stall_ms for every in-flight stream)."""
+    import time
+
+    eng = TPUEngine(PARAMS, CFG, TOK, num_slots=2, max_seq=256,
+                    prefix_texts=())
+    try:
+        eng.warmup(buckets=(64, 128))
+        sched = eng.scheduler
+        store = sched._prefix
+        head = "z y x w v u t s r q " * 5          # 100 chars -> grain 64
+        # Two short-tail sightings promote the 64-id head.
+        for tail in ("alpha", "beta"):
+            p = head + tail
+            text, _ = run(eng, p, max_tokens=8)
+            assert text == oracle(p, 8)
+        deadline = time.monotonic() + 30
+        while len(store) < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(store) == 1, "head never promoted"
+        # The worker's AOT programs merged with the install — including
+        # the 128 suffix bucket no pre-warm covers (single-shot at the
+        # default prefill_chunk=256: 128 is not chunkable).
+        assert any(k[0] == 64 and k[1] == 128
+                   for k in sched._admit_prefix_aot), \
+            sorted(sched._admit_prefix_aot)
+        n_before = sched._admit_prefix_j._cache_size()
+        chunk_keys = set(sched._prefill_chunk_programs)
+        # Third prompt: same head, 60-char tail -> 97-token suffix ->
+        # the 128 bucket. Must admit through the cached prefix WITHOUT
+        # growing any scheduler-thread compile cache.
+        p = head + "the quick brown fox jumps over the lazy dog again and more"
+        text, _ = run(eng, p, max_tokens=8)
+        assert text == oracle(p, 8)
+        m = sched.metrics_snapshot()
+        assert m["serve_prefix_admits_total"] >= 1
+        assert sched._admit_prefix_j._cache_size() == n_before, \
+            "prefix-hit admission compiled on the scheduler thread"
+        assert set(sched._prefill_chunk_programs) == chunk_keys
+    finally:
+        eng.stop()

@@ -1,0 +1,230 @@
+"""Decision-matrix tests for the quantized-matmul dispatch gates.
+
+Round 18 moved every int4 coverage decision onto ONE derivation —
+ops/quant_mm.int4_stripe_seg, the expert-stripe segment table — and
+added the expert-pool (4-D) dispatch to models/quant.q_einsum. These
+tests pin the decisions themselves (pure host logic, no kernels), so a
+future budget/table tweak that silently flips a production shape from
+Pallas to the XLA dequant fallback (or vice versa) fails loudly here
+rather than showing up as a bench regression three rounds later.
+
+The shapes named below are the production ones: bench-moe
+(H=1024, F=2816) and mixtral-large (H=4096, F=11520 = 45*256 = 90*128)
+expert leaves, plus the dense regression shapes the tile table was
+measured on.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2p_llm_chat_tpu.models import quant
+from p2p_llm_chat_tpu.models.quant import (LayerSlice, QTensor, QTensor4,
+                                           _int4_group, q_einsum)
+from p2p_llm_chat_tpu.ops import quant_mm as qmm
+
+
+# -- int4_stripe_seg: the single int4 coverage gate ---------------------------
+
+@pytest.mark.parametrize("K,ng,seg", [
+    # even group counts walk whole groups (G % 128 == 0)
+    (1024, 8, 128),       # dense decode trunk, G=128
+    (11520, 90, 128),     # mixtral-large w_down at group 128
+    (2816, 22, 128),      # bench-moe w_down, G=128
+    (4096, 32, 128),      # mixtral-large wgu_e contraction
+    # odd group counts walk half-groups (G % 256 == 0)
+    (11520, 45, 128),     # mixtral-large w_down at group 256 -> seg G/2
+    (2816, 11, 128),      # bench-moe w_down at group 256 -> seg G/2
+    (512, 1, 256),        # single group, odd -> half of G=512
+    # rejections: the kernels cannot serve these groupings
+    (512, 8, None),       # G=64: even but not lane-aligned
+    (1152, 9, None),      # odd at G=128: hi-half straddles scales
+    (384, 3, None),       # odd at G=128 (small)
+    (1023, 3, None),      # odd K: no packed byte rows
+    (1000, 3, None),      # ng does not divide K
+    (1024, 0, None),      # no groups
+])
+def test_int4_stripe_seg_matrix(K, ng, seg):
+    assert qmm.int4_stripe_seg(K, ng) == seg
+
+
+def test_int4_stripe_seg_segment_covers_one_scale_group():
+    """Both halves of every segment must land inside a single scale
+    group — the invariant _qmm4_body's walk rests on. Checked over the
+    full production grid rather than argued once in a comment."""
+    for K, ng in [(11520, 45), (11520, 90), (2816, 11), (2816, 22),
+                  (4096, 32), (1024, 8), (512, 1)]:
+        seg = qmm.int4_stripe_seg(K, ng)
+        if seg is None:
+            continue
+        G = K // ng
+        half = K // 2
+        for t in range(half // seg):
+            lo_rows = (t * seg, (t + 1) * seg - 1)
+            hi_rows = (half + t * seg, half + (t + 1) * seg - 1)
+            assert lo_rows[0] // G == lo_rows[1] // G, (K, ng, t)
+            assert hi_rows[0] // G == hi_rows[1] // G, (K, ng, t)
+
+
+# -- _int4_group: the grouping chooser the gate must agree with ---------------
+
+@pytest.mark.parametrize("K,expert,group", [
+    (11520, True, 256),    # real expert scale: halve the f32 scale rows
+    (11520, False, 128),   # dense trunk keeps the finer grouping
+    (4096, True, 128),     # expert but below the 8192 floor
+    (4096, False, 128),
+    (192, False, 64),      # small leaves fall to group 64
+    (191, False, None),    # odd K: int8 fallback
+])
+def test_int4_group_choice(K, expert, group):
+    assert _int4_group(K, expert) == group
+
+
+def test_int4_group_choices_are_kernel_servable():
+    """Every grouping _int4_group can emit for a kernel-sized K must be
+    one int4_stripe_seg accepts — quantize-time choice and dispatch-time
+    gate derive from the same table, so a leaf quantized for the kernel
+    can never be silently forced onto the XLA path by its own grouping
+    (the round-18 fix: group 256 at K=11520 yields ng=45, odd, which the
+    old even-only gate rejected)."""
+    for K in (1024, 2816, 4096, 11520, 28672):
+        for expert in (False, True):
+            G = _int4_group(K, expert)
+            if G is None or G == 64:
+                continue   # 64 is the declared XLA-only grouping
+            assert qmm.int4_stripe_seg(K, K // G) is not None, (K, expert)
+
+
+# -- block-width picks at the production shapes -------------------------------
+
+def test_tile_table_pinned_entries():
+    """The measured per-hidden-size caps (rounds 16-18). A removal or
+    retune shows up here first, with the bench row that justified it."""
+    assert qmm._TILE_TABLE[1024] == 256     # round-16 dense decode trunk
+    assert qmm._TILE_TABLE[2816] == 128     # bench-moe w_down: avoid 1-program grid
+    assert qmm._TILE_TABLE[11520] == 256    # mixtral-large w_down, budget-derived
+
+
+@pytest.mark.parametrize("rows,H,O,bo", [
+    (16, 4096, 23040, 512),    # mixtral-large wgu_e (O = 2F)
+    (16, 11520, 4096, 256),    # mixtral-large w_down (tile-table cap)
+    (8, 1024, 5632, 256),      # bench-moe wgu_e (cap via H=1024)
+    (8, 2816, 1024, 128),      # bench-moe w_down (cap avoids bo=O)
+    (2048, 11520, 4096, None),  # prefill-class rows blow the x budget
+])
+def test_pick_expert_bo_matrix(rows, H, O, bo):
+    assert qmm.pick_expert_bo(rows, H, O, 2) == bo
+
+
+@pytest.mark.parametrize("rows,H,O,ng,bo", [
+    (16, 11520, 4096, 45, 256),   # mixtral-large w_down, group 256 (odd walk)
+    (16, 11520, 4096, 90, 256),   # same leaf quantized at group 128
+    (16, 4096, 23040, 32, 512),   # mixtral-large wgu_e, group 128
+    (8, 2816, 1024, 11, 128),     # bench-moe w_down, group 256 (odd walk)
+    (8, 512, 512, 8, None),       # G=64: gate rejects
+    (8, 1152, 512, 9, None),      # odd at G=128: gate rejects
+])
+def test_pick_int4_bo_matrix(rows, H, O, ng, bo):
+    assert qmm.pick_int4_bo(rows, H, O, ng, 2) == bo
+
+
+# -- q_einsum expert-pool dispatch decisions ----------------------------------
+
+def _expert_pool_int8(L=2, NE=2, H=256, F=512, seed=0):
+    r = np.random.default_rng(seed)
+    q = r.integers(-127, 128, size=(L, NE, H, F), dtype=np.int8)
+    s = (r.random((L, NE, 1, F), np.float32) * 0.02 + 0.01)
+    return QTensor(q=jnp.asarray(q), s=jnp.asarray(s))
+
+
+def _expert_pool_int4(L=2, NE=2, H=512, F=512, ng=1, seed=0):
+    r = np.random.default_rng(seed)
+    q = r.integers(0, 256, size=(L, NE, H // 2, F), dtype=np.uint8)
+    s = (r.random((L, NE, ng, F), np.float32) * 0.02 + 0.01)
+    return QTensor4(q=jnp.asarray(q.astype(np.int8)), s=jnp.asarray(s))
+
+
+def _spy(monkeypatch, name):
+    """Replace the named ops.quant_mm expert kernel with a recorder that
+    returns a correctly-shaped dummy (the dispatch sites re-import from
+    the module on every call, so the monkeypatch is what they fetch)."""
+    calls = []
+
+    def fake(x, q, s, layer, **kw):
+        calls.append((x.shape, q.shape, int(layer) if np.ndim(layer) == 0
+                      else layer))
+        return jnp.zeros(x.shape[:2] + (q.shape[-1],), x.dtype)
+
+    monkeypatch.setattr(qmm, name, fake)
+    return calls
+
+
+@pytest.fixture
+def on_tpu(monkeypatch):
+    """Make _kernel_wanted() answer True on the CPU test host (the
+    backend probe is cached; the decision logic under test is
+    backend-independent)."""
+    monkeypatch.setattr(quant, "_BACKEND_IS_TPU", True)
+    monkeypatch.setattr(quant, "_FORCE_XLA", False)
+
+
+def test_expert_dispatch_int8_pool_hits_kernel(on_tpu, monkeypatch):
+    calls = _spy(monkeypatch, "quant_matmul_experts_stacked")
+    w = _expert_pool_int8()
+    x = jnp.ones((2, 8, 256), jnp.float32)
+    y = q_einsum("ech,ehf->ecf", x, LayerSlice(w, 1))
+    assert y.shape == (2, 8, 512)
+    assert len(calls) == 1 and calls[0][2] == 1
+
+
+def test_expert_dispatch_int4_pool_hits_kernel(on_tpu, monkeypatch):
+    calls = _spy(monkeypatch, "quant_matmul_experts_stacked4")
+    w = _expert_pool_int4()              # H=512, ng=1 -> odd walk, seg 256
+    x = jnp.ones((2, 8, 512), jnp.float32)
+    y = q_einsum("ech,ehf->ecf", x, LayerSlice(w, 0))
+    assert y.shape == (2, 8, 512)
+    assert len(calls) == 1 and calls[0][2] == 0
+
+
+@pytest.mark.parametrize("reason,spec,xshape", [
+    # spec not in the family / x not expert-batched: broadcast-style
+    # einsums (one token bucket against every expert) are legal through
+    # the eager path but are NOT a per-expert batched matmul.
+    ("x is not expert-batched (2-D)", "ch,ehf->ecf", (8, 256)),
+    ("prefill-class token count", "ech,ehf->ecf", (2, 513, 256)),
+])
+def test_expert_dispatch_falls_back(on_tpu, monkeypatch, reason, spec,
+                                    xshape):
+    calls = _spy(monkeypatch, "quant_matmul_experts_stacked")
+    w = _expert_pool_int8(H=256, F=512)
+    x = jnp.ones(xshape, jnp.float32)
+    y = q_einsum(spec, x, LayerSlice(w, 0))
+    assert not calls, reason
+    assert y.shape[-1] == 512             # fallback still produced output
+
+
+def test_expert_dispatch_int4_rejected_grouping_falls_back(on_tpu,
+                                                           monkeypatch):
+    """A pool whose grouping the stripe table cannot serve (G=64) must
+    take the dequant fallback even when the kernel is wanted."""
+    calls = _spy(monkeypatch, "quant_matmul_experts_stacked4")
+    w = _expert_pool_int4(H=512, ng=8)    # G=64 -> int4_stripe_seg None
+    x = jnp.ones((2, 8, 512), jnp.float32)
+    y = q_einsum("ech,ehf->ecf", x, LayerSlice(w, 0))
+    assert not calls
+    assert y.shape == (2, 8, 512)
+
+
+def test_expert_dispatch_cpu_fallback_matches_eager_slice():
+    """On the actual CPU backend (no monkeypatch) the LayerSlice expert
+    path must be bit-identical to slicing the layer eagerly and running
+    the plain quantized einsum — the pre-round-18 behavior."""
+    w = _expert_pool_int8(L=3)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal(
+        (2, 8, 256)).astype(np.float32))
+    for layer in range(3):
+        got = q_einsum("ech,ehf->ecf", x, LayerSlice(w, layer))
+        ref = q_einsum("ech,ehf->ecf", x, QTensor(q=w.q[layer],
+                                                  s=w.s[layer]))
+        assert jnp.array_equal(got, ref), layer

@@ -27,15 +27,18 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from p2p_llm_chat_tpu.models.quant import (QTensor, QTensor4,  # noqa: E402
-                                           dequantize, dequantize4,
-                                           quantize, quantize4)
+                                           _int4_group, dequantize,
+                                           dequantize4, quantize, quantize4)
 from p2p_llm_chat_tpu.ops.quant_mm import (_pick_1d_bo,  # noqa: E402
-                                           pick_int4_bo, quant_matmul,
-                                           quant_matmul4,
+                                           pick_expert_bo, pick_int4_bo,
+                                           quant_matmul, quant_matmul4,
+                                           quant_matmul_experts_stacked,
+                                           quant_matmul_experts_stacked4,
                                            quant_matmul_stacked,
                                            quant_matmul_stacked4)
 
 ROWS = 32          # serving decode batch
+EXPERT_ROWS = 16   # per-expert capacity bucket at decode (B=32, top-2/8)
 STEPS = 20
 
 
@@ -119,6 +122,80 @@ def run4(H: int, O: int, L: int = 2) -> None:
         f"_TILE_TABLE (ops/quant_mm.py)"
 
 
+def run_experts8(H: int, O: int, NE: int = 8, L: int = 2) -> None:
+    """w8a16 grouped expert dispatch (round 18): the per-expert stripe
+    walk vs the forced-XLA dequant einsum at decode-class capacity."""
+    rng = np.random.default_rng(H + O + 2)
+    x = jnp.asarray(rng.standard_normal((NE, EXPERT_ROWS, H), np.float32),
+                    jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((L, NE, H, O), np.float32))
+    qt = quantize(w)
+    del w
+    assert pick_expert_bo(EXPERT_ROWS, H, O, 2) is not None, \
+        f"expert kernel must cover H={H} O={O}"
+
+    xla = jax.jit(lambda x, q, s: jnp.einsum(
+        "ech,ehf->ecf", x, q.astype(x.dtype)) * s)
+    for layer in (0, L - 1):
+        got = np.asarray(quant_matmul_experts_stacked(x, qt.q, qt.s, layer),
+                         np.float32)
+        ref = np.asarray(xla(x, qt.q[layer], qt.s[layer]), np.float32)
+        err = np.max(np.abs(got - ref))
+        denom = np.max(np.abs(ref)) or 1.0
+        print(f"int8 experts H={H} O={O} layer={layer}: rel "
+              f"{err / denom:.5f}")
+        assert err / denom < 2e-2, "w8a16 expert kernel diverges"
+
+    k_ms = _time_ms(lambda: quant_matmul_experts_stacked(x, qt.q, qt.s, 1))
+    x_ms = _time_ms(lambda: xla(x, qt.q[1], qt.s[1]))
+    bo = pick_expert_bo(EXPERT_ROWS, H, O, 2)
+    print(f"int8 experts H={H} O={O} NE={NE} (bo={bo}): kernel "
+          f"{k_ms:.4f} ms vs XLA {x_ms:.4f} ms ({x_ms / k_ms:.2f}x)")
+    assert k_ms <= x_ms * 1.02, \
+        f"w8a16 expert kernel loses to forced XLA at H={H} O={O} — " \
+        f"retune _TILE_TABLE (ops/quant_mm.py)"
+
+
+def run_experts4(H: int, O: int, NE: int = 8, L: int = 2) -> None:
+    """w4a16 grouped expert dispatch at the grouping quantize-time
+    chooses for expert leaves — at mixtral-large's H=11520 that is
+    group 256 => ng=45, the ODD group count whose half-group segment
+    walk round 18 added."""
+    group = _int4_group(H, True)
+    assert group is not None, f"_int4_group must serve expert H={H}"
+    rng = np.random.default_rng(H + O + 3)
+    x = jnp.asarray(rng.standard_normal((NE, EXPERT_ROWS, H), np.float32),
+                    jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((L, NE, H, O), np.float32))
+    qt = quantize4(w, group=group)
+    del w
+    ng = qt.s.shape[-2]
+    bo = pick_int4_bo(EXPERT_ROWS, H, O, ng, 2)
+    assert bo is not None, \
+        f"w4a16 expert kernel must cover H={H} O={O} ng={ng}"
+
+    xla = jax.jit(lambda x, q, s: jnp.einsum(
+        "ech,ehf->ecf", x, dequantize4(QTensor4(q=q, s=s), x.dtype)))
+    for layer in (0, L - 1):
+        got = np.asarray(
+            quant_matmul_experts_stacked4(x, qt.q, qt.s, layer), np.float32)
+        ref = np.asarray(xla(x, qt.q[layer], qt.s[layer]), np.float32)
+        err = np.max(np.abs(got - ref))
+        denom = np.max(np.abs(ref)) or 1.0
+        print(f"int4 experts H={H} O={O} ng={ng} layer={layer}: rel "
+              f"{err / denom:.5f}")
+        assert err / denom < 2e-2, "w4a16 expert kernel diverges"
+
+    k_ms = _time_ms(lambda: quant_matmul_experts_stacked4(x, qt.q, qt.s, 1))
+    x_ms = _time_ms(lambda: xla(x, qt.q[1], qt.s[1]))
+    print(f"int4 experts H={H} O={O} NE={NE} (bo={bo}, ng={ng}"
+          f"{', odd walk' if ng % 2 else ''}): kernel {k_ms:.4f} ms vs "
+          f"XLA {x_ms:.4f} ms ({x_ms / k_ms:.2f}x)")
+    assert k_ms <= x_ms * 1.02, \
+        f"w4a16 expert kernel loses to forced XLA at H={H} O={O} — " \
+        f"retune _TILE_TABLE (ops/quant_mm.py)"
+
+
 if __name__ == "__main__":
     # (H, O) per serving config's decode projections: draft-400m's
     # H=1024 trunk (wqkv-fused 2048 and the 4096 MLP — the _TILE_TABLE
@@ -128,4 +205,12 @@ if __name__ == "__main__":
                  (4096, 4096), (4096, 28672)):
         run8(H, O)
         run4(H, O)
+    # MoE expert pools (round 18): bench-moe's fused wgu_e [H=1024,
+    # O=2F=5632] and w_down [2816, 1024], then mixtral-large's real
+    # expert scale — wgu_e [4096, 23040] and w_down [11520, 4096], the
+    # int4 odd-group-count walk (group 256 => ng=45).
+    for H, O in ((1024, 5632), (2816, 1024), (4096, 23040),
+                 (11520, 4096)):
+        run_experts8(H, O)
+        run_experts4(H, O)
     print("quant kernel parity + timing OK")
