@@ -156,8 +156,19 @@ if [ "${1:-}" = "full" ]; then
   echo "== quantization: int8 + int4 full matrix (CPU)"
   JAX_PLATFORMS=cpu python -m pytest tests/test_quant.py -q || rc=1
 
+  # Peer churn (round 20): the WHOLE file — the in-process exactly-once
+  # oracle and failpoint contracts, the slow-marked SIGKILL/SIGTERM
+  # process-kill matrix, and the chaos leg: 8 real node processes under
+  # peer_churn traffic with p2p.node.deliver=raise@0.2 armed and a
+  # NodeChurnWindow SIGKILL/respawn pulse — zero lost messages, zero
+  # duplicates, outbox drop ledger flat. Excluded from the sweep below
+  # so each case executes exactly once.
+  echo "== peer churn: at-least-once delivery chaos leg (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_node_churn.py -q || rc=1
+
   echo "== full test suite"
   python -m pytest tests/ -q \
+    --ignore=tests/test_node_churn.py \
     --ignore=tests/test_spec_tree.py \
     --ignore=tests/test_quant.py \
     --ignore=tests/test_flash_append_geometry.py \
@@ -314,8 +325,19 @@ else
   JAX_PLATFORMS=cpu python -m pytest tests/test_moe_expert_kernels.py \
     tests/test_qmm_tile_table_dispatch.py -q -x || rc=1
 
+  # Peer churn (round 20, tier-1 legs): at-least-once outbox across a
+  # graceful restart (byte-identical, in-order, exactly-once), dedup /
+  # overflow / TTL drop accounting, directory liveness eviction, and
+  # the deliver/resolve/evict failpoint contracts. The slow-marked
+  # process-kill matrix and the 8-process chaos leg run in full mode.
+  # Excluded from the sweep below so each case executes exactly once.
+  echo "== peer churn: at-least-once outbox + directory liveness (CPU)"
+  JAX_PLATFORMS=cpu python -m pytest tests/test_node_churn.py -q -x \
+    -m 'not slow' || rc=1
+
   echo "== fast suite (chat plane + serving contracts)"
   python -m pytest tests/ -q -x \
+    --ignore=tests/test_node_churn.py \
     --ignore=tests/test_spec_tree.py \
     --ignore=tests/test_quant.py \
     --ignore=tests/test_moe_expert_kernels.py \

@@ -11,8 +11,15 @@ at :62-78, /lookup at :80-92) and the node-side DirectoryClient
   "last"}`` or 404 ``{"error":"not found"}`` (directory main.go:80-92).
 - ``Last`` timestamp recorded on register. The reference records it but never
   evicts (SURVEY.md §2 C5); we additionally support optional TTL-based
-  eviction at lookup time (off by default for contract parity), fixing the
-  stale-entry gap the reference's README punts on.
+  liveness (``DIR_TTL_S``, off by default for contract parity), fixing the
+  stale-entry gap the reference's README punts on: a sweep thread evicts
+  records whose heartbeat (node re-register) lapsed past the TTL, and
+  ``/lookup`` 404s expired entries it races ahead of the sweep. Evictions
+  are counted (``directory_evictions_total`` on ``GET /metrics``) and carry
+  the ``p2p.directory.evict`` failpoint so the chaos suite can stall the
+  sweep. ``POST /deregister`` removes a record on graceful node shutdown
+  (guarded by peer_id so a late deregister can't kill a successor's fresh
+  registration).
 
 Deliberate fix vs the reference: register bodies are built with a real JSON
 encoder — the reference interpolates usernames into JSON via fmt.Sprintf
@@ -29,10 +36,11 @@ from typing import Optional
 
 from .proto import now_rfc3339, parse_ts
 from .utils.backoff import with_retries
-from .utils.env import env_or
+from .utils.env import env_float, env_or
 from .utils.failpoints import failpoint, load_env as load_failpoints_env
 from .utils.http import HttpServer, Request, Response, Router, http_json
 from .utils.log import get_logger
+from .utils.metrics import Registry
 
 log = get_logger("directory")
 
@@ -84,10 +92,12 @@ class MemStore:
 
 class DirectoryService:
     """The registry HTTP service. ``ADDR`` env configures the listen address
-    (directory/main.go:58); ``DIRECTORY_TTL_SECONDS`` optionally enables
-    stale-record eviction (0 = never, the reference behavior)."""
+    (directory/main.go:58); ``DIR_TTL_S`` optionally enables heartbeat-driven
+    liveness (0 = never evict, the reference behavior — the loadgen profile
+    turns it on; docs/loadtest.md peer_churn)."""
 
-    def __init__(self, addr: Optional[str] = None, ttl_seconds: float = 0.0) -> None:
+    def __init__(self, addr: Optional[str] = None,
+                 ttl_seconds: Optional[float] = None) -> None:
         # Eager FAIL_POINTS parse: malformed chaos config fails at boot.
         load_failpoints_env()
         self.addr_cfg = addr if addr is not None else env_or("ADDR", ":8080")
@@ -96,11 +106,17 @@ class DirectoryService:
             # (directory/main.go:58); keep that, unlike the loopback default
             # the other services get.
             self.addr_cfg = "0.0.0.0" + self.addr_cfg
-        self.ttl = ttl_seconds
+        self.ttl = (ttl_seconds if ttl_seconds is not None
+                    else env_float("DIR_TTL_S", 0.0))
         self.store = MemStore()
+        self.metrics = Registry()
+        self._m_evictions = self.metrics.counter("directory_evictions_total")
+        self._closed = threading.Event()
         self.router = Router()
         self.router.add("POST", "/register", self._register)
+        self.router.add("POST", "/deregister", self._deregister)
         self.router.add("GET", "/lookup", self._lookup)
+        self.router.add("GET", "/metrics", self._metrics)
         self.router.add("GET", "/healthz", lambda req: Response(200, {"status": "ok"}))
         self._server: Optional[HttpServer] = None
 
@@ -123,6 +139,26 @@ class DirectoryService:
         log.info("registered %s -> %s (%d addrs)", username, peer_id[:12], len(addrs))
         return Response(200, {"status": "ok"})
 
+    def _deregister(self, req: Request) -> Response:
+        """POST /deregister {username, peer_id}: graceful node shutdown
+        (node.py stop()). Idempotent 200; the peer_id must match the
+        live record, so a slow dying node can't delete the record a
+        restarted successor just wrote (last-writer-wins parity with
+        /register)."""
+        try:
+            body = req.json() or {}
+        except ValueError:
+            return Response(400, {"error": "invalid json"})
+        username = str(body.get("username") or "")
+        peer_id = str(body.get("peer_id") or "")
+        if not username or not peer_id:
+            return Response(400, {"error": "username and peer_id required"})
+        rec = self.store.get(username)
+        if rec is not None and rec.peer_id == peer_id:
+            self.store.delete(username)
+            log.info("deregistered %s (%s)", username, peer_id[:12])
+        return Response(200, {"status": "ok"})
+
     def _lookup(self, req: Request) -> Response:
         username = req.query.get("username", "")
         if not username:
@@ -131,17 +167,59 @@ class DirectoryService:
         if rec is not None and self.ttl > 0:
             age = time.time() - parse_ts(rec.last).timestamp()
             if age > self.ttl:
-                self.store.delete(username)
+                # Lookup racing ahead of the sweep: the expired record
+                # must 404 NOW, not at the next sweep tick.
+                self._evict(username, age)
                 rec = None
         if rec is None:
             return Response(404, {"error": "not found"})
         return Response(200, rec.to_dict())
 
+    def _metrics(self, req: Request) -> Response:
+        """GET /metrics: eviction ledger (Prometheus text)."""
+        return Response(200, self.metrics.render(),
+                        content_type="text/plain; version=0.0.4")
+
+    # -- liveness ------------------------------------------------------------
+
+    def _evict(self, username: str, age: float) -> None:
+        """Drop one expired record, counted. The ``p2p.directory.evict``
+        failpoint stalls/fails the eviction (record survives until the
+        next sweep or lookup — degradation contract in
+        docs/robustness.md); it never breaks the service."""
+        act = failpoint("p2p.directory.evict")
+        if act is not None:
+            return            # drop/error: skip this eviction round
+        self.store.delete(username)
+        self._m_evictions.inc()
+        log.info("evicted %s (heartbeat lapsed %.1fs > ttl %.1fs)",
+                 username, age, self.ttl)
+
+    def _sweep_loop(self) -> None:
+        """Heartbeat sweep: evict records older than the TTL. Node
+        re-registers (node.py _reregister_loop) refresh ``last``, so a
+        live node never expires; a killed one disappears within
+        ttl + one sweep interval."""
+        interval = max(0.05, min(self.ttl / 2.0, 5.0))
+        while not self._closed.wait(interval):
+            now = time.time()
+            for rec in self.store.all():
+                age = now - parse_ts(rec.last).timestamp()
+                if age > self.ttl:
+                    try:
+                        self._evict(rec.username, age)
+                    except Exception as e:  # noqa: BLE001 — armed raise
+                        log.debug("evict %s failed: %s", rec.username, e)
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "DirectoryService":
         self._server = HttpServer(self.router, self.addr_cfg).start()
-        log.info("directory listening on %s", self._server.addr)
+        if self.ttl > 0:
+            threading.Thread(target=self._sweep_loop, daemon=True,
+                             name="dir-sweep").start()
+        log.info("directory listening on %s (ttl=%.0fs)",
+                 self._server.addr, self.ttl)
         return self
 
     @property
@@ -154,6 +232,7 @@ class DirectoryService:
         threading.Event().wait()
 
     def stop(self) -> None:
+        self._closed.set()
         if self._server:
             self._server.stop()
 
@@ -191,6 +270,15 @@ class DirectoryClient:
         self._call("p2p.directory.register", lambda: http_json(
             "POST", f"{self.base_url}/register",
             {"username": username, "peer_id": peer_id, "addrs": addrs},
+            timeout=self.timeout))
+
+    def deregister(self, username: str, peer_id: str) -> None:
+        """Graceful-shutdown removal (node.py stop()). Rides the
+        registration-plane failpoint site: chaos that severs /register
+        severs /deregister the same way."""
+        self._call("p2p.directory.register", lambda: http_json(
+            "POST", f"{self.base_url}/deregister",
+            {"username": username, "peer_id": peer_id},
             timeout=self.timeout))
 
     def lookup(self, username: str) -> DirectoryRecord:

@@ -16,7 +16,8 @@ The chat plane's standard-methodology load subsystem (docs/loadtest.md):
 ``tools/e2e_bench.py`` is the operator CLI over all of it.
 """
 
-from .chaos import ChaosWindow, ChurnWindow, check_contracts
+from .chaos import (ChaosWindow, ChurnWindow, NodeChurnWindow,
+                    check_churn_delivery, check_contracts)
 from .driver import Arrival, LoadDriver, TraceRecord, build_schedule
 from .report import (build_ledger, error_row, fetch_timelines, percentile,
                      write_row)
@@ -26,8 +27,10 @@ from .stub import StubServer
 
 __all__ = [
     "Arrival", "ChaosWindow", "ChurnWindow", "Endpoints", "LoadDriver",
+    "NodeChurnWindow",
     "REGISTRY",
     "SLO", "Scenario", "Step", "StubServer", "TraceRecord",
-    "build_ledger", "build_schedule", "check_contracts", "default_mix",
+    "build_ledger", "build_schedule", "check_churn_delivery",
+    "check_contracts", "default_mix",
     "error_row", "fetch_timelines", "parse_mix", "percentile", "write_row",
 ]

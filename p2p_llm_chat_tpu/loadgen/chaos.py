@@ -185,6 +185,106 @@ class ChurnWindow:
         return self._drained.is_set()
 
 
+class NodeChurnWindow:
+    """Peer churn mid-run: KILL a chat node at ``kill_at_s`` into the
+    run and RESTART it at ``restart_at_s`` — the harsher cousin of
+    :class:`ChurnWindow`'s drain/undrain, aimed at the chat plane
+    instead of the serve fleet. A killed node takes its inbox HTTP
+    front AND its P2P listener down cold, so senders fall onto the
+    at-least-once outbox path (node.py): ``/send`` answers a
+    well-formed ``{"status":"queued"}`` 200, and the redelivery worker
+    lands the message once the peer returns.
+
+    ``kill_fn``/``restart_fn`` are the window's whole mechanism —
+    nodes have no drain admin, so there is no HTTP default: an
+    in-process test passes ``ChatNode.stop`` / rebuild-and-start
+    thunks (tests/test_node_churn.py), the e2e bench kills and
+    respawns the real ``python -m p2p_llm_chat_tpu.node`` process
+    (tools/e2e_bench.py). The delivery contract asserted around the
+    window (:func:`check_churn_delivery`): zero lost messages for
+    peers restarting inside the outbox TTL, zero duplicates
+    (receiver-side msg_id dedup), bounded redelivery delay.
+
+    Same lifecycle discipline as :class:`ChurnWindow`: daemon timers
+    relative to the driver's run start; ``stop()`` cancels pending
+    timers and restarts the node if the window is still open (a run
+    must never leak a dead peer past its own teardown)."""
+
+    def __init__(self, kill_fn, restart_fn, peer=0,
+                 kill_at_s: float = 0.0,
+                 restart_at_s: Optional[float] = None) -> None:
+        self.peer = peer
+        self.kill_at_s = kill_at_s
+        self.restart_at_s = restart_at_s
+        self._kill_fn = kill_fn
+        self._restart_fn = restart_fn
+        self._timers: list = []
+        self._killed = threading.Event()
+        self._restored = threading.Event()
+        self._done = threading.Event()
+
+    def _kill(self) -> None:
+        try:
+            self._kill_fn()
+            self._killed.set()
+            log.info("node churn: peer %s killed", self.peer)
+        except Exception:   # noqa: BLE001 — churn is best-effort chaos
+            log.exception("node churn kill failed")
+
+    def _restart(self) -> None:
+        try:
+            self._restart_fn()
+            self._restored.set()
+            log.info("node churn: peer %s restarted", self.peer)
+        except Exception:   # noqa: BLE001
+            log.exception("node churn restart failed")
+
+    def start(self, t0: float) -> None:   # t0 unused: offsets are relative
+        t = threading.Timer(self.kill_at_s, self._kill)
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+        if self.restart_at_s is not None:
+            t2 = threading.Timer(self.restart_at_s, self._restart)
+            t2.daemon = True
+            t2.start()
+            self._timers.append(t2)
+
+    def stop(self) -> None:
+        if self._done.is_set():
+            return
+        self._done.set()
+        for t in self._timers:
+            t.cancel()
+        if self._killed.is_set() and not self._restored.is_set():
+            self._restart()
+
+    @property
+    def churned(self) -> bool:
+        """Did the kill actually land (the run exercised peer churn)?"""
+        return self._killed.is_set()
+
+
+def check_churn_delivery(sent: list, delivered: list) -> dict:
+    """The peer_churn delivery oracle: every sent body delivered
+    EXACTLY once — at-least-once redelivery (node.py Outbox) plus
+    receiver-side msg_id dedup (inbox.py) must compose to
+    exactly-once for any peer that returned inside the outbox TTL.
+
+    ``sent`` is the bodies the senders dispatched (each send listed
+    once), ``delivered`` the bodies drained from recipient inboxes.
+    Returns ``{"ok", "lost", "duplicated"}`` — ``lost`` are sent
+    bodies that never arrived, ``duplicated`` bodies that arrived
+    more times than they were sent."""
+    from collections import Counter
+    want = Counter(sent)
+    got = Counter(delivered)
+    lost = sorted((want - got).elements())
+    dup = sorted(body for body, n in got.items()
+                 if n > want.get(body, 0))
+    return {"ok": not lost and not dup, "lost": lost, "duplicated": dup}
+
+
 @dataclass
 class ContractReport:
     sheds: int = 0

@@ -60,6 +60,18 @@ load" list:
                 P2P delivery leg gets its own SLO instead of hiding
                 inside short_chat's unmeasured first step. Serve-only
                 runs degrade to a short ``/api/chat`` turn.
+``peer_churn``  the chat plane under peer death: one node ``/send``
+                to the ring neighbour, judged on the /send round trip,
+                flown while a NodeChurnWindow (chaos.py) kills and
+                restarts real nodes mid-run. While the recipient is
+                down the sender answers a well-formed
+                ``{"status":"queued"}`` 200 fast — the at-least-once
+                outbox absorbed it — so the judged latency stays
+                bounded THROUGH the kill; actual delivery rides the
+                redelivery worker once the peer returns, and the
+                zero-loss / zero-duplicate oracle is asserted by the
+                chaos/test layer over recipient inboxes
+                (chaos.check_churn_delivery), not by this record.
 ``multi_model``  the heterogeneous fleet (round 18): one arrival
                 stream split across the run's two ``SERVE_MODELS``
                 tags — most arrivals hit the interactive default
@@ -371,6 +383,32 @@ def _build_relay_path(rng: random.Random, peer: int,
                  stream=True, measured=True)]
 
 
+def _build_peer_churn(rng: random.Random, peer: int,
+                      ep: Endpoints) -> list:
+    """One node ``/send`` to the ring neighbour, measured on the /send
+    round trip — the arrival shape the peer_churn chaos window
+    (chaos.NodeChurnWindow) kills nodes under. The sender's answer is
+    "sent" on a live recipient and the well-formed queued 200 on a dead
+    one; BOTH are fast local work, so the latency class matches
+    relay_path's. Arrivals aimed AT the killed node's own HTTP front
+    error out — that is the ~1/N collateral of real process death, and
+    it belongs to the error budget, not the SLO. Serve-only runs
+    degrade to a short ``/api/chat`` turn."""
+    if ep.node_urls:
+        n = len(ep.node_urls)
+        to = (peer + 1) % n
+        user = ep.users[to] if ep.users else f"peer{to:02d}"
+        return [Step(url=f"{ep.node_urls[peer % n]}/send",
+                     payload={"to_username": user,
+                              "content": _chat_text(rng, user)},
+                     measured=True)]
+    msg = _chat_text(rng, "whoever is up")
+    return [Step(url=f"{ep.serve_url}/api/chat",
+                 payload={"messages": [{"role": "user", "content": msg}],
+                          "options": {"num_predict": 16}, "stream": True},
+                 stream=True, measured=True)]
+
+
 # The multi_model arrival split: this fraction of arrivals hits the
 # FIRST tag (the interactive default); the rest hit the second (the
 # large trunk). A fixed constant, not an env knob — the determinism
@@ -507,6 +545,16 @@ REGISTRY: dict = {
                  slo=SLO(ttft_p50_ms=4000, ttft_p95_ms=12000,
                          itl_p95_ms=None, max_shed_frac=0.25),
                  build=_build_relay_path),
+        # Peer churn (round 20): a non-streaming /send judged through a
+        # NodeChurnWindow kill/restart pulse, so itl is None and TTFT
+        # is the sender's local answer — "sent" or the queued 200, both
+        # bounded by the outbox enqueue, never by the dead peer. The
+        # shed/error headroom is churn-wide: arrivals racing the kill
+        # against the dead node's own front are real connection errors.
+        Scenario("peer_churn", weight=0.5,
+                 slo=SLO(ttft_p50_ms=4000, ttft_p95_ms=12000,
+                         itl_p95_ms=None, max_shed_frac=0.4),
+                 build=_build_peer_churn),
         # Heterogeneous models (round 18): the blended scenario SLO is
         # sized for the mix; the per-phase SLOs split misses by MODEL
         # class — model_a holds the interactive default's tight budget,

@@ -49,10 +49,30 @@ gateway (p2p/natpmp.py, RFC 6886) and advertises the external address
 in directory/DHT records; renews at half-lifetime from the re-register
 loop; releases on stop. ``NATPMP=0`` disables, ``NATPMP_GATEWAY``
 overrides gateway discovery.
+
+At-least-once delivery (additive — the reference tries each addr once
+and drops the message, SURVEY.md §2 C5): every outgoing message carries
+a sender-minted ``msg_id`` (proto.mint_msg_id) and the chat stream
+grows an ack frame — the receiver acks after the inbox push, dedups
+redelivered copies by ``msg_id``, and the sender parks unacked messages
+in a bounded per-recipient **Outbox** (``P2P_OUTBOX_MAX`` messages per
+peer, ``P2P_OUTBOX_TTL_S`` seconds). A redelivery worker retries on the
+utils/backoff jittered schedule and RE-RESOLVES the recipient each
+round (directory first, then the DHT rung — a queued message usually
+means the peer moved or restarted, so stale addrs must refresh before
+the next dial). ``POST /send`` answers ``{"status": "queued"}`` when
+the peer is down instead of a 502, and ``stop()`` attempts one final
+outbox flush, then deregisters from the directory (the DHT record
+expires via its own TTL). Peers that predate the ack frame close the
+stream without answering — EOF counts as legacy-delivered, keeping the
+wire compatible in both directions. Drops (TTL lapse, overflow) are
+counted on ``GET /metrics`` as ``p2p_messages_dropped_total``;
+docs/robustness.md §Peer lifecycle has the state machine.
 """
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Optional
@@ -65,14 +85,88 @@ from .p2p import Identity, Multiaddr, P2PHost
 from .p2p.dht import DHTNode, parse_seeds
 from .p2p.natpmp import PortMapper
 from .p2p.transport import SecureStream
-from .proto import ChatMessage, now_rfc3339
-from .utils.env import env_or
+from .proto import ChatMessage, ack_frame, mint_msg_id, now_rfc3339, parse_ack
+from .utils import failpoints as _fp
+from .utils.env import env_float, env_int, env_or
+from .utils.failpoints import failpoint
 from .utils.http import HttpServer, Request, Response, Router
 from .utils.log import get_logger
+from .utils.metrics import Registry
 
 log = get_logger("node")
 
 CHAT_PROTOCOL_ID = "/p2p-llm-chat/1.0.0"   # go/cmd/node/main.go:48
+
+
+class Outbox:
+    """Bounded per-recipient queue of sent-but-unacked messages.
+
+    Locking: ``_mu`` guards the tables and is NEVER held across network
+    I/O — the redelivery worker snapshots under the lock, dials
+    unlocked, then removes delivered entries under the lock again.
+    Rounds themselves are serialized by the node's ``_flush_mu``, so a
+    message is never dialed twice concurrently (and even a duplicate
+    dial is idempotent at the receiver via msg_id dedup).
+    """
+
+    def __init__(self, max_per_peer: int, ttl_s: float) -> None:
+        self.max_per_peer = max(1, max_per_peer)
+        self.ttl_s = ttl_s
+        self._mu = threading.Lock()
+        # recipient -> deque[(msg, enqueued_at_monotonic)], send order
+        self._pending: dict[str, collections.deque] = {}  # guarded-by: _mu
+
+    def put(self, msg: ChatMessage) -> list[ChatMessage]:
+        """Enqueue for redelivery; returns the OLDEST entries dropped to
+        make room at the per-peer bound (overflow accounting)."""
+        dropped: list[ChatMessage] = []
+        with self._mu:
+            q = self._pending.setdefault(msg.to_user, collections.deque())
+            while len(q) >= self.max_per_peer:
+                dropped.append(q.popleft()[0])
+            q.append((msg, time.monotonic()))
+        return dropped
+
+    def expire(self, now: float) -> list[ChatMessage]:
+        """Drop entries older than ``ttl_s``; returns them (TTL
+        accounting). The queue head is the oldest, so one front-scan per
+        recipient suffices."""
+        out: list[ChatMessage] = []
+        with self._mu:
+            for user in list(self._pending):
+                q = self._pending[user]
+                while q and now - q[0][1] > self.ttl_s:
+                    out.append(q.popleft()[0])
+                if not q:
+                    del self._pending[user]
+        return out
+
+    def snapshot(self) -> dict[str, list[tuple[ChatMessage, float]]]:
+        with self._mu:
+            return {u: list(q) for u, q in self._pending.items()}
+
+    def remove(self, user: str, msg_id: str) -> Optional[float]:
+        """Remove a delivered message; returns its enqueue time (for the
+        delivery-latency observation), or None when already gone."""
+        with self._mu:
+            q = self._pending.get(user)
+            if not q:
+                return None
+            for i, (m, t0) in enumerate(q):
+                if m.msg_id == msg_id:
+                    del q[i]
+                    if not q:
+                        del self._pending[user]
+                    return t0
+        return None
+
+    def depth(self) -> int:
+        with self._mu:
+            return sum(len(q) for q in self._pending.values())
+
+    def has(self, user: str) -> bool:
+        with self._mu:
+            return bool(self._pending.get(user))
 
 
 class ChatNode:
@@ -133,11 +227,30 @@ class ChatNode:
         self._lookup_cache: dict[str, object] = {}
         self._cache_mu = threading.Lock()
         self._closed = threading.Event()
+        # At-least-once delivery state (module docstring): the unacked
+        # outbox, the per-sender msg_id sequence, and the drop ledger.
+        self.outbox = Outbox(env_int("P2P_OUTBOX_MAX", 128),
+                             env_float("P2P_OUTBOX_TTL_S", 300.0))
+        self._outbox_kick = threading.Event()
+        # Serializes redelivery rounds (worker tick vs stop()'s final
+        # flush). Held across dials BY DESIGN — it is a round mutex, not
+        # a data lock; outbox._mu nests strictly inside it.
+        self._flush_mu = threading.Lock()
+        self._seq_mu = threading.Lock()
+        self._send_seq = 0                       # guarded-by: _seq_mu
+        self._drop_mu = threading.Lock()
+        self._dropped = {"ttl": 0, "overflow": 0}  # guarded-by: _drop_mu
+        self.metrics = Registry()
+        self._m_outbox_depth = self.metrics.gauge("p2p_outbox_depth")
+        self._m_redelivered = self.metrics.counter("p2p_redelivered_total")
+        self._m_dedup = self.metrics.counter("p2p_dedup_suppressed_total")
+        self._m_delivery_ms = self.metrics.histogram("p2p_delivery_ms")
         self._http: Optional[HttpServer] = None
         self.router = Router()
         self.router.add("POST", "/send", self._handle_send)
         self.router.add("GET", "/inbox", self._handle_inbox)
         self.router.add("GET", "/me", self._handle_me)
+        self.router.add("GET", "/metrics", self._handle_metrics)
         self.router.add("GET", "/healthz", lambda r: Response(200, {"status": "ok"}))
         # grafttrace (obs/trace.py): /send is a chat-plane INGRESS — it
         # parses or mints a trace context per message and records the
@@ -150,16 +263,28 @@ class ChatNode:
     # -- p2p side ------------------------------------------------------------
 
     def _on_chat_stream(self, stream: SecureStream, remote_peer_id: str) -> None:
-        """Inbound chat message: read whole stream until sender closes, parse
-        one JSON ChatMessage, push to inbox (go/cmd/node/main.go:158-172)."""
+        """Inbound chat message: read whole stream until the sender half-
+        closes, parse one JSON ChatMessage, push to inbox
+        (go/cmd/node/main.go:158-172). Messages carrying a ``msg_id``
+        get an ack frame back on the same (full-duplex) stream AFTER the
+        inbox push — a redelivered duplicate is suppressed by the inbox
+        but STILL acked, because the original delivery already won and
+        the sender only needs to stop retrying."""
         try:
             raw = stream.read_all()
             if not raw:
                 return
             msg = ChatMessage.from_json(raw)
-            self.inbox.push(msg)
-            log.info("inbox <- %s: %r (from peer %s)",
-                     msg.from_user, msg.content[:60], remote_peer_id[:12])
+            fresh = self.inbox.push(msg)
+            if fresh:
+                log.info("inbox <- %s: %r (from peer %s)",
+                         msg.from_user, msg.content[:60], remote_peer_id[:12])
+            else:
+                self._m_dedup.inc()
+                log.info("dedup: suppressed duplicate %s from %s",
+                         msg.msg_id[:12], msg.from_user)
+            if msg.msg_id:
+                stream.send_frame(ack_frame(msg.msg_id))
         except (ValueError, OSError) as e:
             log.warning("bad chat stream from %s: %s", remote_peer_id[:12], e)
         finally:
@@ -225,20 +350,30 @@ class ChatNode:
                     log.warning("directory lookup for %s failed (%s); "
                                 "resolved via DHT", to_username, e)
                     via_dht = True
-            if rec is None:
+            if rec is None and not self.outbox.has(to_username):
                 return Response(404, {"error": f"lookup failed: {e}"})
+            # rec None but the outbox holds queued messages for this
+            # user: the recipient exists and is mid-churn (e.g. they
+            # deregistered on shutdown and the first queued send spent
+            # the cached record) — this send JOINS the queue instead of
+            # 404ing, preserving order behind the already-parked ones.
 
+        with self._seq_mu:
+            self._send_seq += 1
+            seq = self._send_seq
         msg = ChatMessage(from_user=self.username, to_user=to_username,
-                          content=content, timestamp=now_rfc3339())
+                          content=content, timestamp=now_rfc3339(),
+                          msg_id=mint_msg_id(self.username, seq, content))
 
         errors: list[str] = []
-        won = self._deliver(rec, msg, errors)
+        won = self._deliver(rec, msg, errors) if rec is not None else ""
         if won:
             if via_dht:
                 # Cache only after a delivery proves the record good — a
                 # dead DHT record must not poison the cache rung.
                 with self._cache_mu:
                     self._lookup_cache[to_username] = rec
+            self._m_delivery_ms.observe((time.monotonic() - t_send) * 1000.0)
             _span(via=("relay" if "/p2p-circuit/" in won else "direct"))
             return Response(200, {"status": "sent", "id": msg.id,
                                   "trace": tctx.trace_id})  # main.go:264
@@ -268,6 +403,8 @@ class ChatNode:
                 if won:
                     with self._cache_mu:
                         self._lookup_cache[to_username] = fresh
+                    self._m_delivery_ms.observe(
+                        (time.monotonic() - t_send) * 1000.0)
                     _span(via=("relay" if "/p2p-circuit/" in won
                                else "direct"))
                     return Response(200, {"status": "sent", "id": msg.id,
@@ -277,18 +414,36 @@ class ChatNode:
             # re-resolves instead of re-dialing dead addrs forever.
             with self._cache_mu:
                 self._lookup_cache.pop(to_username, None)
-        _span(outcome="unreachable", attempts=len(errors))
-        return Response(502, {"error": "could not reach peer", "attempts": errors})
+        # At-least-once: the peer is unreachable RIGHT NOW — park the
+        # message in the outbox and let the redelivery worker retry on
+        # the backoff schedule, re-resolving each round. The client gets
+        # a well-formed queued answer instead of the reference's
+        # 502-and-forget (SURVEY.md §2 C5 message loss).
+        for old in self.outbox.put(msg):
+            self._note_drop("overflow", old)
+        self._m_outbox_depth.set(self.outbox.depth())
+        self._outbox_kick.set()
+        _span(outcome="queued", attempts=len(errors))
+        return Response(200, {"status": "queued", "id": msg.id,
+                              "msg_id": msg.msg_id, "trace": tctx.trace_id})
 
     def _deliver(self, rec, msg: ChatMessage, errors: list[str]) -> str:
         """Try each advertised addr (direct first, then circuits), one stream
-        per message, write JSON, close (main.go:235-261). Returns the
+        per message, write JSON, half-close, await the ack
+        (main.go:235-261 plus the at-least-once wire). Returns the
         addr that delivered (truthy — callers keep their boolean
         checks; the trace span reads the relay marker off it), or ""
         when every addr failed."""
         addrs = sorted(rec.addrs, key=lambda a: "/p2p-circuit/" in a)
         for addr_str in addrs:
             try:
+                # Chaos: a raised/error'd/dropped deliver fails THIS
+                # attempt — the message falls through to the outbox and
+                # the redelivery worker (docs/robustness.md contract).
+                act = failpoint("p2p.node.deliver")
+                if act is not None:
+                    raise ConnectionError(
+                        f"failpoint p2p.node.deliver ({act.kind})")
                 maddr = Multiaddr.parse(addr_str)
                 if maddr.peer_id is None:
                     maddr = maddr.with_peer(rec.peer_id)
@@ -296,12 +451,51 @@ class ChatNode:
                 try:
                     stream.send_frame(msg.to_json())
                     stream.close_write()
+                    if msg.msg_id:
+                        # At-least-once wire: wait for the receiver's
+                        # ack frame. None (EOF without a frame) is a
+                        # pre-ack peer closing after the read — count it
+                        # delivered (legacy wire compat); a frame that
+                        # is not OUR ack is a broken peer.
+                        stream.settimeout(5.0)
+                        raw = stream.recv_frame()
+                        if raw is not None and parse_ack(raw) != msg.msg_id:
+                            raise ConnectionError("bad delivery ack")
                 finally:
                     stream.close()
                 return addr_str
             except Exception as e:  # noqa: BLE001 — collect and try next addr
                 errors.append(f"{addr_str}: {e}")
         return ""
+
+    def _note_drop(self, reason: str, msg: ChatMessage) -> None:
+        """Account an outbox drop (`reason` = ttl|overflow) — the churn
+        contract's loss ledger (a nonzero count under plain churn is a
+        contract breach; docs/loadtest.md peer_churn)."""
+        with self._drop_mu:
+            self._dropped[reason] += 1
+        log.warning("outbox dropped %s -> %s (%s)",
+                    (msg.msg_id or msg.id)[:12], msg.to_user, reason)
+
+    def _handle_metrics(self, req: Request) -> Response:
+        """GET /metrics: the chat-plane delivery ledger (Prometheus
+        text), same exposition contract as the serve fronts."""
+        self._m_outbox_depth.set(self.outbox.depth())
+        text = self.metrics.render()
+        with self._drop_mu:
+            drops = dict(self._dropped)
+        text += "# TYPE p2p_messages_dropped_total counter\n" + "".join(
+            f'p2p_messages_dropped_total{{reason="{r}"}} {n}\n'
+            for r, n in sorted(drops.items()))
+        hits = _fp.snapshot()
+        if hits:
+            # Same operator alarm as the serve front: ANY
+            # failpoint_hits_total series in a production scrape means
+            # chaos is armed on this node.
+            text += "# TYPE failpoint_hits_total counter\n" + "".join(
+                f'failpoint_hits_total{{site="{site}"}} {n}\n'
+                for site, n in sorted(hits.items()))
+        return Response(200, text, content_type="text/plain; version=0.0.4")
 
     def _handle_trace(self, req: Request) -> Response:
         """GET /admin/trace[?id=]: the node's span store — same listing
@@ -385,6 +579,8 @@ class ChatNode:
         if self.reregister_s > 0:
             threading.Thread(target=self._reregister_loop, daemon=True,
                              name="reregister").start()
+        threading.Thread(target=self._redelivery_loop, daemon=True,
+                         name="redelivery").start()
 
         self._http = HttpServer(self.router, self.http_addr).start()
         log.info("node %s HTTP API on %s", self.username, self._http.addr)
@@ -513,6 +709,101 @@ class ChatNode:
                 except Exception as e:  # noqa: BLE001
                     log.debug("dht republish failed: %s", e)
 
+    # -- at-least-once redelivery -------------------------------------------
+
+    def _resolve_for_redelivery(self, to_username: str):
+        """Re-resolve a queued recipient before a redelivery round:
+        directory first (the fresh record — the peer most likely MOVED,
+        which is why the message is queued), then the cache, then the
+        DHT rung with the same identity pinning as the /send ladder.
+        Returns None when no rung answers — the recipient stays queued
+        and the round backs off."""
+        # Chaos: a failed resolve leaves the whole recipient queued this
+        # round — no crash, no message loss, retried on the backoff
+        # schedule (docs/robustness.md contract).
+        act = failpoint("p2p.node.resolve")
+        if act is not None:
+            return None
+        try:
+            rec = self.dir.lookup(to_username)
+            with self._cache_mu:
+                self._lookup_cache[to_username] = rec
+            return rec
+        except Exception:  # noqa: BLE001 — fall through the ladder
+            pass
+        with self._cache_mu:
+            cached = self._lookup_cache.get(to_username)
+        if self.dht is not None:
+            fresh = self.dht.get_record(to_username, budget_s=3.0)
+            if fresh is not None:
+                if cached is not None and fresh.peer_id != getattr(
+                        cached, "peer_id", None):
+                    # Identity pinning (same rule as _handle_send): a
+                    # record signed by a different identity is a squat,
+                    # not a move — keep the pinned binding.
+                    return cached
+                return fresh
+        return cached
+
+    def _flush_outbox(self) -> bool:
+        """One redelivery round: TTL-expire, then per recipient
+        re-resolve and retry the queued messages in send order (stopping
+        at the first failure per recipient, so order is preserved).
+        Returns True when anything failed this round. Serialized by
+        ``_flush_mu``; the outbox lock is never held across a dial."""
+        with self._flush_mu:
+            for old in self.outbox.expire(time.monotonic()):
+                self._note_drop("ttl", old)
+            any_failed = False
+            for user, entries in self.outbox.snapshot().items():
+                try:
+                    rec = self._resolve_for_redelivery(user)
+                except Exception as e:  # noqa: BLE001 — incl. armed raise
+                    log.debug("redelivery resolve %s failed: %s", user, e)
+                    rec = None
+                if rec is None:
+                    any_failed = True
+                    continue
+                for msg, t0 in entries:
+                    errors: list[str] = []
+                    if not self._deliver(rec, msg, errors):
+                        any_failed = True
+                        log.debug("redelivery %s -> %s failed: %s",
+                                  msg.msg_id[:12], user, "; ".join(errors))
+                        break
+                    if self.outbox.remove(user, msg.msg_id) is not None:
+                        self._m_redelivered.inc()
+                        wait_s = time.monotonic() - t0
+                        self._m_delivery_ms.observe(wait_s * 1000.0)
+                        log.info("redelivered %s -> %s after %.1fs",
+                                 msg.msg_id[:12], user, wait_s)
+            self._m_outbox_depth.set(self.outbox.depth())
+            return any_failed
+
+    def _redelivery_loop(self) -> None:
+        """Background worker: retries unacked messages on a jittered
+        exponential schedule (utils/backoff — the jitter keeps a fleet
+        of senders from dialing a restarted peer in lockstep). A /send
+        that queues kicks the worker awake, so the first retry doesn't
+        wait out an idle tick."""
+        backoff = Backoff(base_s=0.25, max_s=4.0, jitter=0.5)
+        delay = 0.25
+        while True:
+            self._outbox_kick.wait(timeout=delay)
+            self._outbox_kick.clear()
+            if self._closed.is_set():
+                return
+            if self.outbox.depth() == 0:
+                backoff.reset()
+                delay = 0.5       # idle: the kick event wakes us instantly
+                continue
+            try:
+                failed = self._flush_outbox()
+            except Exception as e:  # noqa: BLE001 — worker must survive
+                log.warning("redelivery round failed: %s", e)
+                failed = True
+            delay = backoff.next() if failed else (backoff.reset() or 0.05)
+
     @property
     def http_url(self) -> str:
         assert self._http is not None
@@ -534,8 +825,27 @@ class ChatNode:
 
     def stop(self) -> None:
         self._closed.set()
+        self._outbox_kick.set()     # unblock the worker so it exits
         if self._http:
             self._http.stop()
+        # Graceful shutdown, while the p2p host is still up: one final
+        # outbox flush (last chance for queued messages — _flush_mu
+        # serializes against a worker round already in flight), then
+        # deregister so the directory stops advertising a dead node
+        # (the reference never deregisters — SURVEY.md §2 C5; the DHT
+        # record is signed + TTL'd and expires on its own).
+        if self.outbox.depth():
+            try:
+                self._flush_outbox()
+            except Exception as e:  # noqa: BLE001 — best-effort flush
+                log.debug("final outbox flush failed: %s", e)
+        try:
+            self.dir.deregister(self.username, self.host.peer_id)
+            log.info("deregistered %s from directory %s (DHT record "
+                     "expires via its own TTL)",
+                     self.username, self.directory_url)
+        except Exception as e:  # noqa: BLE001 — directory may be gone
+            log.debug("directory deregister failed (non-fatal): %s", e)
         if self.dht is not None:
             self.dht.close()
         if self._mapper is not None:

@@ -56,9 +56,12 @@ degradation contract):
 
 ``p2p.directory.register``   directory client register RPC
 ``p2p.directory.lookup``     directory client lookup RPC
+``p2p.directory.evict``      directory TTL eviction of one stale record
 ``p2p.dht.rpc``              one DHT UDP RPC attempt (drop = lost dgram)
 ``p2p.relay.control``        relay-service control-frame handling
 ``p2p.transport.handshake``  secure-channel dial handshake
+``p2p.node.deliver``         one chat-message delivery attempt (per addr)
+``p2p.node.resolve``         redelivery-round recipient re-resolution
 ===========================  ===============================================
 """
 
@@ -87,9 +90,12 @@ KNOWN_SITES = (
     "serve.disagg.handoff",
     "p2p.directory.register",
     "p2p.directory.lookup",
+    "p2p.directory.evict",
     "p2p.dht.rpc",
     "p2p.relay.control",
     "p2p.transport.handshake",
+    "p2p.node.deliver",
+    "p2p.node.resolve",
 )
 
 _ACTIONS = ("raise", "delay", "drop", "error")

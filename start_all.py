@@ -122,6 +122,12 @@ def main() -> int:
     ap.add_argument("--backend", default=env_or("SERVE_BACKEND", "fake"),
                     help="LLM backend: fake | tpu (default: fake)")
     ap.add_argument("--relay", action="store_true", help="also start the relay daemon")
+    ap.add_argument("--churn-tolerant", action="store_true",
+                    help="keep the stack up when a NODE child dies — "
+                         "loadgen peer-churn runs (tools/e2e_bench.py "
+                         "--churn) SIGKILL nodes on purpose and respawn "
+                         "them externally; any other child's death "
+                         "still tears everything down")
     ap.add_argument("--users", default="Najy,Cannan",
                     help="comma-separated usernames (default mirrors start_all.sh)")
     ap.add_argument("--node-port-base", type=int,
@@ -381,11 +387,21 @@ def main() -> int:
     print("Ctrl-C to stop.")
 
     while True:
+        alive = []
         for name, p in procs:
             code = p.poll()
-            if code is not None:
+            if code is None:
+                alive.append((name, p))
+            elif args.churn_tolerant and name.startswith("node-"):
+                # Forgotten, not fatal: the churn window owns this
+                # node's lifecycle now (its respawn is the window's
+                # child, not ours).
+                print(f"⚠️ {name} exited with {code}; continuing "
+                      "(--churn-tolerant)")
+            else:
                 print(f"⚠️ {name} exited with {code}; shutting down")
                 shutdown(exit_code=1)
+        procs[:] = alive
         time.sleep(1)
 
 

@@ -325,9 +325,11 @@ def test_warm_pair_identity_pinning_rejects_squatter():
             "POST", f"{a.http_url}/send",
             {"to_username": "cannan", "content": "secret"},
             raise_for_status=False)
-        # Pinning must refuse the squatter's identity: total failure (502),
-        # and the squatter received NOTHING.
-        assert status == 502, resp
+        # Pinning must refuse the squatter's identity: the message PARKS
+        # in the at-least-once outbox for the real cannan (a well-formed
+        # queued 200; pre-outbox this was a 502 total failure), and the
+        # squatter received NOTHING.
+        assert status == 200 and resp["status"] == "queued", resp
         assert stolen == []
     finally:
         sq_host.close()

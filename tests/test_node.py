@@ -94,16 +94,22 @@ def test_send_to_unknown_user_is_404(two_nodes):
     assert e.value.status == 404
 
 
-def test_send_to_stale_peer_is_502(two_nodes):
-    # Registered but unreachable peer (node restarted/crashed) -> 502 with
-    # attempt detail, not a hang.
+def test_send_to_downed_peer_queues(two_nodes):
+    # Known-but-unreachable peer (crashed mid-restart) -> the at-least-once
+    # outbox absorbs the send: a fast, well-formed {"status":"queued"} 200,
+    # never a hang (pre-outbox this path answered 502-and-forget).
     a, b = two_nodes
+    status, resp = http_json("POST", f"{a.http_url}/send",
+                             {"to_username": "cannan", "content": "warmup"})
+    assert resp["status"] == "sent"
+    _wait_inbox(b.http_url, 1)
     b.stop()
-    with pytest.raises(HttpError) as e:
-        http_json("POST", f"{a.http_url}/send",
-                  {"to_username": "cannan", "content": "anyone home?"},
-                  timeout=15.0)
-    assert e.value.status == 502
+    status, resp = http_json("POST", f"{a.http_url}/send",
+                             {"to_username": "cannan", "content": "anyone home?"},
+                             timeout=15.0)
+    assert status == 200
+    assert resp["status"] == "queued"
+    assert resp["msg_id"]
 
 
 def test_warm_peers_survive_directory_outage():

@@ -16,9 +16,17 @@ ledger re-asserts the PR 5 degradation contracts under load (sheds
 answered <100 ms with Retry-After, no hung streams, stack still answers
 after the run).
 
+``--churn`` adds real peer churn on top: a NodeChurnWindow SIGKILLs one
+launched node mid-run and respawns it with its captured environment,
+then the ledger asserts every outbox drained (the at-least-once
+redelivery contract, docs/robustness.md peer lifecycle). ``--relay``
+boots the circuit relay so relay_path traffic rides the splice. The
+launched profile turns directory liveness on (``DIR_TTL_S=60``).
+
 Usage:
     python tools/e2e_bench.py --peers 64 --backend tpu --config tiny \
-        --rate 8 --duration 60 --chaos 'serve.api.stream=drop@0.02'
+        --rate 8 --duration 60 --chaos 'serve.api.stream=drop@0.02' \
+        --relay --churn 'peer=3,kill_at=20,restart_at=45'
     python tools/e2e_bench.py --stub --duration 5      # no launcher smoke
 
 In containers without the ``cryptography`` package the node plane runs
@@ -32,6 +40,7 @@ import argparse
 import importlib.util
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -43,9 +52,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from p2p_llm_chat_tpu.loadgen import (   # noqa: E402
-    ChaosWindow, Endpoints, LoadDriver, REGISTRY, build_ledger,
-    build_schedule, check_contracts, error_row, fetch_timelines, parse_mix,
-    write_row)
+    ChaosWindow, Endpoints, LoadDriver, NodeChurnWindow, REGISTRY,
+    build_ledger, build_schedule, check_contracts, error_row,
+    fetch_timelines, parse_mix, write_row)
 from p2p_llm_chat_tpu.loadgen.chaos import parse_fail_points  # noqa: E402
 from p2p_llm_chat_tpu.utils.env import (   # noqa: E402
     env_float, env_int, env_or)
@@ -129,6 +138,73 @@ def build_quote_checkpoint(config: str, env: dict) -> None:
     env["LLM_MODEL"] = config
 
 
+def parse_churn(spec: str) -> dict:
+    """'peer=3,kill_at=20,restart_at=45' -> kwargs for the churn window.
+    Typos fail at parse time, before any boot (the --chaos discipline)."""
+    out = {"peer": 0, "kill_at": 20.0, "restart_at": 45.0}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        key, sep, val = part.partition("=")
+        if not sep or key not in out:
+            raise SystemExit(f"bad --churn entry {part!r} "
+                             "(want peer=K,kill_at=S,restart_at=S)")
+        out[key] = int(val) if key == "peer" else float(val)
+    if out["restart_at"] <= out["kill_at"]:
+        raise SystemExit("--churn restart_at must be after kill_at")
+    return out
+
+
+def find_node_proc(port: int) -> "tuple[int, dict[str, str]]":
+    """Locate the launched node listening on ``port`` by scanning
+    /proc/*/environ for its HTTP_ADDR — start_all.py owns the Popen
+    handles, so the churn window has to find its victim from outside.
+    Returns (pid, env snapshot) so the respawn reproduces the victim's
+    exact configuration (username, ports, FAIL_POINTS, relay addrs)."""
+    needle = f"HTTP_ADDR=127.0.0.1:{port}".encode()
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                raw = f.read()
+            if needle not in raw:
+                continue
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                if b"p2p_llm_chat_tpu.node" not in f.read():
+                    continue
+        except OSError:   # raced a process exit
+            continue
+        env = dict(kv.split("=", 1)
+                   for kv in raw.decode("utf-8", "replace").split("\0")
+                   if "=" in kv)
+        return int(pid), env
+    raise RuntimeError(f"no node process found on port {port}")
+
+
+def outboxes_drained(node_urls: "tuple[str, ...]",
+                     deadline_s: float = 90.0) -> bool:
+    """Poll every node's /metrics until all p2p_outbox_depth gauges read
+    zero — the cheap fleet-wide proxy for 'every message queued during
+    the churn window was redelivered' (per-inbox dedup makes that
+    exactly-once; tests/test_node_churn.py pins the strict oracle)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        depths = []
+        for url in node_urls:
+            try:
+                with urllib.request.urlopen(f"{url}/metrics",
+                                            timeout=5) as r:
+                    text = r.read().decode()
+                for line in text.splitlines():
+                    if line.startswith("p2p_outbox_depth"):
+                        depths.append(float(line.split()[-1]))
+            except Exception:
+                depths.append(-1.0)   # unreachable node: keep polling
+        if depths and all(d == 0.0 for d in depths):
+            return True
+        time.sleep(1.0)
+    return False
+
+
 def drive(ep: Endpoints, args, chaos: "ChaosWindow | None") -> dict:
     """Schedule + drive + judge: the loadgen core, shared by the
     launcher and --stub paths."""
@@ -194,6 +270,20 @@ def main() -> int:
                          "process for the whole run, e.g. "
                          "'serve.api.stream=drop@0.02,p2p.dht.rpc="
                          "drop@0.05'")
+    ap.add_argument("--relay", action="store_true",
+                    help="also start the circuit relay (start_all.py "
+                         "--relay): nodes hold reservations, and the "
+                         "relay_path scenario's NAT-blocked pair rides "
+                         "the splice instead of degrading to a direct "
+                         "dial")
+    ap.add_argument("--churn", default=env_or("LOADGEN_CHURN", ""),
+                    help="arm peer churn mid-run: 'peer=K,kill_at=S,"
+                         "restart_at=S' SIGKILLs the K-th launched node "
+                         "and respawns it with its captured environment "
+                         "— directory re-register plus the at-least-"
+                         "once outbox must hand every queued message "
+                         "over after the restart (docs/robustness.md "
+                         "peer lifecycle)")
     ap.add_argument("--boot-wave", type=int,
                     default=env_int("LOADGEN_BOOT_WAVE", 8))
     ap.add_argument("--slots", type=int, default=0,
@@ -238,11 +328,14 @@ def main() -> int:
     args = ap.parse_args()
     if args.chaos:
         parse_fail_points(args.chaos)   # typos fail before any boot
+    churn_spec = parse_churn(args.churn) if args.churn else None
 
     meta = {"peers": args.peers, "config": args.config,
             "backend": args.backend, "rate_rps": args.rate,
             "seed": args.seed, "mix": args.mix or "default",
             "chaos_spec": args.chaos or None,
+            "relay": bool(args.relay),
+            "churn_spec": args.churn or None,
             # Class topology: disagg rows must be distinguishable from
             # mixed rows at a glance (docs/serving.md Round-14) — a
             # decode_stall_ms ~0 claim means nothing without the fleet
@@ -315,6 +408,12 @@ def main() -> int:
     # NAT-PMP from 64–128 nodes (explicit NATPMP=1 in the caller's env
     # still wins).
     env.setdefault("NATPMP", "0")
+    # The loadgen profile turns directory liveness ON (off by default
+    # for reference contract parity): records older than DIR_TTL_S are
+    # evicted, so a peer that dies and stays dead stops resolving and
+    # senders park messages in the outbox instead of dialing a corpse.
+    # 60 s = two NODE_REREGISTER_S heartbeats of slack.
+    env.setdefault("DIR_TTL_S", "60")
     # Bound the co-pilot suggestion length (the reference sends no
     # num_predict, i.e. the server's 256 default — the single biggest
     # per-request cost; one short sentence is the product-shaped reply).
@@ -344,6 +443,12 @@ def main() -> int:
         launch_cmd += ["--prefill", str(args.prefill)]
     if args.decode:
         launch_cmd += ["--decode", str(args.decode)]
+    if args.relay:
+        launch_cmd += ["--relay"]
+    if churn_spec is not None:
+        # The launcher must forgive the victim's death — the churn
+        # window SIGKILLs it on purpose and owns the respawn.
+        launch_cmd += ["--churn-tolerant"]
     launcher = subprocess.Popen(
         launch_cmd, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT)
@@ -362,6 +467,9 @@ def main() -> int:
     serve_url = f"http://127.0.0.1:{args.serve_port}"
     row: dict = {}
     rc = 1
+    # Churn respawns are OUR children, not the launcher's — tracked so
+    # teardown reaps them (launcher.terminate() can't see them).
+    respawned: "list[subprocess.Popen]" = []
     try:
         try:
             # The launcher boots the serve front FIRST (model init +
@@ -396,7 +504,50 @@ def main() -> int:
             # post-run probe below.
             chaos = (ChaosWindow(args.chaos, in_process=False)
                      if args.chaos else None)
-            row = drive(ep, args, chaos)
+            window = None
+            if churn_spec is not None:
+                victim = churn_spec["peer"] % n
+                victim_port = args.node_base + victim
+                victim_env: dict = {}
+
+                def kill_victim() -> None:
+                    pid, env_snap = find_node_proc(victim_port)
+                    victim_env.update(env_snap)
+                    os.kill(pid, signal.SIGKILL)
+
+                def restart_victim() -> None:
+                    respawned.append(subprocess.Popen(
+                        [sys.executable, "-m", "p2p_llm_chat_tpu.node"],
+                        cwd=REPO, env=victim_env,
+                        stdout=subprocess.DEVNULL,
+                        stderr=subprocess.STDOUT))
+
+                window = NodeChurnWindow(
+                    kill_victim, restart_victim, peer=victim,
+                    kill_at_s=churn_spec["kill_at"],
+                    restart_at_s=churn_spec["restart_at"])
+                window.start(time.monotonic())
+            try:
+                row = drive(ep, args, chaos)
+            finally:
+                if window is not None:
+                    window.stop()   # restores the victim if the run died
+            if churn_spec is not None:
+                # The churn contract's fleet-wide proxy: every message
+                # parked while the victim was down must leave the
+                # outboxes once it is back (at-least-once redelivery;
+                # inbox msg_id dedup makes the client view exactly-once).
+                wait_http(f"http://127.0.0.1:{victim_port}/healthz",
+                          deadline_s=60.0)
+                drained = outboxes_drained(ep.node_urls)
+                row["churn"] = {**churn_spec, "peer": victim,
+                                "churned": window.churned,
+                                "outboxes_drained": drained}
+                if not drained:
+                    row.setdefault("failures", []).append(
+                        "outboxes not drained after churn window "
+                        "(messages still parked 90 s past restart)")
+                    row["verdict"] = "fail"
 
             # Recovery probe: after the storm, the stack still answers.
             probe_ok = False
@@ -420,11 +571,18 @@ def main() -> int:
                 b"".join(tail)[-1500:].decode("utf-8", "replace"))
             raise
     finally:
+        for p in respawned:
+            p.terminate()
         launcher.terminate()
         try:
             launcher.wait(timeout=15)
         except subprocess.TimeoutExpired:
             launcher.kill()
+        for p in respawned:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
         if row and not args.no_row:
             path = write_row(row, args.out_dir)
             print(f"ledger row -> {path}", file=sys.stderr)

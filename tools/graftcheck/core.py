@@ -53,7 +53,7 @@ class Config:
     """Knobs shared by the analyzers (defaults match this repo)."""
 
     env_prefixes: tuple[str, ...] = ("SERVE_", "BENCH_", "PAGED_", "FAIL_",
-                                     "LOADGEN_", "P2P_", "TRACE_")
+                                     "LOADGEN_", "P2P_", "TRACE_", "DIR_")
     env_module: str = "utils/env.py"           # the one blessed reader
     docs_files: tuple[str, ...] = ("docs/serving.md",)
     pytest_ini: str = "pytest.ini"
@@ -70,7 +70,8 @@ class Config:
     # references (the router's aggregation tables live under serve/).
     metric_prefixes: tuple[str, ...] = (
         "serve_", "kv_", "prefix_", "router_", "decode_", "inter_token_",
-        "failpoint_", "retry_", "requests_", "loop_", "prefill_", "model_")
+        "failpoint_", "retry_", "requests_", "loop_", "prefill_", "model_",
+        "p2p_", "directory_")
     metric_suffixes: tuple[str, ...] = (
         "_total", "_seconds", "_ms", "_bytes", "_sessions", "_pages",
         "_depth", "_slots", "_occupancy", "_requests", "_entries")
@@ -102,7 +103,7 @@ class Config:
     http_modules: tuple[str, ...] = ("serve/", "loadgen/", "ui.py",
                                      "node.py")
     endpoint_modules: tuple[str, ...] = ("serve/api.py", "serve/router.py",
-                                         "ui.py", "node.py")
+                                         "ui.py", "node.py", "directory.py")
     endpoint_docs: tuple[str, ...] = ("docs/serving.md",)
     # Source set for cross-file analyses (lock-order class models and
     # declarations, metrics export sites): resolved against the FULL
