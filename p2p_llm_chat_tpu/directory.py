@@ -84,6 +84,21 @@ class MemStore:
         with self._mu:
             self._m.pop(username, None)
 
+    def delete_if_older(self, username: str, cutoff_s: float) -> bool:
+        """Compare-and-delete for TTL eviction: re-read the record's
+        heartbeat under the lock and delete only if it is STILL older
+        than ``cutoff_s`` seconds — a node re-registering between a
+        caller's age check and the delete keeps its fresh record
+        instead of being evicted while live."""
+        with self._mu:
+            rec = self._m.get(username)
+            if rec is None:
+                return False
+            if time.time() - parse_ts(rec.last).timestamp() <= cutoff_s:
+                return False
+            del self._m[username]
+            return True
+
     def all(self) -> list[DirectoryRecord]:
         with self._mu:
             return [DirectoryRecord(r.username, r.peer_id, list(r.addrs), r.last)
@@ -168,9 +183,24 @@ class DirectoryService:
             age = time.time() - parse_ts(rec.last).timestamp()
             if age > self.ttl:
                 # Lookup racing ahead of the sweep: the expired record
-                # must 404 NOW, not at the next sweep tick.
-                self._evict(username, age)
-                rec = None
+                # must 404 NOW, not at the next sweep tick. An armed
+                # p2p.directory.evict raise degrades to a skipped
+                # eviction here, same as in the sweep — the handler
+                # must answer the contracted 404/200, never a 500.
+                try:
+                    self._evict(username, age)
+                except Exception as e:  # noqa: BLE001 — armed raise
+                    log.debug("lookup-path evict %s failed: %s",
+                              username, e)
+                # Re-read after the compare-and-delete: a re-register
+                # racing the age check keeps its fresh record and is
+                # served; a stale record the failpoint left in place
+                # still 404s (expired is expired, evicted or not).
+                rec = self.store.get(username)
+                if (rec is not None
+                        and time.time() - parse_ts(rec.last).timestamp()
+                        > self.ttl):
+                    rec = None
         if rec is None:
             return Response(404, {"error": "not found"})
         return Response(200, rec.to_dict())
@@ -186,11 +216,17 @@ class DirectoryService:
         """Drop one expired record, counted. The ``p2p.directory.evict``
         failpoint stalls/fails the eviction (record survives until the
         next sweep or lookup — degradation contract in
-        docs/robustness.md); it never breaks the service."""
+        docs/robustness.md); every caller catches an armed raise, so it
+        never breaks the service. The delete is compare-and-delete
+        (MemStore.delete_if_older): callers compute ``age`` from a
+        snapshot, so a node re-registering between that check and this
+        delete must keep its fresh record — otherwise lookups would
+        404 a live node until its next heartbeat."""
         act = failpoint("p2p.directory.evict")
         if act is not None:
             return            # drop/error: skip this eviction round
-        self.store.delete(username)
+        if not self.store.delete_if_older(username, self.ttl):
+            return            # re-registered since the age check: live
         self._m_evictions.inc()
         log.info("evicted %s (heartbeat lapsed %.1fs > ttl %.1fs)",
                  username, age, self.ttl)

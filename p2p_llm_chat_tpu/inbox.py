@@ -32,6 +32,12 @@ from .proto import ChatMessage
 # delivered once), so ids outlive the messages by this factor.
 _DEDUP_PER_MSG = 8
 
+# Standalone dedup-id bound for the uncapped (reference-parity) inbox:
+# the message buffer being unbounded is a deliberate reference quirk,
+# but the dedup set is pure additive bookkeeping — cap it so
+# at-least-once accounting can never OOM a node on its own.
+_DEDUP_MAX = 4096
+
 
 class Inbox:
     def __init__(self, max_messages: Optional[int] = None) -> None:
@@ -53,8 +59,9 @@ class Inbox:
                     return False
                 self._seen.add(msg.msg_id)
                 self._seen_order.append(msg.msg_id)
-                if (self._max is not None
-                        and len(self._seen_order) > _DEDUP_PER_MSG * self._max):
+                cap = (_DEDUP_PER_MSG * self._max
+                       if self._max is not None else _DEDUP_MAX)
+                if len(self._seen_order) > cap:
                     self._seen.discard(self._seen_order.popleft())
             self._msgs.append(msg)
             if self._max is not None and len(self._msgs) > self._max:

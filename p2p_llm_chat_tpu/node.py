@@ -75,6 +75,7 @@ from __future__ import annotations
 import collections
 import threading
 import time
+import uuid
 from typing import Optional
 
 from .directory import DirectoryClient
@@ -238,6 +239,12 @@ class ChatNode:
         self._flush_mu = threading.Lock()
         self._seq_mu = threading.Lock()
         self._send_seq = 0                       # guarded-by: _seq_mu
+        # Per-boot salt for msg_id minting: _send_seq restarts at 0
+        # with the process, so ids must carry a per-incarnation nonce
+        # or a post-restart send repeating an earlier (seq, content)
+        # pair would re-mint an old id and get dedup-suppressed by a
+        # receiver that stayed up (silent loss of a NEW message).
+        self._boot_nonce = uuid.uuid4().hex
         self._drop_mu = threading.Lock()
         self._dropped = {"ttl": 0, "overflow": 0}  # guarded-by: _drop_mu
         self.metrics = Registry()
@@ -363,7 +370,25 @@ class ChatNode:
             seq = self._send_seq
         msg = ChatMessage(from_user=self.username, to_user=to_username,
                           content=content, timestamp=now_rfc3339(),
-                          msg_id=mint_msg_id(self.username, seq, content))
+                          msg_id=mint_msg_id(self.username, seq, content,
+                                             nonce=self._boot_nonce))
+
+        if self.outbox.has(to_username):
+            # A backlog is already parked for this recipient (the peer
+            # just came back but the worker hasn't flushed yet, or is
+            # mid-flush): delivering the fresh message directly would
+            # jump ahead of the queued ones — _flush_outbox stops at
+            # the first failure per recipient precisely to preserve
+            # send order. Join the back of the queue and kick the
+            # worker so the whole backlog drains in order.
+            for old in self.outbox.put(msg):
+                self._note_drop("overflow", old)
+            self._m_outbox_depth.set(self.outbox.depth())
+            self._outbox_kick.set()
+            _span(outcome="queued", attempts=0)
+            return Response(200, {"status": "queued", "id": msg.id,
+                                  "msg_id": msg.msg_id,
+                                  "trace": tctx.trace_id})
 
         errors: list[str] = []
         won = self._deliver(rec, msg, errors) if rec is not None else ""

@@ -38,13 +38,21 @@ def parse_ts(ts: str) -> datetime:
         return datetime.fromtimestamp(0, tz=timezone.utc)
 
 
-def mint_msg_id(from_user: str, seq: int, content: str) -> str:
-    """Sender-minted delivery identity: sha1 over sender + per-sender
-    sequence + body. Stable across redelivery attempts of the SAME send
-    (the dedup key for at-least-once delivery) while distinct sends of
-    identical text still get distinct ids via ``seq``."""
+def mint_msg_id(from_user: str, seq: int, content: str,
+                nonce: str = "") -> str:
+    """Sender-minted delivery identity: sha1 over sender + per-boot
+    nonce + per-sender sequence + body. Stable across redelivery
+    attempts of the SAME send (the dedup key for at-least-once
+    delivery) while distinct sends of identical text still get
+    distinct ids via ``seq``. ``nonce`` is a random per-process value
+    (node.py mints one per boot): ``seq`` restarts at 0 with the
+    process, so without it a post-restart send repeating an earlier
+    (seq, content) pair would re-mint an old id and be silently
+    dedup-suppressed by any receiver that stayed up."""
     h = hashlib.sha1()
     h.update(from_user.encode("utf-8"))
+    h.update(b"\x00")
+    h.update(nonce.encode("utf-8"))
     h.update(b"\x00")
     h.update(str(seq).encode("ascii"))
     h.update(b"\x00")

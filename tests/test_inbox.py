@@ -59,6 +59,21 @@ def test_optional_cap_drops_oldest():
     assert [m.id for m in inbox.drain("")] == [m.id for m in msgs[2:]]
 
 
+def test_dedup_ids_bounded_without_message_cap():
+    """REGRESSION: the dedup-id ledger is bounded even for the default
+    uncapped (reference-parity) inbox — at-least-once bookkeeping must
+    never grow without bound on its own."""
+    from p2p_llm_chat_tpu.inbox import _DEDUP_MAX
+    inbox = Inbox()                     # max_messages=None
+    for i in range(_DEDUP_MAX + 10):
+        assert inbox.push(ChatMessage(content=f"m{i}", msg_id=f"id{i}"))
+    assert len(inbox._seen) <= _DEDUP_MAX
+    assert len(inbox._seen_order) <= _DEDUP_MAX
+    # Recent ids still dedup after the cap trimmed the oldest.
+    assert not inbox.push(
+        ChatMessage(content="again", msg_id=f"id{_DEDUP_MAX + 9}"))
+
+
 def test_concurrent_push_drain():
     inbox = Inbox()
     n_threads, per_thread = 8, 50

@@ -21,7 +21,7 @@ import urllib.request
 
 import pytest
 
-from p2p_llm_chat_tpu.directory import DirectoryService
+from p2p_llm_chat_tpu.directory import DirectoryRecord, DirectoryService
 from p2p_llm_chat_tpu.loadgen.chaos import NodeChurnWindow, check_churn_delivery
 from p2p_llm_chat_tpu.node import ChatNode
 from p2p_llm_chat_tpu.proto import ChatMessage, mint_msg_id, now_rfc3339
@@ -141,6 +141,72 @@ def test_dedup_suppresses_forced_double_send():
         directory.stop()
 
 
+def test_restarted_sender_mints_fresh_ids():
+    """REGRESSION: msg_id carries a per-boot nonce. The per-sender seq
+    counter resets to 0 on restart, so without the nonce a restarted
+    sender's first message repeating an earlier (seq, content) pair —
+    a first 'hi' after every boot — would re-mint the old id and be
+    silently dedup-suppressed by a receiver that stayed up."""
+    directory = DirectoryService(addr="127.0.0.1:0").start()
+    a = _node("najy", directory.url)
+    b = _node("cannan", directory.url)
+    a2 = None
+    try:
+        http_json("POST", f"{a.http_url}/send",
+                  {"to_username": "cannan", "content": "hi"})
+        _wait_inbox(b.http_url, 1)
+        a.stop()                        # sender restarts; receiver stays up
+        a2 = _node("najy", directory.url)
+        _, resp = http_json("POST", f"{a2.http_url}/send",
+                            {"to_username": "cannan", "content": "hi"})
+        assert resp["status"] == "sent"
+        inbox = _wait_inbox(b.http_url, 2)
+        assert [m["content"] for m in inbox] == ["hi", "hi"]
+        assert len({m["msg_id"] for m in inbox}) == 2
+        assert _metric(_metrics_text(b.http_url),
+                       "p2p_dedup_suppressed_total") in (None, 0)
+    finally:
+        if a2 is not None:
+            a2.stop()
+        b.stop()
+        directory.stop()
+
+
+def test_send_joins_parked_backlog_preserving_order():
+    """REGRESSION: a fresh /send to a recipient with a parked backlog
+    must JOIN the outbox queue, not deliver directly — otherwise it
+    jumps ahead of the older messages the redelivery worker hasn't
+    flushed yet, breaking send order."""
+    directory = DirectoryService(addr="127.0.0.1:0").start()
+    a = _node("najy", directory.url)
+    b = _node("cannan", directory.url)
+    try:
+        http_json("POST", f"{a.http_url}/send",
+                  {"to_username": "cannan", "content": "warmup"})
+        _wait_inbox(b.http_url, 1)
+        fp.arm("p2p.node.deliver", "raise")   # park a backlog
+        _, resp = http_json("POST", f"{a.http_url}/send",
+                            {"to_username": "cannan", "content": "first"},
+                            timeout=20.0)
+        assert resp["status"] == "queued"
+        # Pin the backlog: the worker can't re-resolve while this is
+        # armed, but /send's direct path (dir.lookup) still can — the
+        # exact shape of the bug: recipient reachable, backlog parked.
+        fp.disarm("p2p.node.deliver")
+        fp.arm("p2p.node.resolve", "raise")
+        _, resp = http_json("POST", f"{a.http_url}/send",
+                            {"to_username": "cannan", "content": "second"},
+                            timeout=20.0)
+        assert resp["status"] == "queued"     # joins the queue, no jump
+        fp.disarm("p2p.node.resolve")
+        inbox = _wait_inbox(b.http_url, 3, timeout=15.0)
+        assert [m["content"] for m in inbox] == ["warmup", "first", "second"]
+    finally:
+        a.stop()
+        b.stop()
+        directory.stop()
+
+
 def test_outbox_overflow_and_ttl_drop_accounting(monkeypatch):
     """Bounded loss is ACCOUNTED loss: a 2-deep outbox fed 3 queued
     sends drops the oldest (overflow); the survivors expire at the TTL
@@ -246,6 +312,45 @@ def test_directory_evict_failpoint_stalls_sweep():
         assert directory.store.get("ghost") is None
     finally:
         directory.stop()
+
+
+def test_directory_evict_failpoint_raise_keeps_lookup_contract():
+    """REGRESSION: an armed ``raise`` on p2p.directory.evict must
+    degrade the /lookup path the same way it degrades the sweep — the
+    expired record answers the contracted 404, never a 500."""
+    directory = DirectoryService(addr="127.0.0.1:0", ttl_seconds=0.1).start()
+    try:
+        http_json("POST", f"{directory.url}/register",
+                  {"username": "ghost", "peer_id": "p1", "addrs": []})
+        fp.arm("p2p.directory.evict", "raise")
+        time.sleep(0.3)
+        status, _ = http_json("GET", f"{directory.url}/lookup?username=ghost",
+                              raise_for_status=False)
+        assert status == 404                   # degraded, not a 500
+        assert directory.store.get("ghost") is not None   # evict skipped
+    finally:
+        directory.stop()
+
+
+def test_evict_compare_and_delete_spares_reregistered_record():
+    """REGRESSION: eviction is compare-and-delete — a node
+    re-registering between the sweep's age check and the delete keeps
+    its fresh record instead of 404ing while live."""
+    svc = DirectoryService(addr="127.0.0.1:0", ttl_seconds=5.0)  # no sweep
+    svc.store.set(DirectoryRecord("u", "p1", [],
+                                  last="2000-01-01T00:00:00Z"))
+    # The sweep snapshot saw the stale record and computed age > ttl;
+    # the node re-registers before the delete lands:
+    svc.store.set(DirectoryRecord("u", "p1", [], last=now_rfc3339()))
+    svc._evict("u", age=10.0)
+    assert svc.store.get("u") is not None
+    assert svc._m_evictions.value == 0         # spared, not counted
+    # And a record that IS still stale gets deleted + counted.
+    svc.store.set(DirectoryRecord("u", "p1", [],
+                                  last="2000-01-01T00:00:00Z"))
+    svc._evict("u", age=10.0)
+    assert svc.store.get("u") is None
+    assert svc._m_evictions.value == 1
 
 
 def test_deliver_failpoint_queues_then_recovers():
